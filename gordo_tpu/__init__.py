@@ -1,0 +1,29 @@
+"""gordo_tpu — a TPU-native framework for config-driven building, serving and
+fleet management of thousands of small per-sensor-tag autoencoder models for
+industrial time-series anomaly detection.
+
+Capability parity target: equinor/gordo-components (``gordo_components/``
+upstream layout — see SURVEY.md; the reference mount was empty so citations
+are upstream module paths, not file:line).
+
+Design stance (NOT a port):
+
+- Models are Flax modules built by registered factories
+  (``feedforward_hourglass``, ``lstm_hourglass``, ...) instead of Keras
+  ``Sequential``s (reference: ``gordo_components/model/factories/``).
+- Training is a single jitted XLA program: ``lax.scan`` over optimizer steps
+  with data resident on device (reference: per-model ``keras Model.fit`` on
+  CPU, one Argo pod per model).
+- The fleet axis (thousands of independent per-tag models) is a *mesh
+  dimension*: stacked per-model params trained by ``shard_map(vmap(step))``
+  over a ``("models", "data")`` device mesh with XLA collectives over ICI
+  (reference: Argo DAG fan-out across k8s pods).
+- The config surface (project YAML, model-definition dicts, metadata schema,
+  HTTP routes, CLI verbs) stays behavior-compatible with the reference.
+"""
+
+__version__ = "0.1.0"
+
+from gordo_tpu import serializer  # noqa: F401
+
+__all__ = ["__version__", "serializer"]

@@ -1,0 +1,2 @@
+from gordo_tpu.models import factories  # noqa: F401  (registers factories)
+from gordo_tpu.models.base import GordoBase  # noqa: F401

@@ -1,0 +1,33 @@
+"""Abstract model contract.
+
+Reference equivalent: ``gordo_components/model/base.py::GordoBase`` — every
+model must expose ``get_metadata()``, ``score()`` and ``get_params()`` beyond
+the fit/predict estimator surface.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Dict, Optional
+
+
+class GordoBase(abc.ABC):
+    @abc.abstractmethod
+    def fit(self, X, y=None, **kwargs):
+        ...
+
+    @abc.abstractmethod
+    def predict(self, X):
+        ...
+
+    @abc.abstractmethod
+    def get_metadata(self) -> Dict[str, Any]:
+        """Build/model metadata dict merged into the machine metadata JSON."""
+
+    @abc.abstractmethod
+    def score(self, X, y=None, sample_weight: Optional[Any] = None) -> float:
+        ...
+
+    @abc.abstractmethod
+    def get_params(self, deep: bool = False) -> Dict[str, Any]:
+        ...

@@ -1,0 +1,215 @@
+"""sklearn-contract estimators wrapping Flax modules.
+
+Reference equivalent: ``gordo_components/model/models.py`` —
+``KerasBaseEstimator`` / ``KerasAutoEncoder`` / ``KerasLSTMAutoEncoder`` /
+``KerasLSTMForecast``.  Same contract: construct with ``kind=<registered
+factory name>`` plus kwargs; the network is built from ``X.shape`` at fit
+time; fit/predict/score/get_params/get_metadata like any sklearn estimator;
+pickling carries host-side weights (reference used HDF5-bytes
+``__getstate__``; here params are a host numpy pytree).
+
+TPU-native: fit is one jitted XLA program (``gordo_tpu.train.fit``),
+predict is a jitted apply.  The estimator exposes its pure pieces
+(``module_``, ``params_``) so the fleet engine and the serving scorer can
+batch many estimators into single device programs.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gordo_tpu.models.base import GordoBase
+from gordo_tpu.ops.metrics import explained_variance_score
+from gordo_tpu.ops.windows import make_windows
+from gordo_tpu.registry import lookup_factory
+from gordo_tpu.train.fit import TrainConfig, fit as fit_model
+from gordo_tpu.utils.args import ParamsMixin, capture_args
+from gordo_tpu.utils.trees import param_count, to_host
+
+
+class BaseJaxEstimator(ParamsMixin, GordoBase):
+    """Common machinery; subclasses define windowing/targets."""
+
+    model_type = "AutoEncoder"  # factory-registry type to resolve `kind` in
+
+    @capture_args
+    def __init__(self, kind: str = "feedforward_hourglass", **kwargs):
+        self.kind = kind
+        self.kwargs = kwargs
+        self.params_: Optional[Any] = None
+        self.module_: Optional[Any] = None
+        self.history_: Optional[np.ndarray] = None
+        self.fit_seconds_: Optional[float] = None
+        self._predict_jit = None
+        self._factory_kwargs_built: Dict[str, Any] = {}
+
+    # -- windowing hooks -----------------------------------------------------
+    #: rows of the input consumed before the first prediction row
+    offset = 0
+
+    def _make_inputs(self, X: jnp.ndarray) -> jnp.ndarray:
+        return X
+
+    def _make_targets(self, X: jnp.ndarray, y: Optional[jnp.ndarray]) -> jnp.ndarray:
+        return X if y is None else y
+
+    # -- estimator surface ---------------------------------------------------
+    def fit(self, X, y=None, **fit_kwargs):
+        t0 = time.time()
+        X = jnp.asarray(np.asarray(X, dtype=np.float32))
+        if X.ndim == 1:
+            X = X[:, None]
+        y_arr = None if y is None else jnp.asarray(np.asarray(y, dtype=np.float32))
+        if y_arr is not None and y_arr.ndim == 1:
+            y_arr = y_arr[:, None]
+
+        cfg, factory_kwargs = TrainConfig.from_kwargs({**self.kwargs, **fit_kwargs})
+        inputs = self._make_inputs(X)
+        targets = self._make_targets(X, y_arr)
+
+        factory = lookup_factory(self.model_type, self.kind)
+        built_kwargs = dict(
+            n_features=int(X.shape[1]),
+            n_features_out=int(targets.shape[-1]),
+            **factory_kwargs,
+        )
+        self.module_ = factory(**built_kwargs)
+        self._factory_kwargs_built = built_kwargs
+        self._train_cfg = cfg
+
+        seed = int(factory_kwargs.get("seed", 0) or 0)
+        params, history = fit_model(
+            self.module_, inputs, targets, cfg, rng=jax.random.PRNGKey(seed)
+        )
+        self.params_ = params
+        self.history_ = np.asarray(history)
+        self._predict_jit = None
+        self.fit_seconds_ = time.time() - t0
+        return self
+
+    def _rebuild_module(self):
+        factory = lookup_factory(self.model_type, self.kind)
+        self.module_ = factory(**self._factory_kwargs_built)
+
+    def predict(self, X) -> np.ndarray:
+        if self.params_ is None:
+            raise RuntimeError(f"{type(self).__name__} is not fitted")
+        if self.module_ is None:
+            self._rebuild_module()
+        X = jnp.asarray(np.asarray(X, dtype=np.float32))
+        if X.ndim == 1:
+            X = X[:, None]
+        inputs = self._make_inputs(X)
+        if self._predict_jit is None:
+            self._predict_jit = jax.jit(self.module_.apply)
+        return np.asarray(self._predict_jit({"params": self.params_}, inputs))
+
+    def score(self, X, y=None, sample_weight=None) -> float:
+        """Explained variance of the model's output vs its targets
+        (reference: ``KerasAutoEncoder.score``)."""
+        X = jnp.asarray(np.asarray(X, dtype=np.float32))
+        if X.ndim == 1:
+            X = X[:, None]
+        y_arr = None if y is None else jnp.asarray(np.asarray(y, dtype=np.float32))
+        targets = self._make_targets(X, y_arr)
+        pred = self.predict(X)
+        return float(explained_variance_score(targets, pred))
+
+    def get_metadata(self) -> Dict[str, Any]:
+        meta: Dict[str, Any] = {
+            "model_type": type(self).__name__,
+            "kind": self.kind,
+            "parameters": {**self.kwargs},
+        }
+        if self.params_ is not None:
+            meta.update(
+                {
+                    "num_params": param_count(self.params_),
+                    "fit_seconds": self.fit_seconds_,
+                    "history": {
+                        "loss": [
+                            float(v)
+                            for v in ([] if self.history_ is None else self.history_)
+                        ],
+                    },
+                }
+            )
+        return meta
+
+    # -- pickling (device-independent artifacts) ----------------------------
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state["params_"] = to_host(state.get("params_"))
+        state["module_"] = None  # rebuilt from factory on demand
+        state["_predict_jit"] = None
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+
+
+class AutoEncoder(BaseJaxEstimator):
+    """Feedforward reconstruction AE (reference: ``KerasAutoEncoder``).
+
+    Target is the estimator's own (already pipeline-transformed) input;
+    score is explained variance of the reconstruction.
+    """
+
+    model_type = "AutoEncoder"
+
+
+class LSTMAutoEncoder(BaseJaxEstimator):
+    """Windowed LSTM reconstruction (reference: ``KerasLSTMAutoEncoder``).
+
+    Windows X into ``lookback_window``-length subsequences on device; the
+    model reconstructs the window's final timestep, so predictions start at
+    row ``lookback_window - 1`` of the input (``offset``).
+    """
+
+    model_type = "LSTMAutoEncoder"
+
+    def __init__(self, kind: str = "lstm_hourglass", **kwargs):
+        super().__init__(kind=kind, **kwargs)
+
+    @property
+    def lookback_window(self) -> int:
+        return int(self.kwargs.get("lookback_window", 1))
+
+    @property
+    def offset(self) -> int:
+        return self.lookback_window - 1
+
+    def _make_inputs(self, X):
+        return make_windows(X, self.lookback_window)
+
+    def _make_targets(self, X, y):
+        base = X if y is None else y
+        return base[self.lookback_window - 1:]
+
+
+class LSTMForecast(LSTMAutoEncoder):
+    """Windowed LSTM one-step-ahead forecast (reference:
+    ``KerasLSTMForecast``): window ``X[t-L:t]`` predicts ``X[t]``, so
+    predictions start at row ``lookback_window`` of the input."""
+
+    @property
+    def offset(self) -> int:
+        return self.lookback_window
+
+    def _make_inputs(self, X):
+        return make_windows(X[:-1], self.lookback_window)
+
+    def _make_targets(self, X, y):
+        base = X if y is None else y
+        return base[self.lookback_window:]
+
+
+# Parity aliases (reference class names).
+KerasAutoEncoder = AutoEncoder
+KerasLSTMAutoEncoder = LSTMAutoEncoder
+KerasLSTMForecast = LSTMForecast

@@ -1,0 +1,155 @@
+"""Feedforward autoencoder factories as Flax modules.
+
+Reference equivalent:
+``gordo_components/model/factories/feedforward_autoencoder.py`` —
+``feedforward_model`` / ``feedforward_symmetric`` / ``feedforward_hourglass``
+returning compiled Keras ``Sequential`` models.  Here each factory returns a
+Flax ``nn.Module``; optimizer/loss selection lives in the estimator's train
+config (``gordo_tpu.train.fit``), not baked into the network, because under
+XLA the whole fit loop is one compiled program anyway.
+
+MXU note: these nets are tiny (feature counts in the tens).  Single-model
+matmuls cannot fill the 128x128 systolic array — throughput comes from the
+fleet engine vmapping thousands of such models into one batched matmul
+(``gordo_tpu.parallel.fleet``), which these modules are shaped for: pure
+dense stacks, static shapes, no data-dependent control flow.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence, Tuple, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from gordo_tpu.models.factories.utils import hourglass_calc_dims
+from gordo_tpu.registry import register_model_builder
+
+ACTIVATIONS = {
+    "tanh": nn.tanh,
+    "relu": nn.relu,
+    "sigmoid": nn.sigmoid,
+    "elu": nn.elu,
+    "selu": nn.selu,
+    "softplus": nn.softplus,
+    "leaky_relu": nn.leaky_relu,
+    "gelu": nn.gelu,
+    "linear": lambda x: x,
+    None: lambda x: x,
+}
+
+
+def resolve_activation(name: Union[str, Callable, None]) -> Callable:
+    if callable(name):
+        return name
+    try:
+        return ACTIVATIONS[name]
+    except KeyError:
+        raise ValueError(
+            f"Unknown activation {name!r}; available: {sorted(k for k in ACTIVATIONS if isinstance(k, str))}"
+        )
+
+
+def _broadcast_funcs(funcs, n: int) -> Tuple:
+    if funcs is None:
+        funcs = "tanh"
+    if isinstance(funcs, (str,)) or callable(funcs):
+        return tuple([funcs] * n)
+    funcs = tuple(funcs)
+    if len(funcs) != n:
+        raise ValueError(f"Got {len(funcs)} activation funcs for {n} layers")
+    return funcs
+
+
+class FeedForwardAutoEncoder(nn.Module):
+    """Dense stack: encoder dims -> decoder dims -> linear-ish output head.
+
+    Hidden compute runs in ``compute_dtype`` (bfloat16 by default on TPU —
+    MXU-native) with float32 params and a float32 output head.
+    """
+
+    dims: Tuple[int, ...]
+    funcs: Tuple[Union[str, Callable], ...]
+    out_dim: int
+    out_func: Union[str, Callable, None] = "linear"
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = x.astype(self.compute_dtype)
+        for i, (d, f) in enumerate(zip(self.dims, self.funcs)):
+            x = nn.Dense(d, dtype=self.compute_dtype, name=f"dense_{i}")(x)
+            x = resolve_activation(f)(x)
+        x = nn.Dense(self.out_dim, dtype=jnp.float32, name="out")(x)
+        return resolve_activation(self.out_func)(x.astype(jnp.float32))
+
+
+@register_model_builder(type="AutoEncoder")
+def feedforward_model(
+    n_features: int,
+    n_features_out: int = None,
+    encoding_dim: Sequence[int] = (256, 128, 64),
+    encoding_func: Sequence[str] = None,
+    decoding_dim: Sequence[int] = (64, 128, 256),
+    decoding_func: Sequence[str] = None,
+    out_func: str = "linear",
+    compute_dtype: str = "bfloat16",
+    **_ignored,
+) -> nn.Module:
+    """Fully parameterised encoder/decoder AE (reference:
+    ``feedforward_autoencoder.feedforward_model``)."""
+    n_features_out = n_features_out or n_features
+    enc = tuple(int(d) for d in encoding_dim)
+    dec = tuple(int(d) for d in decoding_dim)
+    funcs = _broadcast_funcs(encoding_func, len(enc)) + _broadcast_funcs(
+        decoding_func, len(dec)
+    )
+    return FeedForwardAutoEncoder(
+        dims=enc + dec,
+        funcs=funcs,
+        out_dim=int(n_features_out),
+        out_func=out_func,
+        compute_dtype=jnp.dtype(compute_dtype),
+    )
+
+
+@register_model_builder(type="AutoEncoder")
+def feedforward_symmetric(
+    n_features: int,
+    n_features_out: int = None,
+    dims: Sequence[int] = (256, 128, 64),
+    funcs: Sequence[str] = None,
+    **kwargs,
+) -> nn.Module:
+    """Symmetric AE: encoder ``dims``, decoder reversed (reference:
+    ``feedforward_symmetric``)."""
+    if not dims:
+        raise ValueError("dims must be non-empty")
+    dims = tuple(int(d) for d in dims)
+    funcs = _broadcast_funcs(funcs, len(dims))
+    return feedforward_model(
+        n_features,
+        n_features_out,
+        encoding_dim=dims,
+        encoding_func=funcs,
+        decoding_dim=dims[::-1],
+        decoding_func=funcs[::-1],
+        **kwargs,
+    )
+
+
+@register_model_builder(type="AutoEncoder")
+def feedforward_hourglass(
+    n_features: int,
+    n_features_out: int = None,
+    encoding_layers: int = 3,
+    compression_factor: float = 0.5,
+    func: str = "tanh",
+    **kwargs,
+) -> nn.Module:
+    """Geometrically tapered hourglass AE — the reference's default model
+    (reference: ``feedforward_autoencoder.feedforward_hourglass``)."""
+    dims = hourglass_calc_dims(compression_factor, encoding_layers, n_features)
+    return feedforward_symmetric(
+        n_features, n_features_out, dims=dims, funcs=[func] * len(dims), **kwargs
+    )

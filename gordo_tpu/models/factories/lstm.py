@@ -1,0 +1,130 @@
+"""LSTM autoencoder/forecast factories as Flax modules.
+
+Reference equivalent:
+``gordo_components/model/factories/lstm_autoencoder.py`` — ``lstm_model`` /
+``lstm_symmetric`` / ``lstm_hourglass`` over ``(lookback, n_features)``
+windows.
+
+TPU-native design: recurrence is expressed with ``flax.linen.RNN`` (which
+lowers to ``lax.scan`` — compiler-friendly sequential control flow, no
+Python loops in the traced program).  The window axis is short (order 10^2)
+so scan latency is fine; throughput comes from batching across windows *and*
+across models in the fleet engine.  The head reads the final timestep state
+and projects to the output features, matching the reference's 2D
+``(batch, n_features)`` output contract.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from gordo_tpu.models.factories.feedforward import (
+    _broadcast_funcs,
+    resolve_activation,
+)
+from gordo_tpu.models.factories.utils import hourglass_calc_dims
+from gordo_tpu.registry import register_model_builder
+
+
+class LSTMAutoEncoderModule(nn.Module):
+    """Stacked LSTM layers over the window, final-step dense head."""
+
+    dims: Tuple[int, ...]
+    funcs: Tuple[Union[str], ...]
+    out_dim: int
+    out_func: str = "linear"
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        # x: (batch, lookback, n_features)
+        squeeze = x.ndim == 2
+        if squeeze:  # single window
+            x = x[None]
+        for i, (d, f) in enumerate(zip(self.dims, self.funcs)):
+            x = nn.RNN(nn.OptimizedLSTMCell(int(d)), name=f"lstm_{i}")(x)
+            x = resolve_activation(f)(x)
+        out = nn.Dense(self.out_dim, dtype=jnp.float32, name="out")(x[:, -1, :])
+        out = resolve_activation(self.out_func)(out)
+        return out[0] if squeeze else out
+
+
+@register_model_builder(type="LSTMAutoEncoder")
+def lstm_model(
+    n_features: int,
+    n_features_out: int = None,
+    lookback_window: int = 1,
+    encoding_dim: Sequence[int] = (256, 128, 64),
+    encoding_func: Sequence[str] = None,
+    decoding_dim: Sequence[int] = (64, 128, 256),
+    decoding_func: Sequence[str] = None,
+    out_func: str = "linear",
+    **_ignored,
+) -> nn.Module:
+    """Encoder/decoder LSTM stack (reference: ``lstm_autoencoder.lstm_model``).
+
+    ``lookback_window`` is consumed by the estimator for windowing; the module
+    itself handles any window length (scan over time axis).
+    """
+    n_features_out = n_features_out or n_features
+    enc = tuple(int(d) for d in encoding_dim)
+    dec = tuple(int(d) for d in decoding_dim)
+    funcs = _broadcast_funcs(encoding_func, len(enc)) + _broadcast_funcs(
+        decoding_func, len(dec)
+    )
+    return LSTMAutoEncoderModule(
+        dims=enc + dec,
+        funcs=funcs,
+        out_dim=int(n_features_out),
+        out_func=out_func,
+    )
+
+
+@register_model_builder(type="LSTMAutoEncoder")
+def lstm_symmetric(
+    n_features: int,
+    n_features_out: int = None,
+    lookback_window: int = 1,
+    dims: Sequence[int] = (256, 128, 64),
+    funcs: Sequence[str] = None,
+    **kwargs,
+) -> nn.Module:
+    """Symmetric LSTM AE (reference: ``lstm_symmetric``)."""
+    if not dims:
+        raise ValueError("dims must be non-empty")
+    dims = tuple(int(d) for d in dims)
+    funcs = _broadcast_funcs(funcs, len(dims))
+    return lstm_model(
+        n_features,
+        n_features_out,
+        lookback_window=lookback_window,
+        encoding_dim=dims,
+        encoding_func=funcs,
+        decoding_dim=dims[::-1],
+        decoding_func=funcs[::-1],
+        **kwargs,
+    )
+
+
+@register_model_builder(type="LSTMAutoEncoder")
+def lstm_hourglass(
+    n_features: int,
+    n_features_out: int = None,
+    lookback_window: int = 1,
+    encoding_layers: int = 3,
+    compression_factor: float = 0.5,
+    func: str = "tanh",
+    **kwargs,
+) -> nn.Module:
+    """Tapered LSTM AE (reference: ``lstm_autoencoder.lstm_hourglass``)."""
+    dims = hourglass_calc_dims(compression_factor, encoding_layers, n_features)
+    return lstm_symmetric(
+        n_features,
+        n_features_out,
+        lookback_window=lookback_window,
+        dims=dims,
+        funcs=[func] * len(dims),
+        **kwargs,
+    )

@@ -1,0 +1,365 @@
+"""Functional, jit-foldable preprocessing transforms.
+
+Reference equivalents: the sklearn transformers gordo-components composes in
+its pipelines (``sklearn.preprocessing.MinMaxScaler`` etc. — aliased onto
+these classes by :data:`gordo_tpu.registry.ALIASES`) plus
+``gordo_components/model/transformers/``.
+
+TPU-native design: a transform is *stats + a pure function*.  ``fit``
+computes stats on device (one fused XLA reduction, NaN-aware); ``transform``
+/ ``inverse_transform`` are pure jnp functions of ``(stats, X)`` so
+estimators and the anomaly scorer can fold them into a single jitted program
+instead of round-tripping through host numpy between pipeline steps
+(the sklearn execution model the reference inherits).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gordo_tpu.utils.args import ParamsMixin, capture_args
+
+_EPS = 1e-12
+
+
+def _as2d(X) -> jnp.ndarray:
+    X = jnp.asarray(X, dtype=jnp.float32)
+    if X.ndim == 1:
+        X = X[:, None]
+    return X
+
+
+class BaseTransform(ParamsMixin):
+    """Stats + pure-function transform. Subclasses define the static fns."""
+
+    def __init__(self):
+        self.stats_: Optional[dict] = None
+
+    # -- pure functions (jit-safe, also used folded into estimator programs).
+    # CONTRACT: ``stats`` is self-contained — every constructor option that
+    # affects the transform is folded into the stats at fit time, so
+    # ``apply(stats, X)`` inside a jitted program always agrees with the
+    # stateful ``transform(X)``.
+    @staticmethod
+    def compute_stats(X: jnp.ndarray, **options) -> dict:  # pragma: no cover
+        raise NotImplementedError
+
+    @staticmethod
+    def apply(stats: dict, X: jnp.ndarray) -> jnp.ndarray:  # pragma: no cover
+        raise NotImplementedError
+
+    @staticmethod
+    def invert(stats: dict, X: jnp.ndarray) -> jnp.ndarray:
+        raise NotImplementedError("transform is not invertible")
+
+    def _stat_options(self) -> dict:
+        """Constructor options forwarded to ``compute_stats`` at fit time."""
+        return {}
+
+    # -- sklearn-flavoured stateful API -------------------------------------
+    def fit(self, X, y=None):
+        from gordo_tpu.utils.trees import to_host
+
+        self.stats_ = to_host(
+            type(self).compute_stats(_as2d(X), **self._stat_options())
+        )
+        return self
+
+    def fit_transform(self, X, y=None):
+        return self.fit(X, y).transform(X)
+
+    def transform(self, X):
+        self._check_fitted()
+        return np.asarray(type(self).apply(self.stats_, _as2d(X)))
+
+    def inverse_transform(self, X):
+        self._check_fitted()
+        try:
+            return np.asarray(type(self).invert(self.stats_, _as2d(X)))
+        except NotImplementedError:
+            raise NotImplementedError(
+                f"{type(self).__name__} is not invertible"
+            ) from None
+
+    def _check_fitted(self):
+        if self.stats_ is None:
+            raise RuntimeError(f"{type(self).__name__} is not fitted")
+
+    def __getstate__(self):
+        from gordo_tpu.utils.trees import to_host
+
+        state = dict(self.__dict__)
+        state["stats_"] = to_host(state.get("stats_"))
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+
+
+class MinMaxScaler(BaseTransform):
+    """Scale features to ``feature_range`` (default [0, 1]).
+
+    Stats are a folded affine map (``scale``/``offset``) so the pure
+    ``apply`` honours the configured range."""
+
+    @capture_args
+    def __init__(self, feature_range=(0, 1)):
+        super().__init__()
+        self.feature_range = tuple(feature_range)
+
+    def _stat_options(self):
+        return {"feature_range": self.feature_range}
+
+    @staticmethod
+    def compute_stats(X, feature_range=(0.0, 1.0)):
+        a, b = feature_range
+        lo = jnp.nanmin(X, axis=0)
+        hi = jnp.nanmax(X, axis=0)
+        scale = (b - a) / jnp.maximum(hi - lo, _EPS)
+        return {"scale": scale, "offset": a - lo * scale}
+
+    @staticmethod
+    def apply(stats, X):
+        return X * stats["scale"] + stats["offset"]
+
+    @staticmethod
+    def invert(stats, X):
+        return (X - stats["offset"]) / stats["scale"]
+
+
+class StandardScaler(BaseTransform):
+    """Zero-mean unit-variance per feature."""
+
+    @capture_args
+    def __init__(self, with_mean: bool = True, with_std: bool = True):
+        super().__init__()
+        self.with_mean = with_mean
+        self.with_std = with_std
+
+    def _stat_options(self):
+        return {"with_mean": self.with_mean, "with_std": self.with_std}
+
+    @staticmethod
+    def compute_stats(X, with_mean=True, with_std=True):
+        mean = jnp.nanmean(X, axis=0)
+        std = jnp.maximum(jnp.nanstd(X, axis=0), _EPS)
+        ones = jnp.ones_like(std)
+        return {
+            "mean": mean if with_mean else jnp.zeros_like(mean),
+            "std": std if with_std else ones,
+        }
+
+    @staticmethod
+    def apply(stats, X):
+        return (X - stats["mean"]) / stats["std"]
+
+    @staticmethod
+    def invert(stats, X):
+        return X * stats["std"] + stats["mean"]
+
+
+class RobustScaler(BaseTransform):
+    """Median/IQR scaling (outlier-robust, the detector's usual scaler)."""
+
+    @capture_args
+    def __init__(self, with_centering: bool = True, with_scaling: bool = True,
+                 quantile_range=(25.0, 75.0)):
+        super().__init__()
+        self.with_centering = with_centering
+        self.with_scaling = with_scaling
+        self.quantile_range = tuple(quantile_range)
+
+    def _stat_options(self):
+        return {
+            "with_centering": self.with_centering,
+            "with_scaling": self.with_scaling,
+            "quantile_range": self.quantile_range,
+        }
+
+    @staticmethod
+    def compute_stats(X, with_centering=True, with_scaling=True,
+                      quantile_range=(25.0, 75.0)):
+        lo, hi = quantile_range
+        q = jnp.nanpercentile(X, jnp.array([lo, 50.0, hi]), axis=0)
+        center = q[1]
+        scale = jnp.maximum(q[2] - q[0], _EPS)
+        return {
+            "center": center if with_centering else jnp.zeros_like(center),
+            "scale": scale if with_scaling else jnp.ones_like(scale),
+        }
+
+    @staticmethod
+    def apply(stats, X):
+        return (X - stats["center"]) / stats["scale"]
+
+    @staticmethod
+    def invert(stats, X):
+        return X * stats["scale"] + stats["center"]
+
+
+class QuantileTransformer(BaseTransform):
+    """Map features onto a uniform (or normal) distribution via per-feature
+    quantile grids + linear interpolation.  Stats are a fixed-size grid so the
+    transform stays jit-friendly (static shapes)."""
+
+    @capture_args
+    def __init__(self, n_quantiles: int = 100, output_distribution: str = "uniform"):
+        super().__init__()
+        self.n_quantiles = int(n_quantiles)
+        self.output_distribution = output_distribution
+
+    def fit(self, X, y=None):
+        from gordo_tpu.utils.trees import to_host
+
+        X = _as2d(X)
+        qs = jnp.linspace(0.0, 100.0, self.n_quantiles)
+        self.stats_ = to_host({"grid": jnp.nanpercentile(X, qs, axis=0)})
+        return self
+
+    def transform(self, X):
+        self._check_fitted()
+        X = _as2d(X)
+        grid = jnp.asarray(self.stats_["grid"])  # (Q, F)
+        qs = jnp.linspace(0.0, 1.0, grid.shape[0])
+        out = jax.vmap(
+            lambda col, g: jnp.interp(col, g, qs), in_axes=(1, 1), out_axes=1
+        )(X, grid)
+        if self.output_distribution == "normal":
+            from jax.scipy.stats import norm
+
+            out = norm.ppf(jnp.clip(out, 1e-6, 1 - 1e-6))
+        return np.asarray(out)
+
+    def inverse_transform(self, X):
+        self._check_fitted()
+        X = _as2d(X)
+        if self.output_distribution == "normal":
+            from jax.scipy.stats import norm
+
+            X = norm.cdf(X)
+        grid = jnp.asarray(self.stats_["grid"])
+        qs = jnp.linspace(0.0, 1.0, grid.shape[0])
+        out = jax.vmap(
+            lambda col, g: jnp.interp(col, qs, g), in_axes=(1, 1), out_axes=1
+        )(X, grid)
+        return np.asarray(out)
+
+
+class SimpleImputer(BaseTransform):
+    """Fill NaNs with a per-feature statistic (mean/median/constant)."""
+
+    @capture_args
+    def __init__(self, strategy: str = "mean", fill_value: float = 0.0):
+        super().__init__()
+        self.strategy = strategy
+        self.fill_value = fill_value
+
+    def fit(self, X, y=None):
+        from gordo_tpu.utils.trees import to_host
+
+        X = _as2d(X)
+        if self.strategy == "mean":
+            fill = jnp.nanmean(X, axis=0)
+        elif self.strategy == "median":
+            fill = jnp.nanmedian(X, axis=0)
+        elif self.strategy == "constant":
+            fill = jnp.full((X.shape[1],), float(self.fill_value))
+        else:
+            raise ValueError(f"Unknown imputer strategy {self.strategy!r}")
+        self.stats_ = to_host({"fill": fill})
+        return self
+
+    @staticmethod
+    def apply(stats, X):
+        return jnp.where(jnp.isnan(X), stats["fill"], X)
+
+    @staticmethod
+    def invert(stats, X):
+        return X
+
+    def transform(self, X):
+        self._check_fitted()
+        return np.asarray(SimpleImputer.apply(self.stats_, _as2d(X)))
+
+    def inverse_transform(self, X):
+        return np.asarray(_as2d(X))
+
+
+class PCA(BaseTransform):
+    """Principal component projection via on-device SVD."""
+
+    @capture_args
+    def __init__(self, n_components: Optional[int] = None):
+        super().__init__()
+        self.n_components = n_components
+
+    def fit(self, X, y=None):
+        from gordo_tpu.utils.trees import to_host
+
+        X = _as2d(X)
+        k = self.n_components or X.shape[1]
+        mean = jnp.mean(X, axis=0)
+        _, _, vt = jnp.linalg.svd(X - mean, full_matrices=False)
+        self.stats_ = to_host({"mean": mean, "components": vt[:k]})
+        return self
+
+    @staticmethod
+    def apply(stats, X):
+        return (X - stats["mean"]) @ stats["components"].T
+
+    @staticmethod
+    def invert(stats, X):
+        return X @ stats["components"] + stats["mean"]
+
+    def transform(self, X):
+        self._check_fitted()
+        return np.asarray(PCA.apply(self.stats_, _as2d(X)))
+
+    def inverse_transform(self, X):
+        self._check_fitted()
+        return np.asarray(PCA.invert(self.stats_, _as2d(X)))
+
+
+class FunctionTransformer(BaseTransform):
+    """Apply an arbitrary (registered) callable as a pipeline step.
+
+    Reference: ``sklearn.preprocessing.FunctionTransformer`` carrying funcs
+    from ``gordo_components/model/transformer_funcs/general.py``.
+    """
+
+    @capture_args
+    def __init__(self, func: Optional[Callable] = None,
+                 inverse_func: Optional[Callable] = None, kw_args: Optional[dict] = None,
+                 inv_kw_args: Optional[dict] = None):
+        super().__init__()
+        self.func = func
+        self.inverse_func = inverse_func
+        self.kw_args = kw_args or {}
+        self.inv_kw_args = inv_kw_args or {}
+
+    def fit(self, X, y=None):
+        self.stats_ = {}
+        return self
+
+    def transform(self, X):
+        if self.func is None:
+            return np.asarray(_as2d(X))
+        return np.asarray(self.func(_as2d(X), **self.kw_args))
+
+    def inverse_transform(self, X):
+        if self.inverse_func is None:
+            return np.asarray(_as2d(X))
+        return np.asarray(self.inverse_func(_as2d(X), **self.inv_kw_args))
+
+    def get_params(self, deep: bool = False):
+        params = dict(self._init_params)
+        # store funcs as dotted paths for definition round-trip
+        for key in ("func", "inverse_func"):
+            fn = params.get(key)
+            if callable(fn):
+                params[key] = f"{fn.__module__}.{fn.__qualname__}"
+        return params

@@ -1,0 +1,25 @@
+"""Tiny callables usable as ``FunctionTransformer`` funcs in YAML definitions.
+
+Reference equivalent: ``gordo_components/model/transformer_funcs/general.py``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def multiplier(X, factor: float = 1.0):
+    """Multiply all values by ``factor`` (reference: ``general.multiplier``)."""
+    return jnp.asarray(X) * factor
+
+
+def adder(X, addend: float = 0.0):
+    return jnp.asarray(X) + addend
+
+
+def log1p(X):
+    return jnp.log1p(jnp.asarray(X))
+
+
+def expm1(X):
+    return jnp.expm1(jnp.asarray(X))

@@ -1,0 +1,25 @@
+"""Device-side sliding-window materialisation for LSTM estimators.
+
+Reference equivalent: the keras ``TimeseriesGenerator`` helper used by
+``KerasLSTMAutoEncoder``/``KerasLSTMForecast`` in
+``gordo_components/model/models.py`` — there a host-side Python generator;
+here a single gather on device (static shapes, vmap/jit-safe).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def num_windows(n_rows: int, lookback: int) -> int:
+    return max(n_rows - lookback + 1, 0)
+
+
+def make_windows(X: jnp.ndarray, lookback: int) -> jnp.ndarray:
+    """(N, F) -> (N - lookback + 1, lookback, F) overlapping windows."""
+    X = jnp.asarray(X)
+    n = X.shape[0]
+    if n < lookback:
+        raise ValueError(f"Need at least lookback={lookback} rows, got {n}")
+    idx = jnp.arange(n - lookback + 1)[:, None] + jnp.arange(lookback)[None, :]
+    return X[idx]

@@ -1,0 +1,207 @@
+"""Functional pipeline containers.
+
+Reference equivalent: the sklearn containers gordo-components composes via
+``serializer.pipeline_from_definition`` — ``sklearn.pipeline.Pipeline``,
+``FeatureUnion``, ``sklearn.compose.TransformedTargetRegressor``,
+``sklearn.multioutput.MultiOutputRegressor`` (aliased onto these classes by
+the definition interpreter).
+
+Same fit/transform/predict contract; the implementation difference is that
+transforms here are stats+pure-function objects (``gordo_tpu.ops.scalers``)
+whose application can be folded into jitted device programs by the serving
+scorer and fleet engine rather than executed step-by-step through host numpy.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from gordo_tpu.utils.args import ParamsMixin, capture_args
+
+StepLike = Union[Any, Tuple[str, Any], List]
+
+
+def _normalize_steps(steps: Sequence[StepLike]) -> List[Tuple[str, Any]]:
+    normalized = []
+    for i, step in enumerate(steps):
+        if isinstance(step, (tuple, list)) and len(step) == 2 and isinstance(step[0], str):
+            normalized.append((step[0], step[1]))
+        else:
+            normalized.append((f"step_{i}", step))
+    return normalized
+
+
+class Pipeline(ParamsMixin):
+    """Sequential transform chain ending in an estimator (or not)."""
+
+    @capture_args
+    def __init__(self, steps: Sequence[StepLike], memory: Optional[str] = None):
+        self.steps = _normalize_steps(steps)
+        self.memory = memory
+
+    # -- helpers -------------------------------------------------------------
+    @property
+    def named_steps(self) -> Dict[str, Any]:
+        return dict(self.steps)
+
+    def __getitem__(self, idx):
+        return self.steps[idx][1]
+
+    @property
+    def _final(self) -> Any:
+        return self.steps[-1][1]
+
+    @property
+    def offset(self) -> int:
+        """Input rows consumed before the first prediction row (LSTM lookback)."""
+        return getattr(self._final, "offset", 0)
+
+    def _transform_until_final(self, X):
+        for _, step in self.steps[:-1]:
+            X = step.transform(X)
+        return X
+
+    # -- sklearn-contract surface -------------------------------------------
+    def fit(self, X, y=None, **fit_kwargs):
+        for _, step in self.steps[:-1]:
+            X = step.fit_transform(X, y)
+        if hasattr(self._final, "fit"):
+            self._final.fit(X, y, **fit_kwargs)
+        return self
+
+    def transform(self, X):
+        X = self._transform_until_final(X)
+        final = self._final
+        if hasattr(final, "transform"):
+            X = final.transform(X)
+        return X
+
+    def fit_transform(self, X, y=None):
+        return self.fit(X, y).transform(X)
+
+    def predict(self, X):
+        X = self._transform_until_final(X)
+        return self._final.predict(X)
+
+    def inverse_transform(self, X):
+        for _, step in reversed(self.steps):
+            if hasattr(step, "inverse_transform"):
+                X = step.inverse_transform(X)
+        return X
+
+    def score(self, X, y=None, sample_weight=None):
+        Xt = self._transform_until_final(X)
+        return self._final.score(Xt, y, sample_weight)
+
+    def get_metadata(self) -> Dict[str, Any]:
+        final = self._final
+        if hasattr(final, "get_metadata"):
+            return final.get_metadata()
+        return {}
+
+    def get_params(self, deep: bool = False):
+        # Preserve custom step names through definition round-trips; emit the
+        # reference's bare-object form when names are the auto-generated ones.
+        if all(name == f"step_{i}" for i, (name, _) in enumerate(self.steps)):
+            return {"steps": [obj for _, obj in self.steps]}
+        return {"steps": [[name, obj] for name, obj in self.steps]}
+
+
+class FeatureUnion(ParamsMixin):
+    """Concatenate multiple transformers' outputs along the feature axis."""
+
+    @capture_args
+    def __init__(self, transformer_list: Sequence[StepLike], n_jobs: Optional[int] = None):
+        self.transformer_list = _normalize_steps(transformer_list)
+
+    def fit(self, X, y=None):
+        for _, t in self.transformer_list:
+            t.fit(X, y)
+        return self
+
+    def transform(self, X):
+        outs = [t.transform(X) for _, t in self.transformer_list]
+        return np.concatenate([np.asarray(o) for o in outs], axis=1)
+
+    def fit_transform(self, X, y=None):
+        return self.fit(X, y).transform(X)
+
+    def get_params(self, deep: bool = False):
+        if all(
+            name == f"step_{i}"
+            for i, (name, _) in enumerate(self.transformer_list)
+        ):
+            return {"transformer_list": [obj for _, obj in self.transformer_list]}
+        return {"transformer_list": [[name, obj] for name, obj in self.transformer_list]}
+
+
+class TransformedTargetRegressor(ParamsMixin):
+    """Fit the regressor on transformed targets; predict in original units."""
+
+    @capture_args
+    def __init__(self, regressor=None, transformer=None):
+        self.regressor = regressor
+        self.transformer = transformer
+
+    @property
+    def offset(self) -> int:
+        return getattr(self.regressor, "offset", 0)
+
+    def fit(self, X, y=None, **fit_kwargs):
+        y = np.asarray(X if y is None else y, dtype=np.float32)
+        if self.transformer is not None:
+            y_t = self.transformer.fit_transform(y)
+        else:
+            y_t = y
+        self.regressor.fit(X, y_t, **fit_kwargs)
+        return self
+
+    def predict(self, X):
+        pred = self.regressor.predict(X)
+        if self.transformer is not None:
+            pred = self.transformer.inverse_transform(pred)
+        return np.asarray(pred)
+
+    def score(self, X, y=None, sample_weight=None):
+        from gordo_tpu.ops.metrics import explained_variance_score
+
+        y = np.asarray(X if y is None else y, dtype=np.float32)
+        pred = self.predict(X)
+        offset = self.offset
+        return float(explained_variance_score(y[offset:], pred))
+
+    def get_metadata(self):
+        if hasattr(self.regressor, "get_metadata"):
+            return self.regressor.get_metadata()
+        return {}
+
+
+class MultiOutputRegressor(ParamsMixin):
+    """One cloned estimator per output column."""
+
+    @capture_args
+    def __init__(self, estimator=None, n_jobs: Optional[int] = None):
+        self.estimator = estimator
+        self.estimators_: List[Any] = []
+
+    def fit(self, X, y=None, **fit_kwargs):
+        y = np.asarray(X if y is None else y, dtype=np.float32)
+        if y.ndim == 1:
+            y = y[:, None]
+        self.estimators_ = []
+        for col in range(y.shape[1]):
+            est = self.estimator.clone() if hasattr(self.estimator, "clone") else self.estimator
+            est.fit(X, y[:, col:col + 1], **fit_kwargs)
+            self.estimators_.append(est)
+        return self
+
+    def predict(self, X):
+        preds = [np.asarray(e.predict(X)).reshape(len(X), -1) for e in self.estimators_]
+        return np.concatenate(preds, axis=1)
+
+    def get_metadata(self):
+        if self.estimators_ and hasattr(self.estimators_[0], "get_metadata"):
+            return {"per_output": [e.get_metadata() for e in self.estimators_]}
+        return {}

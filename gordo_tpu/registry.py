@@ -1,0 +1,105 @@
+"""Component registries.
+
+Two registries live here:
+
+1. ``register_model_builder`` — the model-factory registry, behavior
+   compatible with the reference's
+   ``gordo_components/model/register.py::register_model_builder``: a
+   decorator that files a factory function under ``{model_type: {name: fn}}``
+   so estimators can resolve their ``kind`` parameter at fit time.
+
+2. ``ALIASES`` — dotted-path aliases used by the definition-dict interpreter
+   (:mod:`gordo_tpu.serializer.definition`) so that *reference* YAML configs
+   (``sklearn.pipeline.Pipeline``, ``gordo_components.model.models.
+   KerasAutoEncoder`` ...) resolve to this framework's TPU-native classes.
+   This is what makes an existing gordo-components project YAML work
+   unchanged against gordo_tpu.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+# {model_type: {factory_name: factory_fn}}
+FACTORY_REGISTRY: Dict[str, Dict[str, Callable]] = {}
+
+
+def register_model_builder(type: str) -> Callable:  # noqa: A002 - parity name
+    """Decorator registering a model factory under an estimator type.
+
+    Mirrors ``gordo_components.model.register.register_model_builder``::
+
+        @register_model_builder(type="AutoEncoder")
+        def my_factory(n_features: int, **kwargs): ...
+
+    The estimator looks the factory up via its ``kind`` parameter.
+    """
+
+    def decorator(fn: Callable) -> Callable:
+        FACTORY_REGISTRY.setdefault(type, {})[fn.__name__] = fn
+        return fn
+
+    return decorator
+
+
+def lookup_factory(model_type: str, kind: str) -> Callable:
+    """Resolve a registered factory; raise with the available names."""
+    # Strict per-type resolution, like the reference: a factory registered
+    # for another estimator type expects different input ranks and would fail
+    # obscurely inside the jitted loss — better to error here with the list.
+    by_type = FACTORY_REGISTRY.get(model_type, {})
+    if kind in by_type:
+        return by_type[kind]
+    raise ValueError(
+        f"Unknown model factory kind={kind!r} for type={model_type!r}; "
+        f"available: {sorted(by_type)}"
+    )
+
+
+# Dotted-path aliases: reference-era paths -> gordo_tpu paths.  Consulted by
+# the definition interpreter before importing, so reference YAMLs run as-is.
+ALIASES: Dict[str, str] = {
+    # sklearn containers -> functional TPU-native pipeline containers
+    "sklearn.pipeline.Pipeline": "gordo_tpu.pipeline.Pipeline",
+    "sklearn.pipeline.FeatureUnion": "gordo_tpu.pipeline.FeatureUnion",
+    "sklearn.compose.TransformedTargetRegressor": "gordo_tpu.pipeline.TransformedTargetRegressor",
+    "sklearn.multioutput.MultiOutputRegressor": "gordo_tpu.pipeline.MultiOutputRegressor",
+    # sklearn transformers -> jax functional scalers
+    "sklearn.preprocessing.MinMaxScaler": "gordo_tpu.ops.scalers.MinMaxScaler",
+    "sklearn.preprocessing.data.MinMaxScaler": "gordo_tpu.ops.scalers.MinMaxScaler",
+    "sklearn.preprocessing.StandardScaler": "gordo_tpu.ops.scalers.StandardScaler",
+    "sklearn.preprocessing.RobustScaler": "gordo_tpu.ops.scalers.RobustScaler",
+    "sklearn.preprocessing.QuantileTransformer": "gordo_tpu.ops.scalers.QuantileTransformer",
+    "sklearn.preprocessing.FunctionTransformer": "gordo_tpu.ops.scalers.FunctionTransformer",
+    "sklearn.impute.SimpleImputer": "gordo_tpu.ops.scalers.SimpleImputer",
+    "sklearn.decomposition.PCA": "gordo_tpu.ops.scalers.PCA",
+    # reference estimators -> TPU estimators
+    "gordo_components.model.models.KerasAutoEncoder": "gordo_tpu.models.estimator.AutoEncoder",
+    "gordo_components.model.models.KerasLSTMAutoEncoder": "gordo_tpu.models.estimator.LSTMAutoEncoder",
+    "gordo_components.model.models.KerasLSTMForecast": "gordo_tpu.models.estimator.LSTMForecast",
+    "gordo_components.model.models.KerasRawModelRegressor": "gordo_tpu.models.estimator.AutoEncoder",
+    # anomaly detectors
+    "gordo_components.model.anomaly.diff.DiffBasedAnomalyDetector": "gordo_tpu.anomaly.diff.DiffBasedAnomalyDetector",
+    # transformer funcs usable inside FunctionTransformer
+    "gordo_components.model.transformer_funcs.general.multiplier": "gordo_tpu.ops.transformer_funcs.multiplier",
+    # datasets / providers (reference dataset configs name these types)
+    "gordo_components.dataset.datasets.TimeSeriesDataset": "gordo_tpu.dataset.datasets.TimeSeriesDataset",
+    "gordo_components.dataset.datasets.RandomDataset": "gordo_tpu.dataset.datasets.RandomDataset",
+    "gordo_components.dataset.data_provider.providers.RandomDataProvider": "gordo_tpu.dataset.data_provider.providers.RandomDataProvider",
+    "gordo_components.dataset.data_provider.providers.InfluxDataProvider": "gordo_tpu.dataset.data_provider.providers.InfluxDataProvider",
+    "gordo_components.dataset.data_provider.providers.DataLakeProvider": "gordo_tpu.dataset.data_provider.providers.DataLakeProvider",
+}
+
+# Import allowlist for dotted paths in definitions (safety: the definition
+# dict is the config-driven extension point; restrict what it may import).
+ALLOWED_IMPORT_PREFIXES = (
+    "gordo_tpu.",
+    "gordo_components.",  # rewritten through ALIASES above
+    "sklearn.",           # rewritten through ALIASES above
+    "numpy.",
+    "optax.",
+)
+
+
+def resolve_alias(dotted: str) -> str:
+    return ALIASES.get(dotted, dotted)

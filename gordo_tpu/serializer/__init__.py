@@ -1,0 +1,82 @@
+"""Model artifact serialization.
+
+Reference equivalent: ``gordo_components/serializer/__init__.py`` —
+``dump(model, dir, metadata=...)`` / ``load(dir)`` / ``load_metadata(dir)``.
+
+The reference walks the sklearn pipeline into nested ``n_step=..._class=...``
+directories of pickles with Keras weights riding on HDF5 ``__getstate__``.
+Here the artifact layout is flat and TPU-native:
+
+``````
+<dir>/
+  metadata.json      build + dataset + CV metadata (primary observability)
+  definition.yaml    into_definition() of the model (config round-trip)
+  model.pkl          pickled component graph; array leaves are host numpy
+``````
+
+Components implement ``__getstate__``/``__setstate__`` so jax arrays are
+pulled to host numpy before pickling (see ``gordo_tpu.utils.trees.to_host``),
+keeping artifacts device-independent: a model built on TPU loads on CPU and
+vice versa.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+from typing import Any, Optional
+
+import yaml
+
+from gordo_tpu.serializer.definition import (  # noqa: F401
+    from_definition,
+    into_definition,
+    pipeline_from_definition,
+    pipeline_into_definition,
+)
+
+METADATA_FILE = "metadata.json"
+DEFINITION_FILE = "definition.yaml"
+MODEL_FILE = "model.pkl"
+
+
+def dump(model: Any, dest_dir: str, metadata: Optional[dict] = None) -> str:
+    """Serialize ``model`` (+ metadata) into ``dest_dir``; returns the dir."""
+    os.makedirs(dest_dir, exist_ok=True)
+    with open(os.path.join(dest_dir, MODEL_FILE), "wb") as f:
+        pickle.dump(model, f)
+    try:
+        definition = into_definition(model)
+        with open(os.path.join(dest_dir, DEFINITION_FILE), "w") as f:
+            yaml.safe_dump(definition, f, sort_keys=False)
+    except Exception:  # definition round-trip is best-effort convenience
+        pass
+    if metadata is not None:
+        with open(os.path.join(dest_dir, METADATA_FILE), "w") as f:
+            json.dump(metadata, f, indent=2, default=str)
+    return dest_dir
+
+
+def load(source_dir: str) -> Any:
+    """Load a model serialized by :func:`dump`."""
+    with open(os.path.join(source_dir, MODEL_FILE), "rb") as f:
+        return pickle.load(f)
+
+
+def load_metadata(source_dir: str) -> dict:
+    """Load the metadata JSON written next to the model artifact."""
+    path = os.path.join(source_dir, METADATA_FILE)
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        return json.load(f)
+
+
+def dumps(model: Any) -> bytes:
+    """In-memory serialization (reference: ``serializer.dumps``)."""
+    return pickle.dumps(model)
+
+
+def loads(data: bytes) -> Any:
+    return pickle.loads(data)

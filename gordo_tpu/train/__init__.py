@@ -1,0 +1,9 @@
+from gordo_tpu.train.fit import (  # noqa: F401
+    LOSSES,
+    OPTIMIZERS,
+    TrainConfig,
+    fit as fit_model,
+    init_params,
+    make_loss_fn,
+    make_optimizer,
+)

@@ -1,0 +1,2 @@
+from gordo_tpu.utils.args import capture_args  # noqa: F401
+from gordo_tpu.utils.trees import to_host  # noqa: F401

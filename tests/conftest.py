@@ -1,0 +1,47 @@
+"""Test harness config.
+
+Tests run on CPU with 8 virtual XLA devices so multi-chip sharding
+(`("models", "data")` meshes, collectives) is exercised without TPU hardware
+— the same simulation strategy the driver's `dryrun_multichip` uses.
+"""
+
+import os
+
+# Must be set before jax backend initialization.
+os.environ["JAX_PLATFORMS"] = "cpu"
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# Deregister non-CPU PJRT plugins (e.g. the axon TPU tunnel) so backend
+# discovery can't block on remote hardware during the test run.  Tests are
+# hermetic CPU-only; TPU execution is covered by bench.py / the driver.
+import jax._src.xla_bridge as _xb  # noqa: E402
+
+# Only the tunnel-backed plugin is removed; the stock 'tpu' entry stays so
+# platform names remain known to jax's lowering registries.
+_xb._backend_factories.pop("axon", None)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def sine_tags():
+    """Synthetic multi-tag sine matrix (the RandomDataProvider-style backbone
+    of integration tests, per SURVEY.md §5)."""
+    rng = np.random.default_rng(42)
+    n, f, latents = 600, 6, 2
+    t = np.arange(n)[:, None]
+    phases = rng.uniform(0, 2 * np.pi, size=(1, latents))
+    freqs = rng.uniform(0.01, 0.1, size=(1, latents))
+    Z = np.sin(freqs * t + phases)  # shared latent signals
+    mix = rng.uniform(-1, 1, size=(latents, f))
+    X = Z @ mix + 0.05 * rng.standard_normal((n, f))
+    return X.astype(np.float32)

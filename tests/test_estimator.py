@@ -1,0 +1,97 @@
+"""Estimator contract tests (reference strategy: tiny epochs on small random
+X; assert the sklearn contract and score behavior, not accuracy)."""
+
+import numpy as np
+import pytest
+
+from gordo_tpu.models.estimator import AutoEncoder, LSTMAutoEncoder, LSTMForecast
+from gordo_tpu.ops.metrics import (
+    explained_variance_score,
+    mean_absolute_error,
+    mean_squared_error,
+    r2_score,
+)
+
+
+def test_metrics_against_sklearn():
+    import sklearn.metrics as skm
+
+    rng = np.random.default_rng(3)
+    y = rng.standard_normal((50, 4)).astype(np.float32)
+    p = y + 0.1 * rng.standard_normal((50, 4)).astype(np.float32)
+    np.testing.assert_allclose(
+        float(explained_variance_score(y, p)),
+        skm.explained_variance_score(y, p), atol=1e-5)
+    np.testing.assert_allclose(float(r2_score(y, p)), skm.r2_score(y, p), atol=1e-5)
+    np.testing.assert_allclose(
+        float(mean_squared_error(y, p)), skm.mean_squared_error(y, p), atol=1e-6)
+    np.testing.assert_allclose(
+        float(mean_absolute_error(y, p)), skm.mean_absolute_error(y, p), atol=1e-6)
+
+
+def test_autoencoder_fit_predict_score(sine_tags):
+    model = AutoEncoder(kind="feedforward_hourglass", epochs=30, batch_size=128,
+                        learning_rate=1e-2)
+    model.fit(sine_tags)
+    pred = model.predict(sine_tags)
+    assert pred.shape == sine_tags.shape
+    score = model.score(sine_tags)
+    assert score > 0.5  # sine reconstruction should be decent after 30 epochs
+    # loss decreased over training
+    hist = model.history_
+    assert hist[-1] < hist[0]
+
+
+def test_autoencoder_metadata(sine_tags):
+    model = AutoEncoder(epochs=2)
+    model.fit(sine_tags)
+    meta = model.get_metadata()
+    assert meta["kind"] == "feedforward_hourglass"
+    assert meta["num_params"] > 0
+    assert len(meta["history"]["loss"]) == 2
+    assert meta["fit_seconds"] > 0
+
+
+def test_autoencoder_clone_unfitted(sine_tags):
+    model = AutoEncoder(kind="feedforward_symmetric", dims=[8, 4], epochs=1)
+    clone = model.clone()
+    assert clone.kind == model.kind
+    assert clone.params_ is None
+    with pytest.raises(RuntimeError):
+        clone.predict(sine_tags)
+
+
+def test_deterministic_given_seed(sine_tags):
+    a = AutoEncoder(epochs=3, seed=5).fit(sine_tags).predict(sine_tags)
+    b = AutoEncoder(epochs=3, seed=5).fit(sine_tags).predict(sine_tags)
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_lstm_autoencoder_offset_and_shapes(sine_tags):
+    L = 6
+    model = LSTMAutoEncoder(
+        kind="lstm_hourglass", lookback_window=L, epochs=2, batch_size=64,
+        encoding_layers=1, compression_factor=0.5,
+    )
+    model.fit(sine_tags)
+    pred = model.predict(sine_tags)
+    assert model.offset == L - 1
+    assert pred.shape == (sine_tags.shape[0] - L + 1, sine_tags.shape[1])
+
+
+def test_lstm_forecast_offset_and_shapes(sine_tags):
+    L = 6
+    model = LSTMForecast(lookback_window=L, epochs=2, batch_size=64,
+                         encoding_layers=1)
+    model.fit(sine_tags)
+    pred = model.predict(sine_tags)
+    assert model.offset == L
+    assert pred.shape == (sine_tags.shape[0] - L, sine_tags.shape[1])
+    assert np.isfinite(model.score(sine_tags))
+
+
+def test_explicit_targets_supported(sine_tags):
+    y = sine_tags[:, :2]
+    model = AutoEncoder(epochs=2)
+    model.fit(sine_tags, y)
+    assert model.predict(sine_tags).shape == y.shape

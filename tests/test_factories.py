@@ -1,0 +1,89 @@
+"""Factory registry + hourglass math tests (reference test strategy:
+layer counts/dims vs config, registry lookups)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gordo_tpu.models.factories import (
+    feedforward_hourglass,
+    feedforward_model,
+    feedforward_symmetric,
+    hourglass_calc_dims,
+    lstm_hourglass,
+    lstm_model,
+)
+from gordo_tpu.registry import FACTORY_REGISTRY, lookup_factory
+
+
+def test_hourglass_dims_taper():
+    dims = hourglass_calc_dims(0.5, 3, 12)
+    assert dims == [10, 8, 6]
+    assert hourglass_calc_dims(0.0, 2, 4)[-1] == 1  # floor at 1
+    assert hourglass_calc_dims(1.0, 3, 10) == [10, 10, 10]
+
+
+def test_hourglass_dims_validation():
+    with pytest.raises(ValueError):
+        hourglass_calc_dims(1.5, 3, 10)
+    with pytest.raises(ValueError):
+        hourglass_calc_dims(0.5, 0, 10)
+
+
+def test_registry_contains_all_factories():
+    assert "feedforward_hourglass" in FACTORY_REGISTRY["AutoEncoder"]
+    assert "feedforward_model" in FACTORY_REGISTRY["AutoEncoder"]
+    assert "feedforward_symmetric" in FACTORY_REGISTRY["AutoEncoder"]
+    assert "lstm_hourglass" in FACTORY_REGISTRY["LSTMAutoEncoder"]
+    assert lookup_factory("AutoEncoder", "feedforward_hourglass") is feedforward_hourglass
+
+
+def test_lookup_unknown_kind_raises_with_available():
+    with pytest.raises(ValueError, match="feedforward_hourglass"):
+        lookup_factory("AutoEncoder", "not_a_factory")
+
+
+def test_feedforward_module_shapes():
+    mod = feedforward_model(6, 6, encoding_dim=(8, 4), decoding_dim=(4, 8))
+    params = mod.init(jax.random.PRNGKey(0), jnp.zeros((2, 6)))["params"]
+    layer_names = sorted(params.keys())
+    assert layer_names == ["dense_0", "dense_1", "dense_2", "dense_3", "out"]
+    out = mod.apply({"params": params}, jnp.zeros((5, 6)))
+    assert out.shape == (5, 6)
+    assert out.dtype == jnp.float32
+
+
+def test_feedforward_hourglass_layer_dims():
+    mod = feedforward_hourglass(12, encoding_layers=3, compression_factor=0.5)
+    params = mod.init(jax.random.PRNGKey(0), jnp.zeros((1, 12)))["params"]
+    # encoder 10,8,6 then decoder 6,8,10 then out 12
+    dims = [params[f"dense_{i}"]["kernel"].shape[1] for i in range(6)]
+    assert dims == [10, 8, 6, 6, 8, 10]
+    assert params["out"]["kernel"].shape == (10, 12)
+
+
+def test_symmetric_rejects_empty_dims():
+    with pytest.raises(ValueError):
+        feedforward_symmetric(4, dims=())
+
+
+def test_lstm_module_shapes():
+    mod = lstm_model(5, 5, lookback_window=8, encoding_dim=(16,), decoding_dim=(16,))
+    x = jnp.zeros((3, 8, 5))
+    params = mod.init(jax.random.PRNGKey(0), x)["params"]
+    out = mod.apply({"params": params}, x)
+    assert out.shape == (3, 5)
+
+
+def test_lstm_hourglass_builds():
+    mod = lstm_hourglass(6, lookback_window=4, encoding_layers=2, compression_factor=0.5)
+    x = jnp.zeros((2, 4, 6))
+    params = mod.init(jax.random.PRNGKey(0), x)["params"]
+    assert mod.apply({"params": params}, x).shape == (2, 6)
+
+
+def test_unknown_activation_raises():
+    with pytest.raises(ValueError, match="Unknown activation"):
+        mod = feedforward_model(4, encoding_dim=(4,), encoding_func=["nope"], decoding_dim=(4,))
+        mod.init(jax.random.PRNGKey(0), jnp.zeros((1, 4)))

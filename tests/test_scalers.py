@@ -1,0 +1,109 @@
+"""Functional scaler tests — behavior parity with the sklearn transformers
+the reference composes (values checked against analytic expectations)."""
+
+import numpy as np
+import pytest
+
+from gordo_tpu.ops import scalers
+
+
+@pytest.fixture
+def X():
+    rng = np.random.default_rng(7)
+    return (rng.standard_normal((200, 5)) * np.array([1, 10, 0.1, 5, 2])
+            + np.array([0, 100, -3, 4, 0.5])).astype(np.float32)
+
+
+def test_minmax_range_and_inverse(X):
+    sc = scalers.MinMaxScaler()
+    Xt = sc.fit_transform(X)
+    assert Xt.min() >= -1e-6 and Xt.max() <= 1 + 1e-6
+    np.testing.assert_allclose(sc.inverse_transform(Xt), X, rtol=1e-4, atol=1e-4)
+
+
+def test_minmax_custom_range(X):
+    sc = scalers.MinMaxScaler(feature_range=(-1, 1))
+    Xt = sc.fit_transform(X)
+    np.testing.assert_allclose(Xt.min(axis=0), -1, atol=1e-5)
+    np.testing.assert_allclose(Xt.max(axis=0), 1, atol=1e-5)
+
+
+def test_standard_scaler(X):
+    sc = scalers.StandardScaler()
+    Xt = sc.fit_transform(X)
+    np.testing.assert_allclose(Xt.mean(axis=0), 0, atol=1e-4)
+    np.testing.assert_allclose(Xt.std(axis=0), 1, atol=1e-3)
+    np.testing.assert_allclose(sc.inverse_transform(Xt), X, rtol=1e-3, atol=1e-3)
+
+
+def test_robust_scaler(X):
+    sc = scalers.RobustScaler()
+    Xt = sc.fit_transform(X)
+    np.testing.assert_allclose(np.median(Xt, axis=0), 0, atol=1e-4)
+    np.testing.assert_allclose(sc.inverse_transform(Xt), X, rtol=1e-3, atol=1e-3)
+
+
+def test_quantile_transformer_uniform(X):
+    qt = scalers.QuantileTransformer(n_quantiles=50)
+    Xt = qt.fit_transform(X)
+    assert Xt.min() >= 0 and Xt.max() <= 1
+    back = qt.inverse_transform(Xt)
+    np.testing.assert_allclose(back, X, rtol=0.1, atol=0.5)
+
+
+def test_simple_imputer_mean():
+    X = np.array([[1.0, np.nan], [3.0, 4.0], [np.nan, 8.0]], dtype=np.float32)
+    imp = scalers.SimpleImputer(strategy="mean")
+    Xt = imp.fit_transform(X)
+    assert not np.isnan(Xt).any()
+    np.testing.assert_allclose(Xt[2, 0], 2.0, atol=1e-5)
+    np.testing.assert_allclose(Xt[0, 1], 6.0, atol=1e-5)
+
+
+def test_pca_roundtrip(X):
+    pca = scalers.PCA()
+    Xt = pca.fit_transform(X)
+    np.testing.assert_allclose(pca.inverse_transform(Xt), X, rtol=1e-2, atol=1e-2)
+
+
+def test_function_transformer_multiplier():
+    from gordo_tpu.ops.transformer_funcs import multiplier
+
+    ft = scalers.FunctionTransformer(func=multiplier, kw_args={"factor": 2.0})
+    X = np.ones((3, 2), dtype=np.float32)
+    np.testing.assert_allclose(ft.fit_transform(X), 2 * X)
+    # definition round-trip stores dotted path
+    params = ft.get_params()
+    assert params["func"] == "gordo_tpu.ops.transformer_funcs.multiplier"
+
+
+def test_scaler_nan_safety():
+    X = np.array([[1.0, np.nan], [3.0, 4.0], [2.0, 8.0]], dtype=np.float32)
+    sc = scalers.MinMaxScaler().fit(X)
+    assert np.isfinite(sc.stats_["scale"]).all()
+    assert np.isfinite(sc.stats_["offset"]).all()
+
+
+def test_pure_apply_matches_stateful_transform(X):
+    """The jit-fold contract: apply(stats, X) == transform(X) including
+    non-default constructor options."""
+    for sc in [
+        scalers.MinMaxScaler(feature_range=(-2, 3)),
+        scalers.StandardScaler(with_mean=False),
+        scalers.RobustScaler(with_centering=False),
+    ]:
+        sc.fit(X)
+        np.testing.assert_allclose(
+            np.asarray(type(sc).apply(sc.stats_, X)), sc.transform(X),
+            rtol=1e-5, atol=1e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(type(sc).invert(sc.stats_, sc.transform(X))), X,
+            rtol=1e-3, atol=1e-3,
+        )
+
+
+def test_not_invertible_names_class():
+    imp = scalers.SimpleImputer().fit(np.ones((3, 2), dtype=np.float32))
+    out = imp.inverse_transform(np.ones((3, 2), dtype=np.float32))
+    assert out.shape == (3, 2)  # imputer inverse is identity, not an error
