@@ -1,0 +1,2 @@
+from gordo_tpu.anomaly.base import AnomalyDetectorBase  # noqa: F401
+from gordo_tpu.anomaly.diff import DiffBasedAnomalyDetector  # noqa: F401

@@ -1,0 +1,22 @@
+"""Anomaly-detector contract.
+
+Reference equivalent: ``gordo_components/model/anomaly/base.py`` —
+``AnomalyDetectorBase`` adds ``.anomaly(X, y) -> pd.DataFrame`` to the
+estimator contract; the server's ``/anomaly/prediction`` route requires it.
+"""
+
+from __future__ import annotations
+
+import abc
+
+import pandas as pd
+
+from gordo_tpu.models.base import GordoBase
+
+
+class AnomalyDetectorBase(GordoBase, abc.ABC):
+    @abc.abstractmethod
+    def anomaly(self, X, y=None, frequency=None) -> pd.DataFrame:
+        """Score ``X`` (optionally against targets ``y``) into the canonical
+        anomaly frame (model-input / model-output / tag-anomaly-scores /
+        total-anomaly-score [+ thresholds])."""

@@ -1,0 +1,197 @@
+"""Diff-based anomaly detection.
+
+Reference equivalent: ``gordo_components/model/anomaly/diff.py::
+DiffBasedAnomalyDetector``:
+
+- wraps a base estimator (typically ``Pipeline[scaler, AutoEncoder]``),
+- ``cross_validate`` produces out-of-fold predictions and derives **per-tag
+  thresholds and an aggregate threshold** from fold-wise error statistics
+  (smoothed scaled absolute error maxima, averaged across folds),
+- ``anomaly`` returns a frame with per-tag ``tag-anomaly-scores``, a
+  ``total-anomaly-score`` (L2 across tags), thresholds, and model in/out.
+
+TPU-native: the entire scoring path — scale targets, scale predictions,
+absolute diff, L2 aggregate — is a single jitted pure function of
+``(scaler_stats, y, y_pred)`` (:func:`scores_fn`), reused by the serving
+scorer; threshold derivation applies the same function per fold.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from gordo_tpu.anomaly.base import AnomalyDetectorBase
+from gordo_tpu.models.utils import make_base_dataframe
+from gordo_tpu.ops.scalers import BaseTransform, MinMaxScaler
+from gordo_tpu.train.cv import cross_validate
+from gordo_tpu.utils.args import ParamsMixin, capture_args
+from gordo_tpu.utils.trees import to_host
+
+#: smoothing window (samples) applied to error series before taking fold
+#: maxima — keeps single-sample spikes from setting thresholds (reference
+#: smooths with a short rolling window the same way).
+SMOOTHING_WINDOW = 6
+
+
+@partial(jax.jit, static_argnames=("scaler_cls",))
+def scores_fn(scaler_cls, scaler_stats, y, y_pred):
+    """Pure scoring: per-tag scaled |diff| and total L2 score."""
+    y_s = scaler_cls.apply(scaler_stats, y)
+    p_s = scaler_cls.apply(scaler_stats, y_pred)
+    tag_scores = jnp.abs(p_s - y_s)
+    total = jnp.linalg.norm(tag_scores, axis=1)
+    return tag_scores, total
+
+
+def _rolling_min_max(arr: np.ndarray, window: int) -> np.ndarray:
+    """max over time of the rolling min — a spike-robust maximum."""
+    s = pd.DataFrame(arr).rolling(window, min_periods=1).min()
+    return s.max(axis=0).to_numpy()
+
+
+class DiffBasedAnomalyDetector(ParamsMixin, AnomalyDetectorBase):
+    @capture_args
+    def __init__(
+        self,
+        base_estimator: Any = None,
+        scaler: Optional[BaseTransform] = None,
+        require_thresholds: bool = True,
+        window: Optional[int] = None,
+    ):
+        if base_estimator is None:
+            from gordo_tpu.models.estimator import AutoEncoder
+            from gordo_tpu.pipeline import Pipeline
+
+            base_estimator = Pipeline([MinMaxScaler(), AutoEncoder()])
+        self.base_estimator = base_estimator
+        self.scaler = scaler if scaler is not None else MinMaxScaler()
+        self.require_thresholds = require_thresholds
+        self.window = window
+        self.feature_thresholds_: Optional[np.ndarray] = None
+        self.aggregate_threshold_: Optional[float] = None
+        self.cv_metadata_: Dict[str, Any] = {}
+
+    @property
+    def offset(self) -> int:
+        return getattr(self.base_estimator, "offset", 0)
+
+    # -- estimator surface ---------------------------------------------------
+    def fit(self, X, y=None, **kwargs):
+        X_arr = np.asarray(X, dtype=np.float32)
+        y_arr = X_arr if y is None else np.asarray(y, dtype=np.float32)
+        self.scaler.fit(y_arr)
+        self.base_estimator.fit(X_arr, y_arr, **kwargs)
+        return self
+
+    def predict(self, X):
+        return self.base_estimator.predict(X)
+
+    def score(self, X, y=None, sample_weight=None):
+        return self.base_estimator.score(X, y, sample_weight)
+
+    # -- cross-validation + thresholds ---------------------------------------
+    def cross_validate(self, X, y=None, cv=None) -> Dict[str, Any]:
+        """Fold-wise fit/predict; derives thresholds from out-of-fold errors.
+
+        Threshold semantics (reference parity): per fold, the per-tag scaled
+        absolute error is smoothed (rolling-min over SMOOTHING_WINDOW) and
+        its maximum taken; fold maxima are averaged into
+        ``feature_thresholds_``; the same on the L2 total gives
+        ``aggregate_threshold_``.
+        """
+        X_arr = np.asarray(X, dtype=np.float32)
+        y_arr = X_arr if y is None else np.asarray(y, dtype=np.float32)
+        self.scaler.fit(y_arr)
+        stats = to_host(self.scaler.stats_)
+        scaler_cls = type(self.scaler)
+
+        results = cross_validate(self.base_estimator, X_arr, y_arr, cv=cv)
+
+        fold_tag_maxima = []
+        fold_total_maxima = []
+        for _, y_true, y_pred in results["predictions"]:
+            tag_scores, total = scores_fn(
+                scaler_cls, stats, jnp.asarray(y_true), jnp.asarray(y_pred)
+            )
+            fold_tag_maxima.append(_rolling_min_max(np.asarray(tag_scores), SMOOTHING_WINDOW))
+            fold_total_maxima.append(
+                float(_rolling_min_max(np.asarray(total)[:, None], SMOOTHING_WINDOW)[0])
+            )
+
+        self.feature_thresholds_ = np.mean(fold_tag_maxima, axis=0)
+        self.aggregate_threshold_ = float(np.mean(fold_total_maxima))
+        self.cv_metadata_ = {
+            "scores": results["scores"],
+            "feature_thresholds": [float(v) for v in self.feature_thresholds_],
+            "aggregate_threshold": self.aggregate_threshold_,
+        }
+        return results
+
+    # -- anomaly scoring -----------------------------------------------------
+    def anomaly(self, X, y=None, frequency=None) -> pd.DataFrame:
+        index = X.index if isinstance(X, pd.DataFrame) else None
+        tags = list(X.columns) if isinstance(X, pd.DataFrame) else None
+        X_arr = np.asarray(X, dtype=np.float32)
+        y_arr = X_arr if y is None else np.asarray(y, dtype=np.float32)
+
+        pred = np.asarray(self.predict(X_arr))
+        offset = self.offset
+        y_aligned = y_arr[offset:]
+
+        stats = to_host(self.scaler.stats_)
+        tag_scores, total = scores_fn(
+            type(self.scaler), stats, jnp.asarray(y_aligned), jnp.asarray(pred)
+        )
+        tag_scores = np.asarray(tag_scores)
+        total = np.asarray(total)
+
+        if self.window:
+            tag_scores = (
+                pd.DataFrame(tag_scores).rolling(self.window, min_periods=1).median().to_numpy()
+            )
+            total = (
+                pd.Series(total).rolling(self.window, min_periods=1).median().to_numpy()
+            )
+
+        tags = tags or [f"sensor_{i}" for i in range(X_arr.shape[1])]
+        frame = make_base_dataframe(
+            tags, X_arr, pred, index=index, frequency=frequency
+        )
+        n = len(frame)
+        for i, tag in enumerate(tags[: tag_scores.shape[1]]):
+            frame[("tag-anomaly-scores", str(tag))] = tag_scores[-n:, i]
+        frame[("total-anomaly-score", "")] = total[-n:]
+
+        if self.feature_thresholds_ is not None:
+            for i, tag in enumerate(tags[: len(self.feature_thresholds_)]):
+                frame[("tag-anomaly-thresholds", str(tag))] = self.feature_thresholds_[i]
+            frame[("total-anomaly-threshold", "")] = self.aggregate_threshold_
+            with np.errstate(divide="ignore", invalid="ignore"):
+                confidence = total[-n:] / max(self.aggregate_threshold_, 1e-12)
+            frame[("anomaly-confidence", "")] = confidence
+        elif self.require_thresholds:
+            raise AttributeError(
+                "DiffBasedAnomalyDetector.anomaly called with "
+                "require_thresholds=True but cross_validate() has not been "
+                "run to derive thresholds"
+            )
+        return frame
+
+    # -- metadata ------------------------------------------------------------
+    def get_metadata(self) -> Dict[str, Any]:
+        meta = {
+            "anomaly_detector": type(self).__name__,
+            "scaler": type(self.scaler).__name__,
+            "require_thresholds": self.require_thresholds,
+        }
+        if self.cv_metadata_:
+            meta["cross_validation"] = self.cv_metadata_
+        if hasattr(self.base_estimator, "get_metadata"):
+            meta["base_estimator"] = self.base_estimator.get_metadata()
+        return meta

@@ -1,0 +1,5 @@
+from gordo_tpu.builder.build_model import (  # noqa: F401
+    build_model,
+    calculate_model_key,
+    provide_saved_model,
+)

@@ -1,0 +1,154 @@
+"""Training driver.
+
+Reference equivalent: ``gordo_components/builder/build_model.py`` —
+``build_model`` (dataset → model construction → CV → final fit → metadata)
+and ``provide_saved_model`` (config-hash cache over a disk registry +
+``serializer.dump``).
+
+Call stack parity with SURVEY.md §4.1; the hot loop inside is the jitted
+XLA fit instead of per-pod Keras.  Fleet-scale builds (thousands of
+machines as one sharded XLA program) layer on top in
+``gordo_tpu.parallel.fleet`` — this module is the single-machine path and
+the metadata/cache contract both share.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+import gordo_tpu
+from gordo_tpu import serializer
+from gordo_tpu.dataset.base import GordoBaseDataset
+from gordo_tpu.utils import disk_registry
+
+logger = logging.getLogger(__name__)
+
+
+def calculate_model_key(
+    name: str,
+    model_config: dict,
+    data_config: dict,
+    metadata: Optional[dict] = None,
+) -> str:
+    """Deterministic cache key: md5 over (version, name, configs, metadata)
+    (reference: ``_calculate_model_key``).  Any config or framework-version
+    change produces a new key → rebuild."""
+    payload = json.dumps(
+        {
+            "gordo_tpu_version": gordo_tpu.__version__,
+            "name": name,
+            "model_config": model_config,
+            "data_config": data_config,
+            "user_metadata": metadata or {},
+        },
+        sort_keys=True,
+        default=str,
+    )
+    return hashlib.md5(payload.encode()).hexdigest()
+
+
+def build_model(
+    name: str,
+    model_config: dict,
+    data_config: dict,
+    metadata: Optional[dict] = None,
+    evaluation_config: Optional[dict] = None,
+) -> Tuple[Any, Dict[str, Any]]:
+    """Build one machine's model: data → model → (CV) → fit → metadata."""
+    metadata = metadata or {}
+    evaluation_config = evaluation_config or {"cv_mode": "full_build"}
+    t_start = time.time()
+
+    dataset = GordoBaseDataset.from_dict(dict(data_config))
+    X, y = dataset.get_data()
+    t_data = time.time()
+
+    model = serializer.from_definition(dict(model_config))
+
+    X_arr = np.asarray(X, dtype=np.float32)
+    y_arr = np.asarray(y, dtype=np.float32)
+
+    cv_meta: Dict[str, Any] = {}
+    cv_duration = 0.0
+    cv_mode = evaluation_config.get("cv_mode", "full_build")
+    if cv_mode != "build_only" and hasattr(model, "cross_validate"):
+        t0 = time.time()
+        model.cross_validate(X_arr, y_arr, cv=evaluation_config.get("cv"))
+        cv_duration = time.time() - t0
+        cv_meta = getattr(model, "cv_metadata_", {})
+
+    if cv_mode == "cross_val_only":
+        fit_duration = 0.0
+    else:
+        t0 = time.time()
+        model.fit(X_arr, y_arr)
+        fit_duration = time.time() - t0
+
+    build_metadata = {
+        "name": name,
+        "gordo_tpu_version": gordo_tpu.__version__,
+        "checksum": calculate_model_key(name, model_config, data_config, metadata),
+        "dataset": dataset.get_metadata(),
+        "model": {
+            "model_config": model_config,
+            "model_creation_date": time.strftime("%Y-%m-%d %H:%M:%S%z"),
+            "data_query_duration_sec": t_data - t_start,
+            "cross_validation_duration_sec": cv_duration,
+            "model_builder_duration_sec": fit_duration,
+            **(
+                {"cross_validation": cv_meta}
+                if cv_meta
+                else {}
+            ),
+            **(
+                model.get_metadata() if hasattr(model, "get_metadata") else {}
+            ),
+        },
+        "user_defined": metadata,
+    }
+    return model, build_metadata
+
+
+def provide_saved_model(
+    name: str,
+    model_config: dict,
+    data_config: dict,
+    metadata: Optional[dict] = None,
+    output_dir: str = "./models",
+    model_register_dir: Optional[str] = None,
+    replace_cache: bool = False,
+    evaluation_config: Optional[dict] = None,
+) -> str:
+    """Cache-aware build: return an artifact dir, training only on miss
+    (reference: ``provide_saved_model``)."""
+    cache_key = calculate_model_key(name, model_config, data_config, metadata)
+
+    if model_register_dir and not replace_cache:
+        cached = disk_registry.get_value(model_register_dir, cache_key)
+        if cached and os.path.exists(os.path.join(cached, serializer.MODEL_FILE)):
+            logger.info("Cache hit for %s (key %s): %s", name, cache_key, cached)
+            return cached
+        if cached:
+            logger.warning(
+                "Registry entry for %s points at missing artifact %s; rebuilding",
+                name, cached,
+            )
+
+    model, build_metadata = build_model(
+        name, model_config, data_config, metadata, evaluation_config
+    )
+    dest = os.path.join(output_dir, name) if os.path.basename(
+        os.path.normpath(output_dir)
+    ) != name else output_dir
+    serializer.dump(model, dest, metadata=build_metadata)
+
+    if model_register_dir:
+        disk_registry.write_key(model_register_dir, cache_key, os.path.abspath(dest))
+    return dest

@@ -1,0 +1,7 @@
+from gordo_tpu.dataset.data_provider.base import GordoBaseDataProvider  # noqa: F401
+from gordo_tpu.dataset.data_provider.providers import (  # noqa: F401
+    DataLakeProvider,
+    FileSystemTagProvider,
+    InfluxDataProvider,
+    RandomDataProvider,
+)

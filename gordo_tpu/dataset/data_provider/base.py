@@ -1,0 +1,62 @@
+"""Data-provider contract.
+
+Reference equivalent: ``gordo_components/dataset/data_provider/base.py`` —
+``GordoBaseDataProvider`` with the ``load_series`` generator contract,
+``can_handle_tag``, and ``capture_args`` so providers round-trip through
+metadata JSON (``to_dict``/``from_dict``).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Iterable, List
+
+import pandas as pd
+
+from gordo_tpu.utils.args import ParamsMixin
+
+
+class GordoBaseDataProvider(ParamsMixin, abc.ABC):
+    @abc.abstractmethod
+    def load_series(
+        self,
+        from_ts: pd.Timestamp,
+        to_ts: pd.Timestamp,
+        tag_list: List,
+        dry_run: bool = False,
+    ) -> Iterable[pd.Series]:
+        """Yield one timezone-aware, time-indexed series per requested tag,
+        named after the tag."""
+
+    @abc.abstractmethod
+    def can_handle_tag(self, tag) -> bool:
+        ...
+
+    def to_dict(self) -> dict:
+        """Self-describing config (reference: ``capture_args`` round-trip)."""
+        cls = type(self)
+        return {
+            "type": f"{cls.__module__}.{cls.__qualname__}",
+            **{
+                k: v
+                for k, v in self.get_params().items()
+                if isinstance(v, (str, int, float, bool, list, dict, type(None)))
+            },
+        }
+
+    @classmethod
+    def from_dict(cls, config: dict) -> "GordoBaseDataProvider":
+        from gordo_tpu.serializer.definition import import_locate
+
+        config = dict(config)
+        type_path = config.pop("type", None)
+        if type_path is None:
+            from gordo_tpu.dataset.data_provider.providers import RandomDataProvider
+
+            return RandomDataProvider(**config)
+        target = import_locate(
+            type_path
+            if "." in type_path
+            else f"gordo_tpu.dataset.data_provider.providers.{type_path}"
+        )
+        return target(**config)

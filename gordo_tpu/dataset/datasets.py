@@ -1,0 +1,199 @@
+"""Dataset assembly.
+
+Reference equivalent: ``gordo_components/dataset/datasets.py`` —
+``TimeSeriesDataset`` (the workhorse: per-tag series → resampled, joined,
+row-filtered tag matrix) and ``RandomDataset``.
+
+Host-side by design: this is the I/O + pandas layer (SURVEY.md §4 marks it
+I/O-bound, not compute-bound).  It produces contiguous float32 matrices that
+the builder moves to device once; nothing here runs under jit.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import numpy as np
+import pandas as pd
+
+from gordo_tpu.dataset.base import GordoBaseDataset
+from gordo_tpu.dataset.data_provider.base import GordoBaseDataProvider
+from gordo_tpu.dataset.data_provider.providers import RandomDataProvider
+from gordo_tpu.dataset.filter_rows import pandas_filter_rows
+from gordo_tpu.dataset.sensor_tag import SensorTag, normalize_sensor_tags
+from gordo_tpu.utils.args import capture_args
+
+
+def _to_timestamp(value) -> pd.Timestamp:
+    ts = pd.Timestamp(value)
+    if ts.tzinfo is None:
+        ts = ts.tz_localize("UTC")
+    return ts
+
+
+class InsufficientDataError(ValueError):
+    pass
+
+
+class TimeSeriesDataset(GordoBaseDataset):
+    """Pull tags from a provider over a train period, resample + join +
+    filter into an aligned tag matrix.
+
+    Parameters mirror the reference's config surface:
+    ``train_start_date``/``train_end_date``, ``tag_list``,
+    ``target_tag_list`` (defaults to ``tag_list`` — autoencoder X == y),
+    ``resolution`` (pandas offset, default "10min"), ``row_filter`` (safe
+    boolean expression), ``aggregation_methods``, ``row_filter_buffer_size``,
+    ``n_samples_threshold``.
+    """
+
+    @capture_args
+    def __init__(
+        self,
+        train_start_date: Union[str, pd.Timestamp] = None,
+        train_end_date: Union[str, pd.Timestamp] = None,
+        tag_list: Optional[List] = None,
+        target_tag_list: Optional[List] = None,
+        data_provider: Union[GordoBaseDataProvider, dict, None] = None,
+        resolution: str = "10min",
+        row_filter: Union[str, list, None] = None,
+        aggregation_methods: Union[str, List[str]] = "mean",
+        row_filter_buffer_size: int = 0,
+        n_samples_threshold: int = 0,
+        asset: Optional[str] = None,
+        **_ignored,
+    ):
+        if train_start_date is None or train_end_date is None:
+            raise ValueError("train_start_date and train_end_date are required")
+        self.train_start_date = _to_timestamp(train_start_date)
+        self.train_end_date = _to_timestamp(train_end_date)
+        if self.train_start_date >= self.train_end_date:
+            raise ValueError(
+                f"train_start_date {self.train_start_date} must precede "
+                f"train_end_date {self.train_end_date}"
+            )
+        self.asset = asset
+        self.tag_list = normalize_sensor_tags(list(tag_list or []), asset=asset)
+        self.target_tag_list = (
+            normalize_sensor_tags(list(target_tag_list), asset=asset)
+            if target_tag_list
+            else list(self.tag_list)
+        )
+        if isinstance(data_provider, dict):
+            data_provider = GordoBaseDataProvider.from_dict(data_provider)
+        self.data_provider = data_provider or RandomDataProvider()
+        self.resolution = resolution
+        self.row_filter = row_filter
+        self.aggregation_methods = aggregation_methods
+        self.row_filter_buffer_size = row_filter_buffer_size
+        self.n_samples_threshold = n_samples_threshold
+        self._metadata: Dict[str, Any] = {}
+
+    # -- assembly ------------------------------------------------------------
+    def _join_timeseries(self, series_iter) -> pd.DataFrame:
+        frames = []
+        metadata = {}
+        for series in series_iter:
+            raw_len = len(series)
+            agg = (
+                series.resample(self.resolution).agg(self.aggregation_methods)
+                if raw_len
+                else series
+            )
+            if isinstance(agg, pd.DataFrame):  # multiple aggregation methods
+                agg.columns = [f"{series.name}_{m}" for m in agg.columns]
+            else:
+                agg.name = series.name
+            frames.append(agg)
+            metadata[str(series.name)] = {
+                "original_length": int(raw_len),
+                "resampled_length": int(len(agg)),
+            }
+        joined = pd.concat(frames, axis=1, join="inner").dropna()
+        self._metadata["tag_loading_metadata"] = metadata
+        return joined
+
+    def get_data(self) -> Tuple[pd.DataFrame, pd.DataFrame]:
+        all_tags: List[SensorTag] = list(
+            dict.fromkeys(self.tag_list + self.target_tag_list)
+        )
+        series_iter = self.data_provider.load_series(
+            self.train_start_date, self.train_end_date, all_tags
+        )
+        data = self._join_timeseries(series_iter)
+        rows_after_join = len(data)
+
+        if self.row_filter:
+            data = pandas_filter_rows(
+                data, self.row_filter, buffer_size=self.row_filter_buffer_size
+            )
+        rows_after_filter = len(data)
+
+        if rows_after_filter < max(self.n_samples_threshold, 1):
+            raise InsufficientDataError(
+                f"Only {rows_after_filter} rows after filtering "
+                f"(threshold {self.n_samples_threshold}) for period "
+                f"{self.train_start_date} → {self.train_end_date}"
+            )
+
+        # Column order follows the config's tag order.  With multiple
+        # aggregation methods the columns are "<tag>_<method>" and X spans
+        # them all (the reference behaves the same way).
+        x_cols = [t.name for t in self.tag_list]
+        y_cols = [t.name for t in self.target_tag_list]
+        X = data[x_cols] if all(c in data.columns for c in x_cols) else data
+        y = (
+            data[y_cols]
+            if all(c in data.columns for c in y_cols)
+            else X.copy()
+        )
+
+        self._metadata.update(
+            {
+                "train_start_date": str(self.train_start_date),
+                "train_end_date": str(self.train_end_date),
+                "resolution": self.resolution,
+                "row_filter": self.row_filter,
+                "rows_after_join": int(rows_after_join),
+                "rows_after_filter": int(rows_after_filter),
+                "filtered_periods": int(rows_after_join - rows_after_filter),
+                "tag_list": [t.to_json() for t in self.tag_list],
+                "target_tag_list": [t.to_json() for t in self.target_tag_list],
+                "data_provider": self.data_provider.to_dict(),
+                "summary_statistics": {
+                    col: {
+                        "mean": float(data[col].mean()),
+                        "std": float(data[col].std()),
+                        "min": float(data[col].min()),
+                        "max": float(data[col].max()),
+                    }
+                    for col in data.columns
+                },
+            }
+        )
+        return X, y
+
+    def get_metadata(self) -> Dict[str, Any]:
+        return dict(self._metadata)
+
+
+class RandomDataset(TimeSeriesDataset):
+    """TimeSeriesDataset preconfigured with the RandomDataProvider
+    (reference: ``datasets.RandomDataset``)."""
+
+    @capture_args
+    def __init__(
+        self,
+        train_start_date="2017-12-25 06:00:00Z",
+        train_end_date="2017-12-29 06:00:00Z",
+        tag_list: Optional[List] = None,
+        **kwargs,
+    ):
+        kwargs.pop("data_provider", None)
+        super().__init__(
+            train_start_date=train_start_date,
+            train_end_date=train_end_date,
+            tag_list=tag_list or ["tag-1", "tag-2", "tag-3"],
+            data_provider=RandomDataProvider(),
+            **kwargs,
+        )

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from gordo_tpu.models.base import GordoBase
+from gordo_tpu.ops.scalers import as_float2d
 from gordo_tpu.ops.metrics import explained_variance_score
 from gordo_tpu.ops.windows import make_windows
 from gordo_tpu.registry import lookup_factory
@@ -61,12 +62,8 @@ class BaseJaxEstimator(ParamsMixin, GordoBase):
     # -- estimator surface ---------------------------------------------------
     def fit(self, X, y=None, **fit_kwargs):
         t0 = time.time()
-        X = jnp.asarray(np.asarray(X, dtype=np.float32))
-        if X.ndim == 1:
-            X = X[:, None]
-        y_arr = None if y is None else jnp.asarray(np.asarray(y, dtype=np.float32))
-        if y_arr is not None and y_arr.ndim == 1:
-            y_arr = y_arr[:, None]
+        X = as_float2d(X)
+        y_arr = None if y is None else as_float2d(y)
 
         cfg, factory_kwargs = TrainConfig.from_kwargs({**self.kwargs, **fit_kwargs})
         inputs = self._make_inputs(X)
@@ -101,10 +98,7 @@ class BaseJaxEstimator(ParamsMixin, GordoBase):
             raise RuntimeError(f"{type(self).__name__} is not fitted")
         if self.module_ is None:
             self._rebuild_module()
-        X = jnp.asarray(np.asarray(X, dtype=np.float32))
-        if X.ndim == 1:
-            X = X[:, None]
-        inputs = self._make_inputs(X)
+        inputs = self._make_inputs(as_float2d(X))
         if self._predict_jit is None:
             self._predict_jit = jax.jit(self.module_.apply)
         return np.asarray(self._predict_jit({"params": self.params_}, inputs))
@@ -112,10 +106,8 @@ class BaseJaxEstimator(ParamsMixin, GordoBase):
     def score(self, X, y=None, sample_weight=None) -> float:
         """Explained variance of the model's output vs its targets
         (reference: ``KerasAutoEncoder.score``)."""
-        X = jnp.asarray(np.asarray(X, dtype=np.float32))
-        if X.ndim == 1:
-            X = X[:, None]
-        y_arr = None if y is None else jnp.asarray(np.asarray(y, dtype=np.float32))
+        X = as_float2d(X)
+        y_arr = None if y is None else as_float2d(y)
         targets = self._make_targets(X, y_arr)
         pred = self.predict(X)
         return float(explained_variance_score(targets, pred))

@@ -1,0 +1,54 @@
+"""Model-output frame assembly.
+
+Reference equivalent: ``gordo_components/model/utils.py::
+make_base_dataframe`` — the multi-level-column DataFrame convention shared
+by the server views and the anomaly path: top-level keys ``model-input``,
+``model-output`` (+ anomaly columns), second level the tag names, with
+``start``/``end`` timestamp columns when a time index is known.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Union
+
+import numpy as np
+import pandas as pd
+
+
+def make_base_dataframe(
+    tags: List[str],
+    model_input: np.ndarray,
+    model_output: np.ndarray,
+    target_tag_list: Optional[List[str]] = None,
+    index: Optional[pd.Index] = None,
+    frequency: Optional[Union[str, pd.Timedelta]] = None,
+) -> pd.DataFrame:
+    """Assemble the canonical prediction frame.
+
+    ``model_output`` may be shorter than ``model_input`` (LSTM lookback
+    offset); rows are aligned to the *end* of the input, matching the
+    reference's truncation convention.
+    """
+    tags = [str(t) for t in tags]
+    out_tags = [str(t) for t in (target_tag_list or tags)]
+    n_out = len(model_output)
+    offset = len(model_input) - n_out
+    model_input = model_input[offset:]
+
+    data = {}
+    for i, tag in enumerate(tags):
+        data[("model-input", tag)] = np.asarray(model_input)[:, i]
+    for i, tag in enumerate(out_tags[: model_output.shape[1]]):
+        data[("model-output", tag)] = np.asarray(model_output)[:, i]
+
+    frame = pd.DataFrame(data)
+    frame.columns = pd.MultiIndex.from_tuples(frame.columns)
+
+    if index is not None:
+        index = pd.Index(index[offset:])
+        frame.index = index
+        if frequency is not None:
+            delta = pd.Timedelta(frequency)
+            frame[("start", "")] = index
+            frame[("end", "")] = index + delta
+    return frame

@@ -26,11 +26,15 @@ from gordo_tpu.utils.args import ParamsMixin, capture_args
 _EPS = 1e-12
 
 
-def _as2d(X) -> jnp.ndarray:
+def as_float2d(X) -> jnp.ndarray:
+    """Coerce input to a float32 2-D jnp array (shared shape/dtype policy)."""
     X = jnp.asarray(X, dtype=jnp.float32)
     if X.ndim == 1:
         X = X[:, None]
     return X
+
+
+_as2d = as_float2d
 
 
 class BaseTransform(ParamsMixin):
@@ -107,7 +111,7 @@ class MinMaxScaler(BaseTransform):
     ``apply`` honours the configured range."""
 
     @capture_args
-    def __init__(self, feature_range=(0, 1)):
+    def __init__(self, feature_range=(0, 1), **_sklearn_kwargs):
         super().__init__()
         self.feature_range = tuple(feature_range)
 
@@ -135,7 +139,7 @@ class StandardScaler(BaseTransform):
     """Zero-mean unit-variance per feature."""
 
     @capture_args
-    def __init__(self, with_mean: bool = True, with_std: bool = True):
+    def __init__(self, with_mean: bool = True, with_std: bool = True, **_sklearn_kwargs):
         super().__init__()
         self.with_mean = with_mean
         self.with_std = with_std
@@ -167,7 +171,7 @@ class RobustScaler(BaseTransform):
 
     @capture_args
     def __init__(self, with_centering: bool = True, with_scaling: bool = True,
-                 quantile_range=(25.0, 75.0)):
+                 quantile_range=(25.0, 75.0), **_sklearn_kwargs):
         super().__init__()
         self.with_centering = with_centering
         self.with_scaling = with_scaling
@@ -207,7 +211,8 @@ class QuantileTransformer(BaseTransform):
     transform stays jit-friendly (static shapes)."""
 
     @capture_args
-    def __init__(self, n_quantiles: int = 100, output_distribution: str = "uniform"):
+    def __init__(self, n_quantiles: int = 100, output_distribution: str = "uniform",
+                 **_sklearn_kwargs):
         super().__init__()
         self.n_quantiles = int(n_quantiles)
         self.output_distribution = output_distribution
@@ -253,7 +258,8 @@ class SimpleImputer(BaseTransform):
     """Fill NaNs with a per-feature statistic (mean/median/constant)."""
 
     @capture_args
-    def __init__(self, strategy: str = "mean", fill_value: float = 0.0):
+    def __init__(self, strategy: str = "mean", fill_value: float = 0.0,
+                 **_sklearn_kwargs):
         super().__init__()
         self.strategy = strategy
         self.fill_value = fill_value
@@ -293,7 +299,7 @@ class PCA(BaseTransform):
     """Principal component projection via on-device SVD."""
 
     @capture_args
-    def __init__(self, n_components: Optional[int] = None):
+    def __init__(self, n_components: Optional[int] = None, **_sklearn_kwargs):
         super().__init__()
         self.n_components = n_components
 

@@ -186,19 +186,34 @@ class MultiOutputRegressor(ParamsMixin):
         self.estimator = estimator
         self.estimators_: List[Any] = []
 
+    @property
+    def offset(self) -> int:
+        if self.estimators_:
+            return max(getattr(e, "offset", 0) for e in self.estimators_)
+        return getattr(self.estimator, "offset", 0)
+
     def fit(self, X, y=None, **fit_kwargs):
+        import copy
+
         y = np.asarray(X if y is None else y, dtype=np.float32)
         if y.ndim == 1:
             y = y[:, None]
         self.estimators_ = []
         for col in range(y.shape[1]):
-            est = self.estimator.clone() if hasattr(self.estimator, "clone") else self.estimator
+            est = (
+                self.estimator.clone()
+                if hasattr(self.estimator, "clone")
+                else copy.deepcopy(self.estimator)
+            )
             est.fit(X, y[:, col:col + 1], **fit_kwargs)
             self.estimators_.append(est)
         return self
 
     def predict(self, X):
-        preds = [np.asarray(e.predict(X)).reshape(len(X), -1) for e in self.estimators_]
+        # Sub-estimators with a lookback offset return fewer rows than
+        # len(X); keep their own row count and column-stack.
+        preds = [np.asarray(e.predict(X)) for e in self.estimators_]
+        preds = [p.reshape(len(p), -1) for p in preds]
         return np.concatenate(preds, axis=1)
 
     def get_metadata(self):

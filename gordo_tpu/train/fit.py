@@ -169,11 +169,15 @@ def make_epoch_fn(loss_fn: Callable, tx: optax.GradientTransformation,
     return epoch
 
 
-@partial(jax.jit, static_argnames=("apply_fn", "cfg", "steps", "bs"))
-def _fit_jit(apply_fn, cfg: TrainConfig, steps: int, bs: int,
+# Static-keyed on the module itself: flax modules are frozen dataclasses, so
+# two estimators built from the same factory kwargs produce EQUAL modules and
+# hit the same compiled executable (per-instance bound methods would not —
+# every CV fold / fleet member would recompile).
+@partial(jax.jit, static_argnames=("module", "cfg", "steps", "bs"))
+def _fit_jit(module, cfg: TrainConfig, steps: int, bs: int,
              params, X, y, w, rng):
     tx = make_optimizer(cfg)
-    loss_fn = make_loss_fn(apply_fn, cfg.loss)
+    loss_fn = make_loss_fn(module.apply, cfg.loss)
     epoch = make_epoch_fn(loss_fn, tx, steps, bs, cfg.shuffle)
     opt_state = tx.init(params)
     keys = jax.random.split(rng, cfg.epochs)
@@ -200,5 +204,5 @@ def fit(module, X, y, cfg: TrainConfig,
         init_rng, rng = jax.random.split(rng)
         params = init_params(module, init_rng, X[:1])
     Xp, yp, w, steps, bs = _pad_batches(X, y, cfg.batch_size)
-    params, history = _fit_jit(module.apply, cfg, steps, bs, params, Xp, yp, w, rng)
+    params, history = _fit_jit(module, cfg, steps, bs, params, Xp, yp, w, rng)
     return params, np.asarray(history)

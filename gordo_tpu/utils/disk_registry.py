@@ -1,0 +1,50 @@
+"""On-disk key→value registry for built-model caching.
+
+Reference equivalent: ``gordo_components/util/disk_registry.py`` — flat
+files ``{registry_dir}/{key}`` whose contents are the cached value (here:
+the absolute path of a built model artifact dir).  Load-bearing for the
+fleet north star: a re-run project build skips every machine whose config
+hash is already registered.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_KEY_RE = re.compile(r"^[A-Za-z0-9_.-]+$")
+
+
+def _key_path(registry_dir: str, key: str) -> str:
+    if not _KEY_RE.match(key):
+        raise ValueError(f"Invalid registry key {key!r}")
+    return os.path.join(registry_dir, key)
+
+
+def write_key(registry_dir: str, key: str, value: str) -> None:
+    os.makedirs(registry_dir, exist_ok=True)
+    path = _key_path(registry_dir, key)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(value)
+    os.replace(tmp, path)  # atomic vs concurrent builders of the same key
+
+
+def get_value(registry_dir: str, key: str) -> Optional[str]:
+    path = _key_path(registry_dir, key)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return f.read().strip()
+
+
+def delete_value(registry_dir: str, key: str) -> bool:
+    path = _key_path(registry_dir, key)
+    if os.path.exists(path):
+        os.remove(path)
+        return True
+    return False

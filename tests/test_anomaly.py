@@ -1,0 +1,147 @@
+"""Anomaly detector + CV tests (reference strategy: threshold math on
+synthetic frames, score monotonicity under injected anomalies)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from gordo_tpu.anomaly import DiffBasedAnomalyDetector
+from gordo_tpu.models.estimator import AutoEncoder
+from gordo_tpu.ops.scalers import MinMaxScaler, RobustScaler
+from gordo_tpu.pipeline import Pipeline
+from gordo_tpu.serializer import from_definition, into_definition
+from gordo_tpu.train.cv import KFold, TimeSeriesSplit, build_splitter, cross_validate
+
+
+# -- splitters ----------------------------------------------------------------
+def test_timeseries_split_expanding():
+    splits = list(TimeSeriesSplit(3).split(np.zeros((100, 2))))
+    assert len(splits) == 3
+    for train, test in splits:
+        assert train.max() < test.min()  # no leakage from the future
+    assert splits[-1][1][-1] == 99  # covers the tail
+
+
+def test_kfold_covers_all():
+    splits = list(KFold(4).split(np.zeros((20, 1))))
+    covered = np.concatenate([test for _, test in splits])
+    assert sorted(covered) == list(range(20))
+
+
+def test_build_splitter_from_config():
+    sp = build_splitter({"sklearn.model_selection.TimeSeriesSplit": {"n_splits": 5}})
+    assert isinstance(sp, TimeSeriesSplit) and sp.n_splits == 5
+    with pytest.raises(ValueError):
+        build_splitter({"NotASplitter": {}})
+
+
+def test_cross_validate_scores(sine_tags):
+    model = Pipeline([MinMaxScaler(), AutoEncoder(epochs=5, learning_rate=1e-2)])
+    results = cross_validate(model, sine_tags, cv=TimeSeriesSplit(3))
+    assert len(results["folds"]) == 3
+    ev = results["scores"]["explained_variance_score"]
+    assert len(ev["folds"]) == 3
+    assert np.isfinite(ev["mean"])
+
+
+# -- detector -----------------------------------------------------------------
+@pytest.fixture(scope="module")
+def fitted_detector(sine_tags):
+    det = DiffBasedAnomalyDetector(
+        base_estimator=Pipeline(
+            [MinMaxScaler(), AutoEncoder(epochs=20, learning_rate=1e-2)]
+        ),
+        scaler=MinMaxScaler(),
+    )
+    det.cross_validate(sine_tags)
+    det.fit(sine_tags)
+    return det
+
+
+def test_default_construction_matches_reference_default():
+    det = DiffBasedAnomalyDetector()
+    assert isinstance(det.base_estimator, Pipeline)
+    assert isinstance(det.scaler, MinMaxScaler)
+
+
+def test_thresholds_derived(fitted_detector, sine_tags):
+    assert fitted_detector.feature_thresholds_ is not None
+    assert len(fitted_detector.feature_thresholds_) == sine_tags.shape[1]
+    assert fitted_detector.aggregate_threshold_ > 0
+    meta = fitted_detector.get_metadata()
+    assert "cross_validation" in meta
+    assert len(meta["cross_validation"]["feature_thresholds"]) == sine_tags.shape[1]
+
+
+def test_anomaly_frame_schema(fitted_detector, sine_tags):
+    idx = pd.date_range("2020-01-01", periods=len(sine_tags), freq="10min", tz="UTC")
+    df = pd.DataFrame(sine_tags, index=idx, columns=[f"tag-{i}" for i in range(6)])
+    frame = fitted_detector.anomaly(df, frequency="10min")
+    top = set(frame.columns.get_level_values(0))
+    assert {
+        "model-input", "model-output", "tag-anomaly-scores",
+        "total-anomaly-score", "tag-anomaly-thresholds",
+        "total-anomaly-threshold", "anomaly-confidence", "start", "end",
+    } <= top
+    assert len(frame) == len(sine_tags)
+    assert (frame[("total-anomaly-score", "")] >= 0).all()
+
+
+def test_anomaly_detects_injected_spike(fitted_detector, sine_tags):
+    corrupted = sine_tags.copy()
+    corrupted[300:310] += 5.0  # large excursion on all tags
+    frame = fitted_detector.anomaly(corrupted)
+    total = frame[("total-anomaly-score", "")].to_numpy()
+    clean_mean = total[:290].mean()
+    spike_mean = total[300:310].mean()
+    assert spike_mean > 3 * clean_mean
+    assert spike_mean > fitted_detector.aggregate_threshold_
+
+
+def test_anomaly_requires_thresholds():
+    det = DiffBasedAnomalyDetector(
+        base_estimator=Pipeline([MinMaxScaler(), AutoEncoder(epochs=1)]),
+        require_thresholds=True,
+    )
+    X = np.random.default_rng(0).standard_normal((50, 3)).astype(np.float32)
+    det.fit(X)
+    with pytest.raises(AttributeError, match="cross_validate"):
+        det.anomaly(X)
+
+
+def test_anomaly_without_thresholds_allowed():
+    det = DiffBasedAnomalyDetector(
+        base_estimator=Pipeline([MinMaxScaler(), AutoEncoder(epochs=1)]),
+        require_thresholds=False,
+    )
+    X = np.random.default_rng(0).standard_normal((50, 3)).astype(np.float32)
+    det.fit(X)
+    frame = det.anomaly(X)
+    assert ("total-anomaly-score", "") in frame.columns
+
+
+def test_detector_definition_roundtrip(sine_tags):
+    det = DiffBasedAnomalyDetector(
+        base_estimator=Pipeline([RobustScaler(), AutoEncoder(epochs=1)]),
+        scaler=RobustScaler(),
+    )
+    defn = into_definition(det)
+    det2 = from_definition(defn)
+    assert isinstance(det2, DiffBasedAnomalyDetector)
+    assert isinstance(det2.scaler, RobustScaler)
+    # reference-era dotted path also resolves
+    det3 = from_definition(
+        {
+            "gordo_components.model.anomaly.diff.DiffBasedAnomalyDetector": {
+                "base_estimator": {
+                    "gordo_tpu.pipeline.Pipeline": {
+                        "steps": [
+                            "gordo_tpu.ops.scalers.MinMaxScaler",
+                            {"gordo_tpu.models.estimator.AutoEncoder": {"epochs": 1}},
+                        ]
+                    }
+                }
+            }
+        }
+    )
+    assert isinstance(det3, DiffBasedAnomalyDetector)

@@ -1,0 +1,126 @@
+"""Builder tests (reference strategy: build against RandomDataset; cache-key
+tests assert same-config → hit, changed config → rebuild)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from gordo_tpu import serializer
+from gordo_tpu.builder import build_model, calculate_model_key, provide_saved_model
+from gordo_tpu.utils import disk_registry
+
+DATA_CONFIG = {
+    "type": "RandomDataset",
+    "train_start_date": "2020-01-01T00:00:00Z",
+    "train_end_date": "2020-01-10T00:00:00Z",
+    "tag_list": ["tag-a", "tag-b", "tag-c"],
+    "resolution": "1h",
+}
+
+MODEL_CONFIG = {
+    "gordo_tpu.anomaly.diff.DiffBasedAnomalyDetector": {
+        "base_estimator": {
+            "gordo_tpu.pipeline.Pipeline": {
+                "steps": [
+                    "gordo_tpu.ops.scalers.MinMaxScaler",
+                    {"gordo_tpu.models.estimator.AutoEncoder": {"epochs": 3}},
+                ]
+            }
+        }
+    }
+}
+
+SIMPLE_MODEL_CONFIG = {
+    "gordo_tpu.pipeline.Pipeline": {
+        "steps": [
+            "gordo_tpu.ops.scalers.MinMaxScaler",
+            {"gordo_tpu.models.estimator.AutoEncoder": {"epochs": 2}},
+        ]
+    }
+}
+
+
+def test_build_model_full_metadata():
+    model, meta = build_model("machine-1", MODEL_CONFIG, DATA_CONFIG,
+                              metadata={"owner": "team-a"})
+    assert meta["name"] == "machine-1"
+    assert meta["user_defined"] == {"owner": "team-a"}
+    assert meta["dataset"]["resolution"] == "1h"
+    assert meta["model"]["cross_validation"]["aggregate_threshold"] > 0
+    assert meta["model"]["model_builder_duration_sec"] > 0
+    # model is usable
+    X = np.random.default_rng(0).standard_normal((30, 3)).astype(np.float32)
+    frame = model.anomaly(X)
+    assert ("total-anomaly-score", "") in frame.columns
+
+
+def test_build_model_without_cv():
+    model, meta = build_model("m", SIMPLE_MODEL_CONFIG, DATA_CONFIG)
+    assert "cross_validation" not in meta["model"]
+    assert hasattr(model, "predict")
+
+
+def test_model_key_stability_and_sensitivity():
+    k1 = calculate_model_key("m", MODEL_CONFIG, DATA_CONFIG)
+    k2 = calculate_model_key("m", MODEL_CONFIG, DATA_CONFIG)
+    assert k1 == k2
+    k3 = calculate_model_key("m2", MODEL_CONFIG, DATA_CONFIG)
+    changed = {**DATA_CONFIG, "resolution": "2h"}
+    k4 = calculate_model_key("m", MODEL_CONFIG, changed)
+    assert len({k1, k3, k4}) == 3
+
+
+def test_provide_saved_model_cache(tmp_path):
+    out = tmp_path / "out"
+    reg = tmp_path / "registry"
+    path1 = provide_saved_model(
+        "machine-x", SIMPLE_MODEL_CONFIG, DATA_CONFIG,
+        output_dir=str(out), model_register_dir=str(reg),
+    )
+    assert os.path.exists(os.path.join(path1, "model.pkl"))
+    mtime = os.path.getmtime(os.path.join(path1, "model.pkl"))
+
+    # second call: cache hit, no rebuild
+    path2 = provide_saved_model(
+        "machine-x", SIMPLE_MODEL_CONFIG, DATA_CONFIG,
+        output_dir=str(out), model_register_dir=str(reg),
+    )
+    assert path2 == path1
+    assert os.path.getmtime(os.path.join(path1, "model.pkl")) == mtime
+
+    # changed config → rebuild under same name
+    changed = {**DATA_CONFIG, "resolution": "2h"}
+    provide_saved_model(
+        "machine-x", SIMPLE_MODEL_CONFIG, changed,
+        output_dir=str(out), model_register_dir=str(reg),
+    )
+    assert os.path.getmtime(os.path.join(path1, "model.pkl")) != mtime
+
+    # artifact loads and predicts
+    model = serializer.load(path1)
+    X = np.random.default_rng(0).standard_normal((10, 3)).astype(np.float32)
+    assert model.predict(X).shape == (10, 3)
+    meta = serializer.load_metadata(path1)
+    assert meta["name"] == "machine-x"
+
+
+def test_provide_saved_model_stale_registry(tmp_path):
+    reg = tmp_path / "registry"
+    disk_registry.write_key(str(reg), "somekey", "/nonexistent/path")
+    assert disk_registry.get_value(str(reg), "somekey") == "/nonexistent/path"
+    # build proceeds despite stale entry
+    path = provide_saved_model(
+        "machine-y", SIMPLE_MODEL_CONFIG, DATA_CONFIG,
+        output_dir=str(tmp_path / "out"), model_register_dir=str(reg),
+    )
+    assert os.path.exists(path)
+
+
+def test_disk_registry_validation(tmp_path):
+    with pytest.raises(ValueError):
+        disk_registry.write_key(str(tmp_path), "../escape", "v")
+    disk_registry.write_key(str(tmp_path), "ok-key", "value")
+    assert disk_registry.get_value(str(tmp_path), "ok-key") == "value"
+    assert disk_registry.delete_value(str(tmp_path), "ok-key")
+    assert disk_registry.get_value(str(tmp_path), "ok-key") is None

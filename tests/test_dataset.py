@@ -1,0 +1,189 @@
+"""Dataset layer tests (reference strategy: RandomDataProvider as the fake
+backend; filter_rows/sensor_tag unit tests; file readers on tiny fixtures)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from gordo_tpu.dataset import (
+    GordoBaseDataset,
+    RandomDataset,
+    SensorTag,
+    TimeSeriesDataset,
+    normalize_sensor_tags,
+)
+from gordo_tpu.dataset.datasets import InsufficientDataError
+from gordo_tpu.dataset.data_provider.providers import (
+    FileSystemTagProvider,
+    RandomDataProvider,
+)
+from gordo_tpu.dataset.filter_rows import pandas_filter_rows
+
+
+# -- sensor tags --------------------------------------------------------------
+def test_normalize_sensor_tags_spellings():
+    tags = normalize_sensor_tags(
+        ["tag-a", ["tag-b", "asset-1"], {"name": "tag-c", "asset": "asset-2"},
+         SensorTag("tag-d", "asset-3")],
+        asset="default-asset",
+    )
+    assert tags[0] == SensorTag("tag-a", "default-asset")
+    assert tags[1] == SensorTag("tag-b", "asset-1")
+    assert tags[2] == SensorTag("tag-c", "asset-2")
+    assert tags[3] == SensorTag("tag-d", "asset-3")
+
+
+def test_normalize_bad_tag_raises():
+    with pytest.raises(ValueError):
+        normalize_sensor_tags([{"asset": "no-name"}])
+
+
+# -- filter_rows --------------------------------------------------------------
+def test_filter_rows_basic():
+    df = pd.DataFrame({"A": [1, -1, 2, -2], "B": [10, 20, 30, 40]})
+    out = pandas_filter_rows(df, "A > 0")
+    assert list(out["A"]) == [1, 2]
+
+
+def test_filter_rows_compound_and_backticks():
+    df = pd.DataFrame({"TAG-A": [1, 5, 10], "TAG-B": [100, 50, 10]})
+    out = pandas_filter_rows(df, "`TAG-A` > 2 & `TAG-B` < 60")
+    assert len(out) == 2
+
+
+def test_filter_rows_buffer():
+    df = pd.DataFrame({"A": [1, 1, -1, 1, 1, 1]})
+    out = pandas_filter_rows(df, "A > 0", buffer_size=1)
+    # row 2 filtered, rows 1 and 3 buffered away too
+    assert list(out.index) == [0, 4, 5]
+
+
+def test_filter_rows_rejects_dangerous():
+    df = pd.DataFrame({"A": [1]})
+    with pytest.raises(ValueError):
+        pandas_filter_rows(df, "A.__class__")
+    with pytest.raises(ValueError):
+        pandas_filter_rows(df, "@pd.eval('1')")
+    with pytest.raises(ValueError):
+        pandas_filter_rows(df, "exec('x')")
+
+
+# -- providers ----------------------------------------------------------------
+def test_random_provider_deterministic():
+    p = RandomDataProvider(seed=1)
+    start, end = pd.Timestamp("2020-01-01", tz="UTC"), pd.Timestamp("2020-01-05", tz="UTC")
+    s1 = list(p.load_series(start, end, ["tag-a", "tag-b"]))
+    s2 = list(p.load_series(start, end, ["tag-a", "tag-b"]))
+    assert s1[0].name == "tag-a"
+    pd.testing.assert_series_equal(s1[0], s2[0])
+    # different tags differ
+    assert not np.allclose(s1[0].to_numpy()[:10], s1[1].to_numpy()[:10])
+
+
+def test_filesystem_provider_csv(tmp_path):
+    asset_dir = tmp_path / "asset-1"
+    asset_dir.mkdir()
+    idx = pd.date_range("2020-01-01", periods=50, freq="1h", tz="UTC")
+    for tag in ["t1", "t2"]:
+        pd.DataFrame({"time": idx, "value": np.arange(50.0)}).to_csv(
+            asset_dir / f"{tag}.csv", index=False, header=True
+        )
+    p = FileSystemTagProvider(str(tmp_path), asset="asset-1")
+    assert p.can_handle_tag("t1")
+    series = list(
+        p.load_series(idx[0], idx[10], [["t1", "asset-1"], ["t2", "asset-1"]])
+    )
+    assert len(series) == 2 and len(series[0]) == 10
+    with pytest.raises(FileNotFoundError):
+        list(p.load_series(idx[0], idx[5], ["missing-tag"]))
+
+
+def test_provider_roundtrip_via_dict():
+    p = RandomDataProvider(min_size=50, max_size=60, seed=3)
+    d = p.to_dict()
+    p2 = RandomDataProvider.from_dict(d)
+    assert isinstance(p2, RandomDataProvider)
+    assert p2.min_size == 50 and p2.seed == 3
+
+
+# -- datasets -----------------------------------------------------------------
+def test_timeseries_dataset_assembles_matrix():
+    ds = TimeSeriesDataset(
+        train_start_date="2020-01-01T00:00:00Z",
+        train_end_date="2020-01-10T00:00:00Z",
+        tag_list=["tag-a", "tag-b", "tag-c"],
+        data_provider=RandomDataProvider(min_size=500, max_size=600),
+        resolution="1h",
+    )
+    X, y = ds.get_data()
+    assert list(X.columns) == ["tag-a", "tag-b", "tag-c"]
+    assert X.shape == y.shape and len(X) > 10
+    assert not X.isna().any().any()
+    meta = ds.get_metadata()
+    assert meta["resolution"] == "1h"
+    assert "summary_statistics" in meta
+    assert meta["data_provider"]["type"].endswith("RandomDataProvider")
+
+
+def test_timeseries_dataset_row_filter():
+    ds = TimeSeriesDataset(
+        train_start_date="2020-01-01T00:00:00Z",
+        train_end_date="2020-01-10T00:00:00Z",
+        tag_list=["tag-a"],
+        data_provider=RandomDataProvider(min_size=500, max_size=600),
+        resolution="1h",
+        row_filter="`tag-a` > -100",  # passes everything
+    )
+    X, _ = ds.get_data()
+    assert len(X) > 0
+    assert ds.get_metadata()["filtered_periods"] == 0
+
+
+def test_timeseries_dataset_target_tags():
+    ds = TimeSeriesDataset(
+        train_start_date="2020-01-01T00:00:00Z",
+        train_end_date="2020-01-10T00:00:00Z",
+        tag_list=["tag-a", "tag-b"],
+        target_tag_list=["tag-b"],
+        data_provider=RandomDataProvider(min_size=500, max_size=600),
+        resolution="1h",
+    )
+    X, y = ds.get_data()
+    assert list(X.columns) == ["tag-a", "tag-b"]
+    assert list(y.columns) == ["tag-b"]
+
+
+def test_timeseries_dataset_insufficient_data():
+    ds = TimeSeriesDataset(
+        train_start_date="2020-01-01T00:00:00Z",
+        train_end_date="2020-01-02T00:00:00Z",
+        tag_list=["tag-a"],
+        data_provider=RandomDataProvider(min_size=5, max_size=8),
+        resolution="1h",
+        n_samples_threshold=1000,
+    )
+    with pytest.raises(InsufficientDataError):
+        ds.get_data()
+
+
+def test_dataset_date_validation():
+    with pytest.raises(ValueError):
+        TimeSeriesDataset(
+            train_start_date="2020-01-02T00:00:00Z",
+            train_end_date="2020-01-01T00:00:00Z",
+            tag_list=["t"],
+        )
+
+
+def test_dataset_from_dict_dispatch():
+    ds = GordoBaseDataset.from_dict(
+        {
+            "type": "RandomDataset",
+            "train_start_date": "2020-01-01T00:00:00Z",
+            "train_end_date": "2020-01-05T00:00:00Z",
+            "tag_list": ["a", "b"],
+        }
+    )
+    assert isinstance(ds, RandomDataset)
+    X, y = ds.get_data()
+    assert list(X.columns) == ["a", "b"]
