@@ -1,0 +1,43 @@
+"""Fleet parallelism: many independent per-machine models as ONE XLA program.
+
+Reference equivalent: the *orchestration-level* fan-out in
+``gordo_components/workflow`` — an Argo DAG schedules one ``gordo build`` pod
+per machine (SURVEY.md §2.3: "fleet parallel" is the reference's only real
+parallelism strategy).  There is no in-process distributed training in the
+reference at all.
+
+TPU-native replacement: stack the M machines' tiny models into leading-axis
+pytrees, ``vmap`` the entire jitted fit over the model axis, and shard that
+axis over a ``jax.sharding.Mesh`` — one dispatch trains the whole fleet, with
+XLA placing each shard's models on its chip and batching their little
+matmuls into MXU-sized ones.  Cross-validation folds ride a second vmap axis
+(fold-mask weights), so CV for the whole fleet is the same single program.
+"""
+
+from gordo_tpu.parallel.mesh import (
+    fleet_mesh,
+    model_sharding,
+    replicated_sharding,
+)
+from gordo_tpu.parallel.fleet import (
+    FleetFitResult,
+    fleet_fit,
+    fleet_apply,
+    fleet_init,
+    stack_rows,
+    fold_masks,
+)
+from gordo_tpu.parallel.anomaly import FleetDiffBuilder
+
+__all__ = [
+    "fleet_mesh",
+    "model_sharding",
+    "replicated_sharding",
+    "FleetFitResult",
+    "fleet_fit",
+    "fleet_apply",
+    "fleet_init",
+    "stack_rows",
+    "fold_masks",
+    "FleetDiffBuilder",
+]

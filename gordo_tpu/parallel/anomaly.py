@@ -1,0 +1,564 @@
+"""Whole-fleet anomaly-detector builds as stacked device programs.
+
+Reference equivalent: running ``gordo_components/builder/build_model.py``
+once per machine in its own Argo pod, each doing sklearn
+``cross_val_predict`` + threshold derivation + a final Keras fit
+(``model/anomaly/diff.py::DiffBasedAnomalyDetector``).
+
+Here the entire bucket of M homogeneous machines — scaler stats, K CV folds
+PLUS the final fit (folds ride a second vmap axis as weight masks),
+out-of-fold scoring, per-tag/aggregate threshold derivation — compiles into
+a few jitted dispatches, sharded over the mesh ``"models"`` axis.  Output is
+M individually fitted :class:`DiffBasedAnomalyDetector` objects, artifact-
+and metadata-compatible with the single-machine path.
+
+Equivalence contract (tests/test_fleet.py): for machines whose row count
+equals the bucket maximum, the FINAL model (params, scaler stats, anomaly
+scores) is bit-identical to the single-machine path — RNG derivation,
+padding, and shuffle match ``train.fit.fit`` exactly.  Shorter machines in
+a ragged bucket, and all CV-fold fits, are *statistically* equivalent but
+not bit-identical: batch geometry/fold membership come from the bucket-wide
+padded length, so the per-epoch shuffle permutes a different row count than
+the materialized single-machine arrays would, changing minibatch
+composition — same estimator, different sample of SGD noise (a few percent
+on fold-averaged thresholds at small epoch counts).
+
+Fleetability is *checked, not assumed*: :func:`analyze_definition` inspects
+a prototype built from the model-config definition and returns a spec only
+for the supported shape — ``DiffBasedAnomalyDetector`` wrapping
+``Pipeline([*pure-stats scalers, BaseJaxEstimator])`` — everything else
+falls back to the per-machine builder.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import time
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from gordo_tpu.anomaly.diff import SMOOTHING_WINDOW, DiffBasedAnomalyDetector
+from gordo_tpu.models.estimator import BaseJaxEstimator
+from gordo_tpu.ops.metrics import MASKED_METRICS
+from gordo_tpu.ops.scalers import (
+    BaseTransform,
+    MinMaxScaler,
+    RobustScaler,
+    StandardScaler,
+)
+from gordo_tpu.parallel import fleet as fleet_mod
+from gordo_tpu.parallel.mesh import MODEL_AXIS, model_sharding, pad_to_multiple
+from gordo_tpu.pipeline import Pipeline
+from gordo_tpu.registry import lookup_factory
+from gordo_tpu.train.cv import build_splitter
+from gordo_tpu.train.fit import TrainConfig, make_fit_fn
+from gordo_tpu.utils.trees import to_host
+
+#: scalers whose stats are computable by a static pure function (vmappable).
+FLEETABLE_SCALERS = (MinMaxScaler, StandardScaler, RobustScaler)
+
+METRIC_NAMES = (
+    "explained_variance_score",
+    "r2_score",
+    "mean_squared_error",
+    "mean_absolute_error",
+)
+
+
+# ---------------------------------------------------------------------------
+# Definition analysis
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class FleetSpec:
+    """Everything needed to run one homogeneous bucket as a fleet program."""
+
+    detector_proto: DiffBasedAnomalyDetector
+    scaler_protos: List[BaseTransform]      # pipeline scalers, in order
+    estimator_proto: BaseJaxEstimator
+    train_cfg: TrainConfig
+    factory_kwargs: Dict[str, Any]
+    seed: int
+
+    @property
+    def signature(self) -> Tuple:
+        """Bucket key: machines with equal signatures share one program."""
+        return (
+            type(self.detector_proto).__name__,
+            self.detector_proto.window,
+            tuple(
+                (type(s).__name__, tuple(sorted(s._stat_options().items())))
+                for s in self.scaler_protos
+            ),
+            (
+                type(self.detector_proto.scaler).__name__,
+                tuple(sorted(self.detector_proto.scaler._stat_options().items())),
+            ),
+            type(self.estimator_proto).__name__,
+            self.estimator_proto.kind,
+            self.train_cfg,
+            tuple(sorted(self.factory_kwargs.items())),
+        )
+
+
+def analyze_definition(model) -> Optional[FleetSpec]:
+    """Return a :class:`FleetSpec` if ``model`` (a built-but-unfitted
+    prototype) matches the fleetable shape, else None."""
+    if not isinstance(model, DiffBasedAnomalyDetector):
+        return None
+    if not isinstance(model.scaler, FLEETABLE_SCALERS):
+        return None
+
+    base = model.base_estimator
+    scalers: List[BaseTransform] = []
+    if isinstance(base, Pipeline):
+        for _, step in base.steps[:-1]:
+            if not isinstance(step, FLEETABLE_SCALERS):
+                return None
+            scalers.append(step)
+        est = base._final
+    else:
+        est = base
+    if not isinstance(est, BaseJaxEstimator):
+        return None
+    if est.params_ is not None:  # already fitted — not a prototype
+        return None
+
+    cfg, factory_kwargs = TrainConfig.from_kwargs(dict(est.kwargs))
+    seed = int(factory_kwargs.get("seed", 0) or 0)
+    return FleetSpec(
+        detector_proto=model,
+        scaler_protos=scalers,
+        estimator_proto=est,
+        train_cfg=cfg,
+        factory_kwargs=factory_kwargs,
+        seed=seed,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Pure device-side pieces
+# ---------------------------------------------------------------------------
+
+def _span_mask(row_mask: np.ndarray, offset: int, lengths: np.ndarray) -> np.ndarray:
+    """Aligned-axis mask: aligned index j is on iff rows ``j..j+offset`` are
+    ALL on in ``row_mask`` and row ``j+offset`` is a real (unpadded) row.
+
+    Works for train masks (window+target fully inside the train rows) and
+    test masks (prediction j only uses test rows) alike; host numpy, static
+    shapes. ``row_mask``: (..., N) bool; returns (..., N - offset) bool.
+    """
+    n = row_mask.shape[-1]
+    span = offset + 1
+    c = np.concatenate(
+        [np.zeros(row_mask.shape[:-1] + (1,), np.int64),
+         np.cumsum(row_mask.astype(np.int64), axis=-1)],
+        axis=-1,
+    )
+    full = (c[..., span:] - c[..., : n - offset]) == span  # (..., N - offset)
+    valid = (np.arange(n - offset) + offset) < lengths[..., None]
+    return full & valid
+
+
+def _smoothed_masked_max(err: jnp.ndarray, mask: jnp.ndarray, window: int) -> jnp.ndarray:
+    """Max over masked rows of the trailing rolling-min of ``err``.
+
+    Matches pandas ``rolling(window, min_periods=1).min()`` then ``max()`` on
+    the masked segment (DiffBasedAnomalyDetector threshold smoothing), as a
+    pure static-shape function: off-mask entries become +inf before the
+    rolling min (identity) and -inf before the max.
+    ``err``: (N, F) — returns (F,).
+    """
+    big = jnp.where(mask[:, None], err, jnp.inf)
+    neg = -jax.lax.reduce_window(
+        -big,
+        -jnp.inf,
+        jax.lax.max,
+        window_dimensions=(window, 1),
+        window_strides=(1, 1),
+        padding=((window - 1, 0), (0, 0)),
+    )
+    vals = jnp.where(mask[:, None], neg, -jnp.inf)
+    return jnp.max(vals, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# The fleet builder
+# ---------------------------------------------------------------------------
+
+class FleetDiffBuilder:
+    """Build M homogeneous ``DiffBasedAnomalyDetector`` machines at once.
+
+    One instance per bucket; ``build(Xs, ys)`` returns fitted detectors in
+    input order.
+    """
+
+    def __init__(self, spec: FleetSpec, cv: Any = None, mesh: Optional[Mesh] = None):
+        self.spec = spec
+        self.splitter = build_splitter(cv)
+        self.mesh = mesh
+
+    # -- host-side orchestration --------------------------------------------
+    def build(
+        self,
+        Xs: Sequence[np.ndarray],
+        ys: Optional[Sequence[np.ndarray]] = None,
+    ) -> List[DiffBasedAnomalyDetector]:
+        spec = self.spec
+        est_proto = spec.estimator_proto
+        offset = est_proto.offset
+        t0 = time.time()
+
+        X, w_rows, lengths = fleet_mod.stack_rows(Xs)
+        if ys is None:
+            y = X
+        else:
+            if len(ys) != len(Xs):
+                raise ValueError(
+                    f"Got {len(Xs)} input series but {len(ys)} target series"
+                )
+            y, _, y_lengths = fleet_mod.stack_rows(ys)
+            mismatched = [
+                i for i, (a, b) in enumerate(zip(lengths, y_lengths)) if a != b
+            ]
+            if mismatched:
+                raise ValueError(
+                    "Target row counts differ from inputs for machines "
+                    f"{mismatched}: row masks are derived from X, so shorter "
+                    "targets would silently train on zero padding"
+                )
+        m, n = X.shape[:2]
+        n_features = X.shape[2]
+        n_out = y.shape[2]
+
+        # CV fold row-masks, per machine (fold geometry depends on length).
+        k_folds = self.splitter.get_n_splits()
+        train_rows = np.zeros((m, k_folds, n), dtype=bool)
+        test_rows = np.zeros((m, k_folds, n), dtype=bool)
+        for i, length in enumerate(lengths):
+            tr, te = fleet_mod.fold_masks(int(length), self.splitter)
+            train_rows[i, :, : int(length)] = tr
+            test_rows[i, :, : int(length)] = te
+
+        # Aligned-axis weights: K CV folds + 1 final full fit.
+        w_folds = _span_mask(train_rows, offset, lengths[:, None]).astype(np.float32)
+        w_test = _span_mask(test_rows, offset, lengths[:, None]).astype(np.float32)
+        w_full = _span_mask(
+            w_rows.astype(bool)[:, None, :], offset, lengths[:, None]
+        ).astype(np.float32)
+        w_all = np.concatenate([w_folds, w_full], axis=1)  # (M, K+1, NA)
+
+        # Row masks per fold for scaler fitting (single-machine parity: each
+        # CV fold refits the pipeline scalers on ITS train rows only; the
+        # final fit's scalers see every valid row).
+        rows_all = np.concatenate(
+            [train_rows, w_rows.astype(bool)[:, None, :]], axis=1
+        )  # (M, K+1, N)
+
+        # Factory module for this bucket's shapes.
+        factory = lookup_factory(est_proto.model_type, est_proto.kind)
+        built_kwargs = dict(
+            n_features=n_features, n_features_out=n_out, **spec.factory_kwargs
+        )
+        module = factory(**built_kwargs)
+
+        # Pad the model axis for the mesh.
+        m_pad = m
+        if self.mesh is not None:
+            m_pad = pad_to_multiple(m, self.mesh.shape[MODEL_AXIS])
+        if m_pad != m:
+            X = fleet_mod._pad_models(X, m_pad)
+            y = fleet_mod._pad_models(y, m_pad)
+            rows_all = fleet_mod._pad_models(rows_all, m_pad)
+            w_all = np.concatenate(
+                [w_all, np.zeros((m_pad - m,) + w_all.shape[1:], np.float32)], axis=0
+            )
+            w_test = np.concatenate(
+                [w_test, np.zeros((m_pad - m,) + w_test.shape[1:], np.float32)],
+                axis=0,
+            )
+
+        na = w_all.shape[-1]
+        bs = int(min(spec.train_cfg.batch_size, na))
+        steps = -(-na // bs)
+        na_pad = steps * bs - na
+
+        scaler_opts = tuple(
+            (type(s), tuple(sorted(s._stat_options().items())))
+            for s in spec.scaler_protos
+        )
+        det_scaler_opts = (
+            type(spec.detector_proto.scaler),
+            tuple(sorted(spec.detector_proto.scaler._stat_options().items())),
+        )
+
+        # Windowing semantics as static flags (see estimator classes):
+        # "none"=row-wise FF AE, "ae"=reconstruct window end, "forecast"=t+1.
+        from gordo_tpu.models.estimator import LSTMAutoEncoder, LSTMForecast
+
+        if isinstance(est_proto, LSTMForecast):
+            window_mode, lookback = "forecast", est_proto.lookback_window
+        elif isinstance(est_proto, LSTMAutoEncoder):
+            window_mode, lookback = "ae", est_proto.lookback_window
+        else:
+            window_mode, lookback = "none", 1
+
+        seeds = np.full((m_pad,), spec.seed, dtype=np.uint32)
+        out = _fleet_diff_program(
+            module,
+            scaler_opts,
+            det_scaler_opts,
+            window_mode,
+            lookback,
+            int(offset),
+            spec.train_cfg,
+            steps,
+            bs,
+            na_pad,
+            self.mesh,
+            jnp.asarray(X),
+            jnp.asarray(y),
+            jnp.asarray(rows_all),
+            jnp.asarray(w_all),
+            jnp.asarray(w_test),
+            jnp.asarray(seeds),
+        )
+        out = to_host(out)
+        fleet_seconds = time.time() - t0
+
+        return self._assemble(
+            out, m, built_kwargs, fleet_seconds, k_folds
+        )
+
+    # -- unpacking into per-machine detector objects ------------------------
+    def _assemble(
+        self,
+        out: Dict[str, Any],
+        m: int,
+        built_kwargs: Dict[str, Any],
+        fleet_seconds: float,
+        k_folds: int,
+    ) -> List[DiffBasedAnomalyDetector]:
+        spec = self.spec
+        detectors: List[DiffBasedAnomalyDetector] = []
+        final_params_leaves, treedef = jax.tree.flatten(out["final_params"])
+
+        for i in range(m):
+            est = copy.deepcopy(spec.estimator_proto)
+            est.module_ = None
+            est.params_ = jax.tree.unflatten(
+                treedef, [leaf[i] for leaf in final_params_leaves]
+            )
+            est._factory_kwargs_built = dict(built_kwargs)
+            est.history_ = np.asarray(out["final_history"][i])
+            est.fit_seconds_ = fleet_seconds / m
+
+            steps = []
+            for j, proto in enumerate(spec.scaler_protos):
+                sc = copy.deepcopy(proto)
+                # fold axis: -1 is the final full-data fit's scaler stats
+                sc.stats_ = {
+                    key: np.asarray(val[i, -1])
+                    for key, val in out["scaler_stats"][j].items()
+                }
+                steps.append(sc)
+            base: Any = est
+            if steps or isinstance(spec.detector_proto.base_estimator, Pipeline):
+                base = Pipeline([*steps, est])
+
+            det_scaler = copy.deepcopy(spec.detector_proto.scaler)
+            det_scaler.stats_ = {
+                key: np.asarray(val[i])
+                for key, val in out["det_scaler_stats"].items()
+            }
+
+            det = DiffBasedAnomalyDetector(
+                base_estimator=base,
+                scaler=det_scaler,
+                require_thresholds=spec.detector_proto.require_thresholds,
+                window=spec.detector_proto.window,
+            )
+            det.feature_thresholds_ = np.asarray(out["feature_thresholds"][i])
+            det.aggregate_threshold_ = float(out["aggregate_threshold"][i])
+            det.cv_metadata_ = {
+                "scores": {
+                    name: {
+                        "folds": [
+                            float(out["metrics"][name][i, k]) for k in range(k_folds)
+                        ],
+                        "mean": float(np.mean(out["metrics"][name][i])),
+                        "std": float(np.std(out["metrics"][name][i])),
+                    }
+                    for name in METRIC_NAMES
+                },
+                "feature_thresholds": [
+                    float(v) for v in out["feature_thresholds"][i]
+                ],
+                "aggregate_threshold": float(out["aggregate_threshold"][i]),
+                "fleet": {"bucket_size": m, "fleet_seconds": fleet_seconds},
+            }
+            detectors.append(det)
+        return detectors
+
+
+# ---------------------------------------------------------------------------
+# The single compiled program (cached across equal-signature buckets)
+# ---------------------------------------------------------------------------
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "module",
+        "scaler_opts",
+        "det_scaler_opts",
+        "window_mode",
+        "lookback",
+        "offset",
+        "cfg",
+        "steps",
+        "bs",
+        "na_pad",
+        "mesh",
+    ),
+)
+def _fleet_diff_program(
+    module,
+    scaler_opts,
+    det_scaler_opts,
+    window_mode: str,
+    lookback: int,
+    offset: int,
+    cfg: TrainConfig,
+    steps: int,
+    bs: int,
+    na_pad: int,
+    mesh,
+    X,         # (M, N, F) raw stacked rows (zero-padded)
+    y,         # (M, N, Fout) raw targets
+    rows_all,  # (M, K+1, N) bool: each fold's scaler-fit rows (K = all valid)
+    w_all,     # (M, K+1, NA) aligned train weights; fold K is the final fit
+    w_test,    # (M, K, NA) aligned test-eval masks
+    seeds,     # (M,) uint32
+):
+    """Scaler stats -> windows -> (K+1)-fold vmapped fits -> out-of-fold
+    scoring -> thresholds, as ONE jitted program over the whole bucket."""
+    m = X.shape[0]
+    k_folds = w_test.shape[1]
+
+    # 1. Pipeline scaler chain — stats PER FOLD on that fold's train rows
+    #    (single-machine parity: every CV fold refits its scalers), then
+    #    transform; stats of step i are computed on step i-1's output.
+    X_nan = jnp.where(rows_all[:, :, :, None], X[:, None], jnp.nan)  # (M,K+1,N,F)
+    scaler_stats = []
+    X_scaled = jnp.broadcast_to(X[:, None], X_nan.shape)
+    vv = lambda f: jax.vmap(jax.vmap(f))  # noqa: E731 — (models, folds) map
+    for scaler_cls, opts in scaler_opts:
+        stats = vv(lambda xm: scaler_cls.compute_stats(xm, **dict(opts)))(X_nan)
+        scaler_stats.append(stats)
+        X_scaled = vv(scaler_cls.apply)(stats, X_scaled)
+        X_nan = vv(scaler_cls.apply)(stats, X_nan)
+
+    # 2. Detector scaler stats on raw targets over ALL valid rows (the
+    #    detector scaler is fit once on the full series, not per fold).
+    det_cls, det_opts = det_scaler_opts
+    y_nan = jnp.where(rows_all[:, -1, :, None], y, jnp.nan)
+    det_stats = jax.vmap(lambda ym: det_cls.compute_stats(ym, **dict(det_opts)))(
+        y_nan
+    )
+
+    # 3. Windowing (estimator semantics) on the scaled input.
+    from gordo_tpu.ops.windows import make_windows
+
+    if window_mode == "none":
+        inputs, targets = X_scaled, y                      # (M, K+1, NA, ...)
+    elif window_mode == "ae":
+        inputs = vv(lambda a: make_windows(a, lookback))(X_scaled)
+        targets = y[:, lookback - 1:]
+    elif window_mode == "forecast":
+        inputs = vv(lambda a: make_windows(a[:-1], lookback))(X_scaled)
+        targets = y[:, lookback:]
+    else:
+        raise ValueError(f"Unknown window_mode {window_mode!r}")
+
+    # Pad aligned rows to whole minibatches.
+    if na_pad:
+        inputs = jnp.concatenate(
+            [inputs, jnp.zeros(inputs.shape[:2] + (na_pad,) + inputs.shape[3:], inputs.dtype)],
+            axis=2,
+        )
+        targets = jnp.concatenate(
+            [targets, jnp.zeros((m, na_pad) + targets.shape[2:], targets.dtype)],
+            axis=1,
+        )
+        w_all = jnp.concatenate(
+            [w_all, jnp.zeros((m, w_all.shape[1], na_pad), w_all.dtype)], axis=2
+        )
+
+    # 4. (K+1)-fold fits: vmapped over (models, folds); each fold sees its
+    #    own scaled inputs but the shared raw-target series.
+    init_keys, fit_keys = fleet_mod.fleet_keys(seeds)
+    params0 = fleet_mod.fleet_init(module, init_keys, inputs[0, 0, :1])
+    params0 = jax.tree.map(
+        lambda leaf: jnp.broadcast_to(
+            leaf[:, None], (m, k_folds + 1) + leaf.shape[1:]
+        ),
+        params0,
+    )
+    fit_fn = make_fit_fn(module, cfg, steps, bs)
+    vfit = jax.vmap(  # models axis
+        jax.vmap(fit_fn, in_axes=(0, 0, None, 0, None)),  # folds axis
+        in_axes=(0, 0, 0, 0, 0),
+    )
+    params, history = vfit(params0, inputs, targets, w_all, fit_keys)
+
+    # 5. Out-of-fold scoring on the K CV folds.
+    vapply = jax.vmap(
+        jax.vmap(lambda p, x: module.apply({"params": p}, x)),  # folds
+        in_axes=(0, 0),
+    )
+    cv_params = jax.tree.map(lambda leaf: leaf[:, :k_folds], params)
+    na = w_test.shape[2]
+    preds = vapply(cv_params, inputs[:, :k_folds])[:, :, :na]  # (M, K, NA, Fout)
+    y_al = targets[:, :na]
+
+    def fold_scores(pred_k, y_m, mask_k, det_stats_m):
+        y_s = det_cls.apply(det_stats_m, y_m)
+        p_s = det_cls.apply(det_stats_m, pred_k)
+        tag_err = jnp.abs(p_s - y_s)
+        total = jnp.linalg.norm(tag_err, axis=-1)
+        feat_max = _smoothed_masked_max(tag_err, mask_k > 0, SMOOTHING_WINDOW)
+        total_max = _smoothed_masked_max(
+            total[:, None], mask_k > 0, SMOOTHING_WINDOW
+        )[0]
+        metrics = {
+            name: MASKED_METRICS[name](y_m, pred_k, mask_k)
+            for name in METRIC_NAMES
+        }
+        return feat_max, total_max, metrics
+
+    vscores = jax.vmap(  # models
+        jax.vmap(fold_scores, in_axes=(0, None, 0, None)),  # folds
+        in_axes=(0, 0, 0, 0),
+    )
+    feat_max, total_max, metrics = vscores(preds, y_al, w_test, det_stats)
+
+    out = {
+        "scaler_stats": scaler_stats,
+        "det_scaler_stats": det_stats,
+        "final_params": jax.tree.map(lambda leaf: leaf[:, -1], params),
+        "final_history": history[:, -1],
+        "feature_thresholds": jnp.mean(feat_max, axis=1),
+        "aggregate_threshold": jnp.mean(total_max, axis=1),
+        "metrics": metrics,
+    }
+    if mesh is not None:
+        out = jax.lax.with_sharding_constraint(
+            out, model_sharding(mesh)
+        )
+    return out

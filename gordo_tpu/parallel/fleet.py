@@ -1,0 +1,268 @@
+"""Stacked-model fleet training: vmap over models, shard over the mesh.
+
+Reference equivalent: SURVEY.md §2.3 — the reference's only parallelism is
+Argo scheduling one training pod per machine
+(``gordo_components/workflow/`` + ``builder/build_model.py``).  Here the
+same fan-out is a single XLA program:
+
+- every machine's (tiny) dataset is padded to a common row count and stacked
+  into ``(M, N, F)`` device arrays, with a ``(M, N)`` weight mask zeroing
+  padding out of the loss;
+- per-machine params are initialised vmapped into leading-axis-stacked
+  pytrees;
+- the WHOLE multi-epoch fit (``gordo_tpu.train.fit.make_fit_fn``) is vmapped
+  over the model axis and jitted with the stacked axis sharded over the
+  mesh's ``"models"`` axis — XLA places each chip's slice of the fleet
+  locally; no collectives cross the model axis (pure map), so scaling to a
+  v5e-64 is embarrassing in the good sense.
+
+The MXU win: one 8-tag hourglass's ``(256, 8)·(8, 4)`` matmuls can never
+fill a 128x128 systolic array; 10k of them stacked become effectively
+batched GEMMs that can.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from gordo_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    model_sharding,
+    pad_to_multiple,
+)
+from gordo_tpu.train.fit import TrainConfig, batch_geometry, make_fit_fn
+
+
+# ---------------------------------------------------------------------------
+# Host-side stacking
+# ---------------------------------------------------------------------------
+
+def stack_rows(
+    arrays: Sequence[np.ndarray],
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Stack per-machine row-major arrays with row padding.
+
+    Returns ``(stacked (M, N, ...), weights (M, N), lengths (M,))`` where
+    ``N`` is the max row count and ``weights`` masks padded rows.
+    """
+    arrays = [np.asarray(a, dtype=np.float32) for a in arrays]
+    trailing = {a.shape[1:] for a in arrays}
+    if len(trailing) != 1:
+        raise ValueError(
+            f"stack_rows needs homogeneous feature shapes, got {sorted(trailing)}"
+        )
+    lengths = np.array([a.shape[0] for a in arrays], dtype=np.int32)
+    n = int(lengths.max())
+    m = len(arrays)
+    out = np.zeros((m, n) + arrays[0].shape[1:], dtype=np.float32)
+    w = np.zeros((m, n), dtype=np.float32)
+    for i, a in enumerate(arrays):
+        out[i, : a.shape[0]] = a
+        w[i, : a.shape[0]] = 1.0
+    return out, w, lengths
+
+
+def fold_masks(n_rows: int, splitter) -> Tuple[np.ndarray, np.ndarray]:
+    """CV folds as static-shape boolean masks ``(K, n_rows)``.
+
+    Device-side CV cannot fancy-index per fold (shapes must be static under
+    vmap); fold membership becomes a weight/selection mask instead.
+    """
+    k = splitter.get_n_splits()
+    train = np.zeros((k, n_rows), dtype=bool)
+    test = np.zeros((k, n_rows), dtype=bool)
+    for i, (tr, te) in enumerate(splitter.split(np.empty((n_rows, 1)))):
+        train[i, tr] = True
+        test[i, te] = True
+    return train, test
+
+
+# ---------------------------------------------------------------------------
+# Fleet fit
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class FleetFitResult:
+    """Stacked fit output: leading-axis-``M`` params pytree + loss history."""
+
+    params: Any              # pytree, every leaf (M, ...)
+    history: np.ndarray      # (M, epochs)
+    n_models: int            # models actually requested (before mesh padding)
+
+    def unstack_params(self) -> List[Any]:
+        """Split the stacked pytree into per-machine host pytrees."""
+        leaves, treedef = jax.tree.flatten(jax.device_get(self.params))
+        return [
+            jax.tree.unflatten(treedef, [leaf[i] for leaf in leaves])
+            for i in range(self.n_models)
+        ]
+
+
+def _pad_models(arr: np.ndarray, m_pad: int) -> np.ndarray:
+    """Grow the leading model axis to ``m_pad`` by repeating the last entry
+    (weights for padded models are zeroed separately)."""
+    m = arr.shape[0]
+    if m == m_pad:
+        return arr
+    reps = np.repeat(arr[-1:], m_pad - m, axis=0)
+    return np.concatenate([arr, reps], axis=0)
+
+
+def fleet_keys(seeds: np.ndarray) -> Tuple[jax.Array, jax.Array]:
+    """Per-machine (init_key, fit_key) pairs, derived EXACTLY like the
+    single-model path (``train.fit.fit``: split of ``PRNGKey(seed)``) so a
+    fleet fit is bit-identical to M separate fits of the same shapes."""
+    keys = jax.vmap(jax.random.PRNGKey)(jnp.asarray(seeds, dtype=jnp.uint32))
+    split = jax.vmap(jax.random.split)(keys)  # (M, 2, 2)
+    return split[:, 0], split[:, 1]
+
+
+def fleet_init(module, init_keys: jax.Array, sample_x: np.ndarray):
+    """vmapped param init: one rng per machine -> stacked params pytree."""
+    return jax.vmap(lambda k: module.init(k, jnp.asarray(sample_x))["params"])(
+        init_keys
+    )
+
+
+def fleet_fit(
+    module,
+    X: np.ndarray,
+    y: np.ndarray,
+    w: np.ndarray,
+    cfg: TrainConfig,
+    seeds: Optional[np.ndarray] = None,
+    mesh: Optional[Mesh] = None,
+    params: Optional[Any] = None,
+) -> FleetFitResult:
+    """Train ``M`` instances of ``module`` on stacked data in one dispatch.
+
+    ``X``: (M, N, ...) inputs, ``y``: (M, N, ...) targets, ``w``: (M, N)
+    row-validity weights.  With a mesh, the model axis is sharded over the
+    mesh's ``"models"`` axis (M is padded up to a multiple of its size with
+    zero-weight dummies); rows replicate within a model shard — the ``data``
+    mesh axis serves :func:`fit_data_parallel` instead.
+    """
+    X = np.asarray(X, np.float32)
+    y = np.asarray(y, np.float32)
+    w = np.asarray(w, np.float32)
+    m, n = X.shape[:2]
+
+    # Pad rows to a whole number of minibatches (masked out of the loss).
+    steps, bs, n_pad = batch_geometry(n, cfg.batch_size)
+    if n_pad:
+        X = np.concatenate([X, np.zeros((m, n_pad) + X.shape[2:], X.dtype)], axis=1)
+        y = np.concatenate([y, np.zeros((m, n_pad) + y.shape[2:], y.dtype)], axis=1)
+        w = np.concatenate([w, np.zeros((m, n_pad), w.dtype)], axis=1)
+
+    # Pad the model axis to the mesh's fleet width.
+    m_pad = m
+    if mesh is not None:
+        m_pad = pad_to_multiple(m, mesh.shape[MODEL_AXIS])
+        if m_pad != m:
+            X = _pad_models(X, m_pad)
+            y = _pad_models(y, m_pad)
+            w = np.concatenate(
+                [w, np.zeros((m_pad - m, w.shape[1]), w.dtype)], axis=0
+            )
+
+    if seeds is None:
+        seeds = np.arange(m_pad, dtype=np.uint32)
+    else:
+        seeds = _pad_models(np.asarray(seeds, np.uint32), m_pad)
+
+    init_keys, fit_keys = fleet_keys(seeds)
+    if params is None:
+        params = fleet_init(module, init_keys, X[0, :1])
+
+    fit_fn = make_fit_fn(module, cfg, steps, bs)
+    vfit = jax.vmap(fit_fn)
+
+    if mesh is not None:
+        ms = model_sharding(mesh)
+        fitted = jax.jit(
+            vfit,
+            in_shardings=(ms, ms, ms, ms, ms),
+            out_shardings=(ms, ms),
+        )
+    else:
+        fitted = jax.jit(vfit)
+
+    out_params, history = fitted(
+        params, jnp.asarray(X), jnp.asarray(y), jnp.asarray(w), fit_keys
+    )
+    return FleetFitResult(
+        params=out_params,
+        history=np.asarray(history)[:m],
+        n_models=m,
+    )
+
+
+def fleet_apply(
+    module,
+    params: Any,
+    X,
+    mesh: Optional[Mesh] = None,
+) -> jnp.ndarray:
+    """vmapped forward pass: stacked params (M, ...) x inputs (M, N, ...)."""
+    vapply = jax.vmap(lambda p, x: module.apply({"params": p}, x))
+    if mesh is not None:
+        ms = model_sharding(mesh)
+        return jax.jit(vapply, in_shardings=(ms, ms), out_shardings=ms)(
+            params, jnp.asarray(X)
+        )
+    return jax.jit(vapply)(params, jnp.asarray(X))
+
+
+# ---------------------------------------------------------------------------
+# Data-parallel single-model fit (the "data" mesh axis)
+# ---------------------------------------------------------------------------
+
+def fit_data_parallel(
+    module,
+    X: np.ndarray,
+    y: np.ndarray,
+    cfg: TrainConfig,
+    mesh: Mesh,
+    rng: Optional[jax.Array] = None,
+) -> Tuple[Any, np.ndarray]:
+    """Fit ONE model with rows sharded over the mesh ``"data"`` axis.
+
+    For a single larger model (not the fleet case): params replicate, the
+    batch axis shards, and XLA's grad all-reduce rides ICI — the TPU-native
+    replacement for the `tf.distribute` capability the reference never used
+    (SURVEY.md §6.8).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    X = np.asarray(X, np.float32)
+    y = np.asarray(y, np.float32)
+    n = X.shape[0]
+    steps, bs, n_pad = batch_geometry(n, cfg.batch_size)
+    w = np.concatenate([np.ones(n, np.float32), np.zeros(n_pad, np.float32)])
+    if n_pad:
+        X = np.concatenate([X, np.zeros((n_pad,) + X.shape[1:], X.dtype)])
+        y = np.concatenate([y, np.zeros((n_pad,) + y.shape[1:], y.dtype)])
+
+    init_rng, rng = jax.random.split(rng)  # same derivation as train.fit.fit
+    params = module.init(init_rng, jnp.asarray(X[:1]))["params"]
+    fit_fn = make_fit_fn(module, cfg, steps, bs)
+
+    rows = NamedSharding(mesh, P(DATA_AXIS))
+    repl = NamedSharding(mesh, P())
+    fitted = jax.jit(
+        fit_fn,
+        in_shardings=(repl, rows, rows, rows, repl),
+        out_shardings=(repl, repl),
+    )
+    out_params, history = fitted(
+        params, jnp.asarray(X), jnp.asarray(y), jnp.asarray(w), rng
+    )
+    return out_params, np.asarray(history)

@@ -1,0 +1,57 @@
+"""Device-mesh construction and sharding helpers.
+
+The framework's canonical mesh has two axes:
+
+- ``"models"`` — the fleet axis: independent machines' stacked models.  This
+  replaces the reference's Argo pod-per-machine fan-out; collectives never
+  cross it (pure map), so XLA partitions it for free.
+- ``"data"`` — batch/row axis for data-parallel fitting of a single larger
+  model (all-reduce of grads rides ICI).
+
+On a v5e-64 slice the default is all 64 chips on ``"models"``; a single-chip
+dev box gets a 1x1 mesh and every program still compiles identically.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MODEL_AXIS = "models"
+DATA_AXIS = "data"
+
+
+def fleet_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    data_parallel: int = 1,
+) -> Mesh:
+    """Build the canonical ``("models", "data")`` mesh over ``devices``.
+
+    ``data_parallel`` chips are grouped per model-shard; the rest of the
+    devices spread the fleet axis.
+    """
+    devices = list(devices) if devices is not None else jax.devices()
+    n = len(devices)
+    if n % data_parallel != 0:
+        raise ValueError(
+            f"data_parallel={data_parallel} does not divide device count {n}"
+        )
+    grid = np.asarray(devices).reshape(n // data_parallel, data_parallel)
+    return Mesh(grid, (MODEL_AXIS, DATA_AXIS))
+
+
+def model_sharding(mesh: Mesh, extra_dims: int = 0) -> NamedSharding:
+    """Sharding placing a leading ``models`` axis over the mesh fleet axis."""
+    return NamedSharding(mesh, P(MODEL_AXIS, *([None] * extra_dims)))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def pad_to_multiple(m: int, k: int) -> int:
+    """Smallest multiple of ``k`` that is >= ``m``."""
+    return -(-m // k) * k

@@ -124,12 +124,22 @@ def init_params(module, rng: jax.Array, sample_x: jnp.ndarray):
     return module.init(rng, sample_x)["params"]
 
 
+def batch_geometry(n: int, batch_size: int) -> Tuple[int, int, int]:
+    """Shared minibatch geometry: ``(steps, bs, n_pad)`` for ``n`` rows.
+
+    Single source of truth for the padding arithmetic that the single-model,
+    fleet, and data-parallel fits must all agree on (their bit-parity tests
+    depend on identical geometry).
+    """
+    bs = int(min(batch_size, n))
+    steps = -(-n // bs)
+    return steps, bs, steps * bs - n
+
+
 def _pad_batches(X, y, batch_size: int):
     """Pad to a whole number of batches; returns (X, y, w, steps, bs)."""
     n = X.shape[0]
-    bs = int(min(batch_size, n))
-    steps = -(-n // bs)
-    n_pad = steps * bs - n
+    steps, bs, n_pad = batch_geometry(n, batch_size)
     w = jnp.concatenate([jnp.ones((n,), jnp.float32), jnp.zeros((n_pad,), jnp.float32)])
     if n_pad:
         X = jnp.concatenate([X, jnp.zeros((n_pad,) + X.shape[1:], X.dtype)])
@@ -169,6 +179,30 @@ def make_epoch_fn(loss_fn: Callable, tx: optax.GradientTransformation,
     return epoch
 
 
+def make_fit_fn(module, cfg: TrainConfig, steps: int, bs: int) -> Callable:
+    """The whole multi-epoch fit as ONE pure function
+    ``(params, X, y, w, rng) -> (params, history)``.
+
+    This is the unit the fleet engine vmaps across stacked models
+    (``gordo_tpu.parallel.fleet``) and the single-model path jits directly.
+    """
+    tx = make_optimizer(cfg)
+    loss_fn = make_loss_fn(module.apply, cfg.loss)
+    epoch = make_epoch_fn(loss_fn, tx, steps, bs, cfg.shuffle)
+
+    def fit_fn(params, X, y, w, rng):
+        opt_state = tx.init(params)
+        keys = jax.random.split(rng, cfg.epochs)
+
+        def body(carry, key):
+            return epoch(carry, key, X, y, w)
+
+        (params, _), history = jax.lax.scan(body, (params, opt_state), keys)
+        return params, history
+
+    return fit_fn
+
+
 # Static-keyed on the module itself: flax modules are frozen dataclasses, so
 # two estimators built from the same factory kwargs produce EQUAL modules and
 # hit the same compiled executable (per-instance bound methods would not —
@@ -176,17 +210,7 @@ def make_epoch_fn(loss_fn: Callable, tx: optax.GradientTransformation,
 @partial(jax.jit, static_argnames=("module", "cfg", "steps", "bs"))
 def _fit_jit(module, cfg: TrainConfig, steps: int, bs: int,
              params, X, y, w, rng):
-    tx = make_optimizer(cfg)
-    loss_fn = make_loss_fn(module.apply, cfg.loss)
-    epoch = make_epoch_fn(loss_fn, tx, steps, bs, cfg.shuffle)
-    opt_state = tx.init(params)
-    keys = jax.random.split(rng, cfg.epochs)
-
-    def body(carry, key):
-        return epoch(carry, key, X, y, w)
-
-    (params, _), history = jax.lax.scan(body, (params, opt_state), keys)
-    return params, history
+    return make_fit_fn(module, cfg, steps, bs)(params, X, y, w, rng)
 
 
 def fit(module, X, y, cfg: TrainConfig,
