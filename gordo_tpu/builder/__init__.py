@@ -1,5 +1,10 @@
 from gordo_tpu.builder.build_model import (  # noqa: F401
+    assemble_metadata,
     build_model,
     calculate_model_key,
     provide_saved_model,
+)
+from gordo_tpu.builder.fleet_build import (  # noqa: F401
+    ProjectBuildResult,
+    build_project,
 )

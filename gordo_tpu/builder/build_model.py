@@ -62,7 +62,6 @@ def build_model(
     evaluation_config: Optional[dict] = None,
 ) -> Tuple[Any, Dict[str, Any]]:
     """Build one machine's model: data → model → (CV) → fit → metadata."""
-    metadata = metadata or {}
     evaluation_config = evaluation_config or {"cv_mode": "full_build"}
     t_start = time.time()
 
@@ -91,15 +90,47 @@ def build_model(
         model.fit(X_arr, y_arr)
         fit_duration = time.time() - t0
 
-    build_metadata = {
+    build_metadata = assemble_metadata(
+        name=name,
+        model=model,
+        model_config=model_config,
+        data_config=data_config,
+        dataset_metadata=dataset.get_metadata(),
+        metadata=metadata,
+        data_query_duration=t_data - t_start,
+        cv_duration=cv_duration,
+        fit_duration=fit_duration,
+        cv_meta=cv_meta,
+    )
+    return model, build_metadata
+
+
+def assemble_metadata(
+    name: str,
+    model: Any,
+    model_config: dict,
+    data_config: dict,
+    dataset_metadata: dict,
+    metadata: Optional[dict],
+    data_query_duration: float,
+    cv_duration: float,
+    fit_duration: float,
+    cv_meta: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """The machine-metadata schema shared by the single-machine and fleet
+    builders (reference parity: the metadata JSON is the primary
+    observability artifact, SURVEY.md §6.5)."""
+    metadata = metadata or {}
+    cv_meta = cv_meta or {}
+    return {
         "name": name,
         "gordo_tpu_version": gordo_tpu.__version__,
         "checksum": calculate_model_key(name, model_config, data_config, metadata),
-        "dataset": dataset.get_metadata(),
+        "dataset": dataset_metadata,
         "model": {
             "model_config": model_config,
             "model_creation_date": time.strftime("%Y-%m-%d %H:%M:%S%z"),
-            "data_query_duration_sec": t_data - t_start,
+            "data_query_duration_sec": data_query_duration,
             "cross_validation_duration_sec": cv_duration,
             "model_builder_duration_sec": fit_duration,
             **(
@@ -113,7 +144,6 @@ def build_model(
         },
         "user_defined": metadata,
     }
-    return model, build_metadata
 
 
 def provide_saved_model(

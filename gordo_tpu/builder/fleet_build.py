@@ -1,0 +1,239 @@
+"""Project-scale builds: the whole machine list through the fleet engine.
+
+Reference equivalent: the Argo workflow's fan-out — N independent
+``gordo build`` pods, one per machine, each running
+``builder/build_model.py::provide_saved_model`` (SURVEY.md §4.4).
+
+TPU-native replacement: machines are bucketed by model-signature +
+data-shape; each bucket trains as ONE stacked XLA program
+(``gordo_tpu.parallel.anomaly.FleetDiffBuilder``) sharded over the device
+mesh.  Per-machine contracts are preserved exactly: every machine still
+gets its own artifact directory, metadata JSON, and config-hash cache entry
+(``provide_saved_model`` cache parity) — a re-run project build skips
+already-built machines, and a machine whose config the fleet engine can't
+express falls back to the single-machine builder transparently.
+
+Data loading stays host-side and overlaps across machines via a thread
+pool (the reference's per-pod I/O becomes concurrent per-tag reads feeding
+one process).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+from jax.sharding import Mesh
+
+from gordo_tpu import serializer
+from gordo_tpu.builder.build_model import (
+    assemble_metadata,
+    build_model,
+    calculate_model_key,
+)
+from gordo_tpu.dataset.base import GordoBaseDataset
+from gordo_tpu.parallel.anomaly import FleetDiffBuilder, analyze_definition
+from gordo_tpu.utils import disk_registry
+from gordo_tpu.workflow.config import Machine
+
+logger = logging.getLogger(__name__)
+
+#: fleet programs are chunked so a bucket's stacked arrays stay well inside
+#: device memory (tiny models: the data, not the params, is the footprint).
+DEFAULT_MAX_BUCKET = 512
+
+
+class ProjectBuildResult:
+    """Per-machine artifact dirs + build accounting for one project build."""
+
+    def __init__(self):
+        self.artifacts: Dict[str, str] = {}
+        self.cached: List[str] = []
+        self.fleet_built: List[str] = []
+        self.single_built: List[str] = []
+        self.failed: Dict[str, str] = {}
+        self.seconds: float = 0.0
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "n_machines": len(self.artifacts) + len(self.failed),
+            "cached": len(self.cached),
+            "fleet_built": len(self.fleet_built),
+            "single_built": len(self.single_built),
+            "failed": dict(self.failed),
+            "build_seconds": self.seconds,
+        }
+
+
+def _as_machine(m: Union[Machine, Dict[str, Any]]) -> Machine:
+    if isinstance(m, Machine):
+        return m
+    return Machine.from_config(m)
+
+
+def build_project(
+    machines: Sequence[Union[Machine, Dict[str, Any]]],
+    output_dir: str,
+    model_register_dir: Optional[str] = None,
+    mesh: Optional[Mesh] = None,
+    replace_cache: bool = False,
+    max_bucket_size: int = DEFAULT_MAX_BUCKET,
+    data_workers: int = 8,
+) -> ProjectBuildResult:
+    """Build every machine; fleet-bucket the homogeneous ones.
+
+    Returns a :class:`ProjectBuildResult` with one artifact dir per machine
+    (identical layout to ``provide_saved_model``).
+    """
+    t_start = time.time()
+    machines = [_as_machine(m) for m in machines]
+    result = ProjectBuildResult()
+
+    # 1. Config-hash cache check (reference: provide_saved_model).
+    to_build: List[Machine] = []
+    for m in machines:
+        key = calculate_model_key(m.name, m.model, m.dataset, m.metadata)
+        if model_register_dir and not replace_cache:
+            cached = disk_registry.get_value(model_register_dir, key)
+            if cached and os.path.exists(
+                os.path.join(cached, serializer.MODEL_FILE)
+            ):
+                logger.info("Cache hit for %s: %s", m.name, cached)
+                result.artifacts[m.name] = cached
+                result.cached.append(m.name)
+                continue
+        to_build.append(m)
+
+    # 2. Load data concurrently (host-side, I/O-bound).
+    def _load(m: Machine):
+        t0 = time.time()
+        dataset = GordoBaseDataset.from_dict(dict(m.dataset))
+        X, y = dataset.get_data()
+        return (
+            np.asarray(X, np.float32),
+            np.asarray(y, np.float32),
+            dataset.get_metadata(),
+            time.time() - t0,
+        )
+
+    loaded: Dict[str, Tuple] = {}
+    if to_build:
+        with ThreadPoolExecutor(max_workers=data_workers) as pool:
+            futures = {m.name: pool.submit(_load, m) for m in to_build}
+        for m in to_build:
+            try:
+                loaded[m.name] = futures[m.name].result()
+            except Exception as exc:  # data failures shouldn't sink the fleet
+                logger.exception("Data load failed for %s", m.name)
+                result.failed[m.name] = f"data: {exc}"
+    to_build = [m for m in to_build if m.name in loaded]
+
+    # 3. Bucket by (fleet signature, feature shapes); misfits go single.
+    buckets: Dict[Tuple, List[Machine]] = {}
+    singles: List[Machine] = []
+    specs: Dict[Tuple, Any] = {}
+    for m in to_build:
+        X, y, _, _ = loaded[m.name]
+        cv_mode = m.evaluation.get("cv_mode", "full_build")
+        spec = None
+        if cv_mode == "full_build":
+            try:
+                spec = analyze_definition(serializer.from_definition(dict(m.model)))
+            except Exception:
+                spec = None
+        if spec is None:
+            singles.append(m)
+            continue
+        key = (spec.signature, X.shape[1], y.shape[1], str(m.evaluation.get("cv")))
+        buckets.setdefault(key, []).append(m)
+        specs[key] = spec
+
+    # 4. Fleet-build each bucket in chunks.
+    for key, bucket in buckets.items():
+        spec = specs[key]
+        cv = bucket[0].evaluation.get("cv")
+        for start in range(0, len(bucket), max_bucket_size):
+            chunk = bucket[start : start + max_bucket_size]
+            t0 = time.time()
+            try:
+                builder = FleetDiffBuilder(spec, cv=cv, mesh=mesh)
+                detectors = builder.build(
+                    [loaded[m.name][0] for m in chunk],
+                    [loaded[m.name][1] for m in chunk],
+                )
+            except Exception as exc:
+                logger.exception("Fleet bucket failed; falling back to singles")
+                singles.extend(chunk)
+                continue
+            fleet_seconds = time.time() - t0
+            for m, det in zip(chunk, detectors):
+                _dump_machine(
+                    m,
+                    det,
+                    loaded[m.name],
+                    fleet_seconds / len(chunk),
+                    output_dir,
+                    model_register_dir,
+                    result,
+                    fleet=True,
+                )
+
+    # 5. Single-machine fallback (non-fleetable configs).
+    for m in singles:
+        try:
+            model, metadata = build_model(
+                m.name, m.model, m.dataset, m.metadata, m.evaluation
+            )
+        except Exception as exc:
+            logger.exception("Single build failed for %s", m.name)
+            result.failed[m.name] = f"build: {exc}"
+            continue
+        dest = os.path.join(output_dir, m.name)
+        serializer.dump(model, dest, metadata=metadata)
+        _register(m, dest, model_register_dir)
+        result.artifacts[m.name] = dest
+        result.single_built.append(m.name)
+
+    result.seconds = time.time() - t_start
+    return result
+
+
+def _dump_machine(
+    m: Machine,
+    detector,
+    loaded_entry: Tuple,
+    fit_seconds: float,
+    output_dir: str,
+    model_register_dir: Optional[str],
+    result: ProjectBuildResult,
+    fleet: bool,
+) -> None:
+    _, _, dataset_meta, query_seconds = loaded_entry
+    metadata = assemble_metadata(
+        name=m.name,
+        model=detector,
+        model_config=m.model,
+        data_config=m.dataset,
+        dataset_metadata=dataset_meta,
+        metadata=m.metadata,
+        data_query_duration=query_seconds,
+        cv_duration=fit_seconds,  # fleet: CV+fit are one fused program
+        fit_duration=fit_seconds,
+        cv_meta=getattr(detector, "cv_metadata_", {}),
+    )
+    metadata["model"]["fleet_built"] = fleet
+    dest = os.path.join(output_dir, m.name)
+    serializer.dump(detector, dest, metadata=metadata)
+    _register(m, dest, model_register_dir)
+    result.artifacts[m.name] = dest
+    result.fleet_built.append(m.name)
+
+
+def _register(m: Machine, dest: str, model_register_dir: Optional[str]) -> None:
+    if model_register_dir:
+        key = calculate_model_key(m.name, m.model, m.dataset, m.metadata)
+        disk_registry.write_key(model_register_dir, key, os.path.abspath(dest))

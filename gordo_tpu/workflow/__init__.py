@@ -1,0 +1,17 @@
+"""Project-config normalization and execution-plan generation.
+
+Reference equivalent: ``gordo_components/workflow/`` — the layer that turns
+a project YAML (``machines:`` + ``globals:``) into per-machine build specs
+and an orchestration document (there: a Jinja2-rendered Argo ``Workflow``
+fanning out one builder pod per machine; here: a TPU fleet execution plan,
+with the Argo/Kubernetes YAML still emittable for cluster parity).
+"""
+
+from gordo_tpu.workflow.config import (
+    DEFAULT_MODEL,
+    Machine,
+    NormalizedConfig,
+    load_machine_config,
+)
+
+__all__ = ["DEFAULT_MODEL", "Machine", "NormalizedConfig", "load_machine_config"]

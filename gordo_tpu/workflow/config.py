@@ -1,0 +1,162 @@
+"""Project YAML → normalized machine list.
+
+Reference equivalent:
+``gordo_components/workflow/config_elements/normalized_config.py`` (+
+``machine.py``): parse ``machines:``, overlay ``globals:`` onto per-machine
+entries over the built-in defaults, inject the default model (scaler +
+hourglass autoencoder wrapped in a DiffBasedAnomalyDetector), and enforce
+DNS-safe machine names (machine names become k8s service names downstream).
+"""
+
+from __future__ import annotations
+
+import copy
+import re
+from typing import Any, Dict, List, Optional, Union
+
+import yaml
+
+#: the reference's default machine model, in this framework's dotted paths
+#: (reference-era sklearn/gordo_components paths also work via ALIASES).
+DEFAULT_MODEL: Dict[str, Any] = {
+    "gordo_tpu.anomaly.diff.DiffBasedAnomalyDetector": {
+        "base_estimator": {
+            "gordo_tpu.pipeline.Pipeline": {
+                "steps": [
+                    "gordo_tpu.ops.scalers.MinMaxScaler",
+                    {
+                        "gordo_tpu.models.estimator.AutoEncoder": {
+                            "kind": "feedforward_hourglass"
+                        }
+                    },
+                ]
+            }
+        }
+    }
+}
+
+DEFAULT_EVALUATION: Dict[str, Any] = {
+    "cv_mode": "full_build",
+}
+
+#: DNS-1123 label: machine names become endpoint path segments and k8s
+#: service names (reference enforces the same rule).
+_NAME_RE = re.compile(r"^[a-z0-9]([-a-z0-9]{0,61}[a-z0-9])?$")
+
+
+def _deep_merge(base: Dict, overlay: Dict) -> Dict:
+    """Recursive dict merge; overlay wins, nested dicts merge."""
+    out = copy.deepcopy(base)
+    for key, value in overlay.items():
+        if (
+            key in out
+            and isinstance(out[key], dict)
+            and isinstance(value, dict)
+        ):
+            out[key] = _deep_merge(out[key], value)
+        else:
+            out[key] = copy.deepcopy(value)
+    return out
+
+
+class Machine:
+    """One machine (named tag group): the unit of model building/serving.
+
+    Reference equivalent: ``workflow/config_elements/machine.py::Machine``.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        dataset: Dict[str, Any],
+        model: Optional[Dict[str, Any]] = None,
+        metadata: Optional[Dict[str, Any]] = None,
+        evaluation: Optional[Dict[str, Any]] = None,
+        runtime: Optional[Dict[str, Any]] = None,
+        project_name: Optional[str] = None,
+    ):
+        if not _NAME_RE.match(name or ""):
+            raise ValueError(
+                f"Invalid machine name {name!r}: must be a lowercase DNS-1123 "
+                "label (a-z, 0-9, '-', max 63 chars, no leading/trailing '-')"
+            )
+        if not dataset:
+            raise ValueError(f"Machine {name!r} has no dataset config")
+        self.name = name
+        self.dataset = dataset
+        self.model = model or copy.deepcopy(DEFAULT_MODEL)
+        self.metadata = metadata or {}
+        self.evaluation = evaluation or copy.deepcopy(DEFAULT_EVALUATION)
+        self.runtime = runtime or {}
+        self.project_name = project_name
+
+    @classmethod
+    def from_config(
+        cls,
+        config: Dict[str, Any],
+        project_name: Optional[str] = None,
+        config_globals: Optional[Dict[str, Any]] = None,
+    ) -> "Machine":
+        g = config_globals or {}
+        return cls(
+            name=config.get("name"),
+            dataset=_deep_merge(g.get("dataset", {}), config.get("dataset", {})),
+            model=config.get("model") or g.get("model"),
+            metadata=_deep_merge(
+                g.get("metadata", {}), config.get("metadata", {})
+            ),
+            evaluation=_deep_merge(
+                g.get("evaluation", {}), config.get("evaluation", {})
+            ),
+            runtime=_deep_merge(g.get("runtime", {}), config.get("runtime", {})),
+            project_name=project_name,
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "dataset": self.dataset,
+            "model": self.model,
+            "metadata": self.metadata,
+            "evaluation": self.evaluation,
+            "runtime": self.runtime,
+        }
+
+    def __repr__(self) -> str:
+        return f"Machine({self.name!r})"
+
+
+class NormalizedConfig:
+    """Parsed project config: globals overlaid onto every machine entry.
+
+    Reference equivalent: ``NormalizedConfig`` — the single source of truth
+    the builder fan-out, workflow generator, and watchman all consume.
+    """
+
+    def __init__(self, config: Dict[str, Any], project_name: str = "project"):
+        if not isinstance(config, dict) or "machines" not in config:
+            raise ValueError("Project config must be a mapping with 'machines'")
+        self.project_name = project_name
+        self.config_globals = config.get("globals", {}) or {}
+        self.machines: List[Machine] = [
+            Machine.from_config(m, project_name, self.config_globals)
+            for m in config["machines"]
+        ]
+        names = [m.name for m in self.machines]
+        dupes = {n for n in names if names.count(n) > 1}
+        if dupes:
+            raise ValueError(f"Duplicate machine names: {sorted(dupes)}")
+
+
+def load_machine_config(source: Union[str, Dict]) -> Dict[str, Any]:
+    """YAML text, file path, or dict → raw project-config dict."""
+    if isinstance(source, dict):
+        return source
+    text = source
+    if "\n" not in source and source.endswith((".yml", ".yaml")):
+        with open(source) as f:
+            text = f.read()
+    loaded = yaml.safe_load(text)
+    if not isinstance(loaded, dict):
+        raise ValueError("Project config did not parse to a mapping")
+    return loaded

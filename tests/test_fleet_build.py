@@ -1,0 +1,123 @@
+"""Project-scale fleet builds: YAML → bucketed fleet programs → per-machine
+artifacts with cache parity (reference: builder tests against
+RandomDataset + the provide_saved_model cache, SURVEY.md §5)."""
+
+import os
+
+import numpy as np
+import yaml
+
+from gordo_tpu import serializer
+from gordo_tpu.builder import build_project
+from gordo_tpu.parallel import fleet_mesh
+from gordo_tpu.workflow import NormalizedConfig, load_machine_config
+
+
+def _project_yaml(n_machines=3, epochs=2):
+    machines = "\n".join(
+        f"""
+  - name: machine-{i}
+    dataset:
+      type: RandomDataset
+      tags: [tag-a, tag-b, tag-c]
+      train_start_date: "2017-12-25T06:00:00Z"
+      train_end_date: "2017-12-27T06:00:00Z"
+"""
+        for i in range(n_machines)
+    )
+    return f"""
+machines:{machines}
+globals:
+  model:
+    gordo_tpu.anomaly.diff.DiffBasedAnomalyDetector:
+      base_estimator:
+        gordo_tpu.pipeline.Pipeline:
+          steps:
+            - gordo_tpu.ops.scalers.MinMaxScaler
+            - gordo_tpu.models.estimator.AutoEncoder:
+                kind: feedforward_hourglass
+                epochs: {epochs}
+                batch_size: 64
+"""
+
+
+class TestBuildProject:
+    def test_fleet_build_produces_per_machine_artifacts(self, tmp_path):
+        cfg = NormalizedConfig(load_machine_config(_project_yaml()), "proj")
+        out = tmp_path / "models"
+        reg = tmp_path / "registry"
+        result = build_project(
+            cfg.machines,
+            str(out),
+            model_register_dir=str(reg),
+            mesh=fleet_mesh(),
+        )
+        assert sorted(result.artifacts) == [
+            "machine-0",
+            "machine-1",
+            "machine-2",
+        ]
+        assert result.fleet_built and not result.single_built
+        assert not result.failed
+
+        for name, path in result.artifacts.items():
+            model = serializer.load(path)
+            meta = serializer.load_metadata(path)
+            assert meta["name"] == name
+            assert meta["model"]["fleet_built"] is True
+            assert "cross_validation" in meta["model"]
+            assert meta["dataset"]["tag_list"]
+            # the loaded artifact scores end-to-end
+            X = np.random.default_rng(0).standard_normal((50, 3)).astype(
+                np.float32
+            )
+            frame = model.anomaly(X)
+            assert np.isfinite(
+                frame[("total-anomaly-score", "")].to_numpy()
+            ).all()
+
+    def test_second_run_hits_cache(self, tmp_path):
+        cfg = NormalizedConfig(load_machine_config(_project_yaml(2)), "proj")
+        out, reg = str(tmp_path / "m"), str(tmp_path / "r")
+        first = build_project(cfg.machines, out, model_register_dir=reg)
+        assert len(first.fleet_built) == 2
+        second = build_project(cfg.machines, out, model_register_dir=reg)
+        assert sorted(second.cached) == ["machine-0", "machine-1"]
+        assert not second.fleet_built
+        assert second.artifacts == first.artifacts
+
+    def test_config_change_rebuilds(self, tmp_path):
+        out, reg = str(tmp_path / "m"), str(tmp_path / "r")
+        cfg1 = NormalizedConfig(load_machine_config(_project_yaml(1, epochs=2)))
+        build_project(cfg1.machines, out, model_register_dir=reg)
+        cfg2 = NormalizedConfig(load_machine_config(_project_yaml(1, epochs=3)))
+        result = build_project(cfg2.machines, out, model_register_dir=reg)
+        assert result.fleet_built == ["machine-0"]
+
+    def test_non_fleetable_model_falls_back_to_single(self, tmp_path):
+        raw = load_machine_config(_project_yaml(1))
+        # a bare pipeline (no anomaly detector) is not fleet-expressible
+        raw["globals"]["model"] = yaml.safe_load(
+            """
+gordo_tpu.pipeline.Pipeline:
+  steps:
+    - gordo_tpu.ops.scalers.MinMaxScaler
+    - gordo_tpu.models.estimator.AutoEncoder:
+        kind: feedforward_hourglass
+        epochs: 2
+"""
+        )
+        cfg = NormalizedConfig(raw)
+        result = build_project(cfg.machines, str(tmp_path / "m"))
+        assert result.single_built == ["machine-0"]
+        model = serializer.load(result.artifacts["machine-0"])
+        X = np.random.default_rng(0).standard_normal((40, 3)).astype(np.float32)
+        assert model.predict(X).shape == (40, 3)
+
+    def test_mixed_feature_counts_bucket_separately(self, tmp_path):
+        raw = load_machine_config(_project_yaml(2))
+        raw["machines"][1]["dataset"]["tags"] = ["a", "b", "c", "d", "e"]
+        cfg = NormalizedConfig(raw)
+        result = build_project(cfg.machines, str(tmp_path / "m"))
+        assert len(result.fleet_built) == 2
+        assert not result.failed

@@ -1,0 +1,76 @@
+"""Project-config normalization tests (reference:
+``tests/gordo_components/workflow/`` — globals overlay, default model
+injection, machine-name rules)."""
+
+import pytest
+
+from gordo_tpu.workflow import (
+    DEFAULT_MODEL,
+    Machine,
+    NormalizedConfig,
+    load_machine_config,
+)
+
+PROJECT_YAML = """
+machines:
+  - name: machine-one
+    dataset:
+      tags: [tag-1, tag-2]
+      train_start_date: "2020-01-01T00:00:00Z"
+      train_end_date: "2020-02-01T00:00:00Z"
+  - name: machine-two
+    dataset:
+      tags: [tag-3, tag-4]
+      train_start_date: "2020-01-01T00:00:00Z"
+      train_end_date: "2020-02-01T00:00:00Z"
+    model:
+      gordo_tpu.models.estimator.AutoEncoder:
+        kind: feedforward_symmetric
+globals:
+  dataset:
+    resolution: 1h
+  metadata:
+    owner: team-a
+"""
+
+
+class TestNormalizedConfig:
+    def test_globals_overlay_and_default_model(self):
+        cfg = NormalizedConfig(load_machine_config(PROJECT_YAML), "proj")
+        assert [m.name for m in cfg.machines] == ["machine-one", "machine-two"]
+        m1, m2 = cfg.machines
+        # globals merged into every machine's dataset / metadata
+        assert m1.dataset["resolution"] == "1h"
+        assert m1.metadata == {"owner": "team-a"}
+        # default model injected when machine + globals define none
+        assert m1.model == DEFAULT_MODEL
+        # machine-level model wins
+        assert "gordo_tpu.models.estimator.AutoEncoder" in m2.model
+
+    def test_machine_overrides_beat_globals(self):
+        raw = load_machine_config(PROJECT_YAML)
+        raw["machines"][0]["dataset"]["resolution"] = "10min"
+        cfg = NormalizedConfig(raw)
+        assert cfg.machines[0].dataset["resolution"] == "10min"
+        assert cfg.machines[1].dataset["resolution"] == "1h"
+
+    @pytest.mark.parametrize(
+        "bad", ["Machine", "has_underscore", "-leading", "trailing-", "a" * 64, ""]
+    )
+    def test_invalid_names_rejected(self, bad):
+        with pytest.raises(ValueError, match="name"):
+            Machine(name=bad, dataset={"tags": ["t"]})
+
+    def test_duplicate_names_rejected(self):
+        raw = load_machine_config(PROJECT_YAML)
+        raw["machines"][1]["name"] = "machine-one"
+        with pytest.raises(ValueError, match="Duplicate"):
+            NormalizedConfig(raw)
+
+    def test_missing_machines_key(self):
+        with pytest.raises(ValueError, match="machines"):
+            NormalizedConfig({"globals": {}})
+
+    def test_machine_requires_dataset(self):
+        with pytest.raises(ValueError, match="dataset"):
+            Machine(name="ok-name", dataset={})
