@@ -1,0 +1,25 @@
+"""Model serving.
+
+Reference equivalent: ``gordo_components/server/`` — Flask app exposing
+``/gordo/v0/<project>/<machine>/{prediction, anomaly/prediction, metadata,
+healthcheck, download-model}`` over a model loaded from ``MODEL_LOCATION``.
+
+TPU-native design: the HTTP frontend is asyncio (aiohttp — Flask isn't in
+this image and a blocking WSGI stack would serialize device dispatches);
+the scoring hot path is :mod:`gordo_tpu.serve.scorer` — the whole
+scaler→model→anomaly-math pipeline fused into one jitted device program
+with request shapes padded onto a small set of compile buckets.  One server
+process can host MANY machines (``ModelCollection``), unlike the
+reference's pod-per-machine layout; the routes stay per-machine for parity.
+"""
+
+from gordo_tpu.serve.scorer import CompiledScorer, compile_scorer
+from gordo_tpu.serve.server import ModelCollection, build_app, run_server
+
+__all__ = [
+    "CompiledScorer",
+    "compile_scorer",
+    "ModelCollection",
+    "build_app",
+    "run_server",
+]

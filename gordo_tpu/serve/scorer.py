@@ -1,0 +1,235 @@
+"""Fused serving scorer: one jitted program per (model, shape-bucket).
+
+Reference equivalent: the server view path
+``server/views/base.py -> model.predict`` /
+``views/anomaly.py -> DiffBasedAnomalyDetector.anomaly`` — there a chain of
+host-side sklearn transforms, a Keras predict, and pandas frame assembly
+per request.
+
+Here the entire scoring pipeline — scaler chain, windowing, network apply,
+detector scaling, |diff|, L2 total, threshold comparison — is ONE XLA
+program of ``(X,) -> arrays``.  Request row counts are padded up to
+power-of-two buckets so the jit cache stays small (a handful of compiles
+serve any stream); padded rows are sliced off before response assembly.
+
+The structural requirements are the same as the fleet engine's
+(``parallel/anomaly.py``): pure-stats scalers + a BaseJaxEstimator.  Models
+that don't match run through their own (slower, host-side) ``.anomaly`` /
+``.predict`` methods transparently.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gordo_tpu.anomaly.base import AnomalyDetectorBase
+from gordo_tpu.anomaly.diff import DiffBasedAnomalyDetector
+from gordo_tpu.models.estimator import (
+    BaseJaxEstimator,
+    LSTMAutoEncoder,
+    LSTMForecast,
+)
+from gordo_tpu.ops.windows import make_windows
+from gordo_tpu.pipeline import Pipeline
+
+#: smallest compile bucket; requests below this pad up to it.
+MIN_BUCKET = 64
+
+
+def _bucket_rows(n: int) -> int:
+    b = MIN_BUCKET
+    while b < n:
+        b *= 2
+    return b
+
+
+def _extract_chain(model) -> Optional[Dict[str, Any]]:
+    """Pull the pure pieces out of a detector/pipeline/estimator, or None."""
+    detector = None
+    base = model
+    if isinstance(model, DiffBasedAnomalyDetector):
+        detector = model
+        base = model.base_estimator
+
+    scalers: List[Tuple[type, dict]] = []
+    if isinstance(base, Pipeline):
+        for _, step in base.steps[:-1]:
+            stats = getattr(step, "stats_", None)
+            if stats is None or type(step).apply.__qualname__.startswith(
+                "BaseTransform"
+            ):
+                return None
+            scalers.append((type(step), stats))
+        est = base._final
+    else:
+        est = base
+    if not isinstance(est, BaseJaxEstimator) or est.params_ is None:
+        return None
+    if est.module_ is None:
+        est._rebuild_module()
+
+    if isinstance(est, LSTMForecast):
+        mode, lookback = "forecast", est.lookback_window
+    elif isinstance(est, LSTMAutoEncoder):
+        mode, lookback = "ae", est.lookback_window
+    else:
+        mode, lookback = "none", 1
+
+    chain: Dict[str, Any] = {
+        "scalers": scalers,
+        "module": est.module_,
+        "params": est.params_,
+        "mode": mode,
+        "lookback": lookback,
+        "offset": est.offset,
+        "detector": None,
+    }
+    if detector is not None:
+        if detector.scaler is None or getattr(detector.scaler, "stats_", None) is None:
+            return None
+        chain["detector"] = {
+            "scaler_cls": type(detector.scaler),
+            "scaler_stats": detector.scaler.stats_,
+            "feature_thresholds": detector.feature_thresholds_,
+            "aggregate_threshold": detector.aggregate_threshold_,
+        }
+    return chain
+
+
+@partial(jax.jit, static_argnames=("module", "scaler_classes", "mode", "lookback", "det_cls", "with_anomaly"))
+def _score_program(
+    module,
+    scaler_classes,
+    mode,
+    lookback,
+    det_cls,
+    with_anomaly,
+    scaler_stats,
+    params,
+    det_stats,
+    X,
+):
+    """(X padded to bucket) -> dict of arrays; the whole pipeline fused."""
+    Xs = X
+    for cls, stats in zip(scaler_classes, scaler_stats):
+        Xs = cls.apply(stats, Xs)
+
+    if mode == "none":
+        inputs = Xs
+    elif mode == "ae":
+        inputs = make_windows(Xs, lookback)
+    else:  # forecast
+        inputs = make_windows(Xs[:-1], lookback)
+
+    pred = module.apply({"params": params}, inputs)
+    out = {"model-output": pred}
+    if with_anomaly:
+        offset = X.shape[0] - pred.shape[0]
+        y_al = X[offset:]
+        y_s = det_cls.apply(det_stats, y_al)
+        p_s = det_cls.apply(det_stats, pred)
+        tag = jnp.abs(p_s - y_s)
+        out["tag-anomaly-scores"] = tag
+        out["total-anomaly-score"] = jnp.linalg.norm(tag, axis=-1)
+    return out
+
+
+class CompiledScorer:
+    """Callable scoring surface over one model; jitted when possible."""
+
+    def __init__(self, model):
+        self.model = model
+        self.chain = _extract_chain(model)
+        self.is_anomaly = isinstance(model, AnomalyDetectorBase)
+        self.offset = getattr(model, "offset", 0)
+
+    @property
+    def fused(self) -> bool:
+        return self.chain is not None
+
+    # -- fused path ----------------------------------------------------------
+    def _run(self, X: np.ndarray, with_anomaly: bool) -> Dict[str, np.ndarray]:
+        c = self.chain
+        n = X.shape[0]
+        bucket = _bucket_rows(n)
+        if bucket != n:
+            X = np.concatenate(
+                [X, np.tile(X[-1:], (bucket - n, 1))]  # repeat-last padding
+            )
+        det = c["detector"]
+        out = _score_program(
+            c["module"],
+            tuple(cls for cls, _ in c["scalers"]),
+            c["mode"],
+            c["lookback"],
+            det["scaler_cls"] if det else None,
+            bool(with_anomaly and det),
+            tuple(stats for _, stats in c["scalers"]),
+            c["params"],
+            det["scaler_stats"] if det else None,
+            jnp.asarray(X, jnp.float32),
+        )
+        n_valid = n - self.offset
+        return {k: np.asarray(v)[:n_valid] for k, v in out.items()}
+
+    # -- public surface ------------------------------------------------------
+    def predict(self, X) -> np.ndarray:
+        X = np.asarray(X, np.float32)
+        if self.fused:
+            return self._run(X, with_anomaly=False)["model-output"]
+        return np.asarray(self.model.predict(X))
+
+    def anomaly_arrays(self, X, y: Optional[np.ndarray] = None) -> Dict[str, Any]:
+        """Anomaly scoring as plain arrays (no pandas on the hot path)."""
+        if not self.is_anomaly:
+            raise TypeError(
+                f"{type(self.model).__name__} is not an anomaly detector"
+            )
+        X = np.asarray(X, np.float32)
+        if self.fused and (y is None or y is X):
+            out = self._run(X, with_anomaly=True)
+            det = self.chain["detector"]
+            result = {
+                "model-output": out["model-output"],
+                "tag-anomaly-scores": out["tag-anomaly-scores"],
+                "total-anomaly-score": out["total-anomaly-score"],
+            }
+            if det["feature_thresholds"] is not None:
+                result["tag-anomaly-thresholds"] = np.asarray(
+                    det["feature_thresholds"]
+                )
+                result["total-anomaly-threshold"] = float(
+                    det["aggregate_threshold"]
+                )
+                result["anomaly-confidence"] = result[
+                    "total-anomaly-score"
+                ] / max(float(det["aggregate_threshold"]), 1e-12)
+            return result
+        # fallback: the model's own pandas path
+        frame = self.model.anomaly(X, y)
+        result = {
+            "model-output": frame["model-output"].to_numpy(),
+            "tag-anomaly-scores": frame["tag-anomaly-scores"].to_numpy(),
+            "total-anomaly-score": frame[("total-anomaly-score", "")].to_numpy(),
+        }
+        if ("total-anomaly-threshold", "") in frame.columns:
+            result["tag-anomaly-thresholds"] = frame[
+                "tag-anomaly-thresholds"
+            ].to_numpy()[0]
+            result["total-anomaly-threshold"] = float(
+                frame[("total-anomaly-threshold", "")].iloc[0]
+            )
+            result["anomaly-confidence"] = frame[
+                ("anomaly-confidence", "")
+            ].to_numpy()
+        return result
+
+
+def compile_scorer(model) -> CompiledScorer:
+    """Build (and warm up lazily) the serving scorer for ``model``."""
+    return CompiledScorer(model)

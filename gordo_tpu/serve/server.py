@@ -1,0 +1,279 @@
+"""Async ML server.
+
+Reference equivalent: ``gordo_components/server/server.py`` (Flask
+``build_app``/``run_server`` behind gunicorn) and
+``server/views/base.py``/``views/anomaly.py`` (the
+``/gordo/v0/<project>/<machine>/...`` routes, payload validation against
+model metadata, download-model).
+
+Differences by design:
+- aiohttp event loop instead of gunicorn worker forks: device dispatches run
+  in a thread-pool executor so the loop keeps accepting while XLA computes.
+- one process serves MANY machines (``ModelCollection``) — the reference
+  runs one pod per machine; the per-machine route shape is preserved so
+  clients cannot tell the difference.
+- scoring goes through :class:`gordo_tpu.serve.scorer.CompiledScorer` — one
+  fused jitted program per shape bucket instead of sklearn-transform hops.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+from aiohttp import web
+
+import gordo_tpu
+from gordo_tpu import serializer
+from gordo_tpu.serve.scorer import CompiledScorer
+
+logger = logging.getLogger(__name__)
+
+API_PREFIX = "/gordo/v0"
+
+COLLECTION_KEY: "web.AppKey[ModelCollection]" = web.AppKey(
+    "collection", object
+)
+
+
+class ModelEntry:
+    def __init__(self, name: str, directory: str):
+        self.name = name
+        self.directory = directory
+        self.model = serializer.load(directory)
+        self.metadata = serializer.load_metadata(directory)
+        self.scorer = CompiledScorer(self.model)
+
+    @property
+    def tags(self) -> List[str]:
+        tag_list = self.metadata.get("dataset", {}).get("tag_list") or []
+        return [t["name"] if isinstance(t, dict) else str(t) for t in tag_list]
+
+
+class ModelCollection:
+    """All machines this server hosts: ``{name: ModelEntry}``.
+
+    ``from_directory`` accepts either a single machine's artifact dir or a
+    project output dir containing one artifact dir per machine (the layout
+    ``build_project`` writes).
+    """
+
+    def __init__(self, entries: Dict[str, ModelEntry], project: str = "project"):
+        self.entries = entries
+        self.project = project
+
+    @classmethod
+    def from_directory(cls, path: str, project: str = "project") -> "ModelCollection":
+        entries: Dict[str, ModelEntry] = {}
+        if os.path.exists(os.path.join(path, serializer.MODEL_FILE)):
+            name = os.path.basename(os.path.normpath(path))
+            entries[name] = ModelEntry(name, path)
+        else:
+            for child in sorted(os.listdir(path)):
+                sub = os.path.join(path, child)
+                if os.path.exists(os.path.join(sub, serializer.MODEL_FILE)):
+                    try:
+                        entries[child] = ModelEntry(child, sub)
+                    except Exception:
+                        logger.exception("Failed to load artifact %s", sub)
+        if not entries:
+            raise FileNotFoundError(f"No model artifacts under {path!r}")
+        return cls(entries, project=project)
+
+    def get(self, name: str) -> Optional[ModelEntry]:
+        return self.entries.get(name)
+
+
+# ---------------------------------------------------------------------------
+# payload parsing / response shaping
+# ---------------------------------------------------------------------------
+
+def parse_X(payload: Any, tags: List[str]) -> np.ndarray:
+    """``{"X": ...}`` JSON → float32 matrix.  Accepts a list-of-lists or a
+    list of records keyed by tag name (reference ``server/utils.py``
+    ``@extract_X_y`` behaviors)."""
+    if not isinstance(payload, dict) or "X" not in payload:
+        raise ValueError("Payload must be a JSON object with an 'X' key")
+    X = payload["X"]
+    if isinstance(X, list) and X and isinstance(X[0], dict):
+        if not tags:
+            raise ValueError("Record-style X requires model tag metadata")
+        try:
+            X = [[rec[t] for t in tags] for rec in X]
+        except KeyError as exc:
+            raise ValueError(f"Record missing tag {exc}")
+    arr = np.asarray(X, dtype=np.float32)
+    if arr.ndim == 1:
+        arr = arr[:, None]
+    if arr.ndim != 2:
+        raise ValueError(f"X must be 2-dimensional, got shape {arr.shape}")
+    return arr
+
+
+def _jsonable(out: Dict[str, Any]) -> Dict[str, Any]:
+    return {
+        k: (v.tolist() if isinstance(v, np.ndarray) else v)
+        for k, v in out.items()
+    }
+
+
+# ---------------------------------------------------------------------------
+# handlers
+# ---------------------------------------------------------------------------
+
+def _entry_or_404(request: web.Request) -> ModelEntry:
+    collection: ModelCollection = request.app[COLLECTION_KEY]
+    entry = collection.get(request.match_info["machine"])
+    if entry is None:
+        raise web.HTTPNotFound(
+            text=f"Machine {request.match_info['machine']!r} not found"
+        )
+    return entry
+
+
+async def healthcheck(request: web.Request) -> web.Response:
+    _entry_or_404(request)
+    return web.json_response({"gordo-server-version": gordo_tpu.__version__})
+
+
+async def metadata(request: web.Request) -> web.Response:
+    entry = _entry_or_404(request)
+    return web.json_response(
+        {
+            "endpoint-metadata": {"model-name": entry.name},
+            "metadata": entry.metadata,
+        },
+        dumps=_json_dumps,
+    )
+
+
+async def prediction(request: web.Request) -> web.Response:
+    entry = _entry_or_404(request)
+    t0 = time.perf_counter()
+    try:
+        payload = await request.json()
+        X = parse_X(payload, entry.tags)
+        _validate_width(X, entry)
+    except ValueError as exc:
+        return web.json_response({"error": str(exc)}, status=400)
+    loop = asyncio.get_running_loop()
+    try:
+        out = await loop.run_in_executor(None, entry.scorer.predict, X)
+    except Exception as exc:
+        logger.exception("Prediction failed for %s", entry.name)
+        return web.json_response({"error": str(exc)}, status=500)
+    return web.json_response(
+        {
+            "data": {"model-output": out.tolist()},
+            "time-seconds": round(time.perf_counter() - t0, 6),
+        }
+    )
+
+
+async def anomaly_prediction(request: web.Request) -> web.Response:
+    entry = _entry_or_404(request)
+    if not entry.scorer.is_anomaly:
+        return web.json_response(
+            {
+                "error": "Model is not an AnomalyDetector; use /prediction"
+            },
+            status=422,
+        )
+    t0 = time.perf_counter()
+    try:
+        payload = await request.json()
+        X = parse_X(payload, entry.tags)
+        _validate_width(X, entry)
+        y = (
+            parse_X({"X": payload["y"]}, entry.tags)
+            if isinstance(payload, dict) and payload.get("y") is not None
+            else None
+        )
+    except ValueError as exc:
+        return web.json_response({"error": str(exc)}, status=400)
+    loop = asyncio.get_running_loop()
+    try:
+        out = await loop.run_in_executor(
+            None, entry.scorer.anomaly_arrays, X, y
+        )
+    except Exception as exc:
+        logger.exception("Anomaly scoring failed for %s", entry.name)
+        return web.json_response({"error": str(exc)}, status=500)
+    return web.json_response(
+        {
+            "data": _jsonable(out),
+            "time-seconds": round(time.perf_counter() - t0, 6),
+        }
+    )
+
+
+async def download_model(request: web.Request) -> web.Response:
+    entry = _entry_or_404(request)
+    return web.Response(
+        body=serializer.dumps(entry.model),
+        content_type="application/octet-stream",
+    )
+
+
+async def project_index(request: web.Request) -> web.Response:
+    collection: ModelCollection = request.app[COLLECTION_KEY]
+    return web.json_response(
+        {
+            "project-name": collection.project,
+            "machines": sorted(collection.entries),
+            "gordo-server-version": gordo_tpu.__version__,
+        }
+    )
+
+
+def _validate_width(X: np.ndarray, entry: ModelEntry) -> None:
+    tags = entry.tags
+    if tags and X.shape[1] != len(tags):
+        raise ValueError(
+            f"X has {X.shape[1]} columns; model expects {len(tags)} tags"
+        )
+
+
+def _json_dumps(obj) -> str:
+    import json
+
+    return json.dumps(obj, default=str)
+
+
+# ---------------------------------------------------------------------------
+# app factory
+# ---------------------------------------------------------------------------
+
+def build_app(collection: ModelCollection) -> web.Application:
+    app = web.Application(client_max_size=256 * 1024 * 1024)
+    app[COLLECTION_KEY] = collection
+    p = f"{API_PREFIX}/{{project}}"
+    app.router.add_get(f"{p}/", project_index)
+    app.router.add_get(f"{p}/{{machine}}/healthcheck", healthcheck)
+    app.router.add_get(f"{p}/{{machine}}/metadata", metadata)
+    app.router.add_post(f"{p}/{{machine}}/prediction", prediction)
+    app.router.add_post(f"{p}/{{machine}}/anomaly/prediction", anomaly_prediction)
+    app.router.add_get(f"{p}/{{machine}}/download-model", download_model)
+    return app
+
+
+def run_server(
+    model_dir: str,
+    host: str = "0.0.0.0",
+    port: int = 5555,
+    project: str = "project",
+) -> None:
+    """Blocking entrypoint (reference: ``gordo run-server``)."""
+    collection = ModelCollection.from_directory(model_dir, project=project)
+    logger.info(
+        "Serving %d machine(s) from %s on %s:%d",
+        len(collection.entries),
+        model_dir,
+        host,
+        port,
+    )
+    web.run_app(build_app(collection), host=host, port=port)

@@ -1,0 +1,215 @@
+"""ML-server integration tests (reference: Flask ``app.test_client()``
+against a real artifact built once per session, SURVEY.md §5 "Server
+integration"). Here: aiohttp TestClient driven through ``asyncio.run``."""
+
+import asyncio
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from gordo_tpu import serializer
+from gordo_tpu.builder import build_project
+from gordo_tpu.serve import ModelCollection, build_app
+from gordo_tpu.serve.scorer import CompiledScorer
+from gordo_tpu.workflow import NormalizedConfig
+
+PROJECT = {
+    "machines": [
+        {
+            "name": "machine-a",
+            "dataset": {
+                "type": "RandomDataset",
+                "tags": ["tag-1", "tag-2", "tag-3"],
+                "train_start_date": "2017-12-25T06:00:00Z",
+                "train_end_date": "2017-12-27T06:00:00Z",
+            },
+        },
+        {
+            "name": "machine-b",
+            "dataset": {
+                "type": "RandomDataset",
+                "tags": ["tag-1", "tag-2", "tag-3"],
+                "train_start_date": "2017-12-25T06:00:00Z",
+                "train_end_date": "2017-12-27T06:00:00Z",
+            },
+        },
+    ],
+    "globals": {
+        "model": {
+            "gordo_tpu.anomaly.diff.DiffBasedAnomalyDetector": {
+                "base_estimator": {
+                    "gordo_tpu.pipeline.Pipeline": {
+                        "steps": [
+                            "gordo_tpu.ops.scalers.MinMaxScaler",
+                            {
+                                "gordo_tpu.models.estimator.AutoEncoder": {
+                                    "kind": "feedforward_hourglass",
+                                    "epochs": 2,
+                                    "batch_size": 64,
+                                }
+                            },
+                        ]
+                    }
+                }
+            }
+        }
+    },
+}
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    out = tmp_path_factory.mktemp("artifacts")
+    cfg = NormalizedConfig(PROJECT, "testproj")
+    result = build_project(cfg.machines, str(out))
+    assert not result.failed
+    return str(out)
+
+
+def _call(model_dir, fn):
+    """Run coroutine ``fn(client)`` against a fresh test client."""
+
+    async def runner():
+        collection = ModelCollection.from_directory(model_dir, project="testproj")
+        client = TestClient(TestServer(build_app(collection)))
+        await client.start_server()
+        try:
+            return await fn(client)
+        finally:
+            await client.close()
+
+    return asyncio.run(runner())
+
+
+X_ROWS = [[0.1, 0.5, 0.9]] * 40
+
+
+class TestServerRoutes:
+    def test_project_index(self, model_dir):
+        async def fn(client):
+            resp = await client.get("/gordo/v0/testproj/")
+            assert resp.status == 200
+            return await resp.json()
+
+        body = _call(model_dir, fn)
+        assert body["machines"] == ["machine-a", "machine-b"]
+        assert body["project-name"] == "testproj"
+
+    def test_healthcheck_and_metadata(self, model_dir):
+        async def fn(client):
+            h = await client.get("/gordo/v0/testproj/machine-a/healthcheck")
+            m = await client.get("/gordo/v0/testproj/machine-a/metadata")
+            return h.status, await m.json()
+
+        status, meta = _call(model_dir, fn)
+        assert status == 200
+        assert meta["metadata"]["name"] == "machine-a"
+        assert meta["metadata"]["model"]["fleet_built"] is True
+
+    def test_unknown_machine_404(self, model_dir):
+        async def fn(client):
+            resp = await client.get("/gordo/v0/testproj/nope/healthcheck")
+            return resp.status
+
+        assert _call(model_dir, fn) == 404
+
+    def test_prediction_roundtrip(self, model_dir):
+        async def fn(client):
+            resp = await client.post(
+                "/gordo/v0/testproj/machine-a/prediction", json={"X": X_ROWS}
+            )
+            return resp.status, await resp.json()
+
+        status, body = _call(model_dir, fn)
+        assert status == 200
+        out = np.asarray(body["data"]["model-output"])
+        assert out.shape == (40, 3)
+        assert np.isfinite(out).all()
+        assert body["time-seconds"] >= 0
+
+    def test_prediction_record_payload(self, model_dir):
+        records = [{"tag-1": 0.1, "tag-2": 0.5, "tag-3": 0.9}] * 10
+
+        async def fn(client):
+            resp = await client.post(
+                "/gordo/v0/testproj/machine-a/prediction", json={"X": records}
+            )
+            return resp.status, await resp.json()
+
+        status, body = _call(model_dir, fn)
+        assert status == 200
+        assert np.asarray(body["data"]["model-output"]).shape == (10, 3)
+
+    def test_prediction_validation_errors(self, model_dir):
+        async def fn(client):
+            wrong_width = await client.post(
+                "/gordo/v0/testproj/machine-a/prediction",
+                json={"X": [[1.0, 2.0]]},
+            )
+            no_x = await client.post(
+                "/gordo/v0/testproj/machine-a/prediction", json={"nope": 1}
+            )
+            return wrong_width.status, no_x.status
+
+        assert _call(model_dir, fn) == (400, 400)
+
+    def test_anomaly_prediction(self, model_dir):
+        async def fn(client):
+            resp = await client.post(
+                "/gordo/v0/testproj/machine-a/anomaly/prediction",
+                json={"X": X_ROWS},
+            )
+            return resp.status, await resp.json()
+
+        status, body = _call(model_dir, fn)
+        assert status == 200
+        data = body["data"]
+        assert np.asarray(data["tag-anomaly-scores"]).shape == (40, 3)
+        assert len(data["total-anomaly-score"]) == 40
+        assert data["total-anomaly-threshold"] > 0
+        assert len(data["tag-anomaly-thresholds"]) == 3
+
+    def test_download_model(self, model_dir):
+        async def fn(client):
+            resp = await client.get(
+                "/gordo/v0/testproj/machine-a/download-model"
+            )
+            return resp.status, await resp.read()
+
+        status, raw = _call(model_dir, fn)
+        assert status == 200
+        model = serializer.loads(raw)
+        assert hasattr(model, "anomaly")
+
+
+class TestCompiledScorer:
+    def test_fused_matches_model_methods(self, model_dir):
+        import os
+
+        path = os.path.join(model_dir, "machine-a")
+        model = serializer.load(path)
+        scorer = CompiledScorer(model)
+        assert scorer.fused
+
+        X = np.random.default_rng(3).standard_normal((50, 3)).astype(np.float32)
+        np.testing.assert_allclose(
+            scorer.predict(X), model.predict(X), rtol=1e-5, atol=1e-6
+        )
+        out = scorer.anomaly_arrays(X)
+        frame = model.anomaly(X)
+        np.testing.assert_allclose(
+            out["total-anomaly-score"],
+            frame[("total-anomaly-score", "")].to_numpy(),
+            rtol=1e-5,
+            atol=1e-6,
+        )
+
+    def test_shape_buckets_reuse_compilation(self, model_dir):
+        import os
+
+        model = serializer.load(os.path.join(model_dir, "machine-a"))
+        scorer = CompiledScorer(model)
+        for n in (10, 40, 63, 64, 65, 200):
+            out = scorer.predict(np.zeros((n, 3), np.float32))
+            assert out.shape == (n, 3)
