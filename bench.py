@@ -1,0 +1,154 @@
+"""Driver benchmark: full fleet build throughput on the available chip(s).
+
+Measures the north-star headline (`BASELINE.json`): per-tag anomaly-detector
+builds per hour per chip — the COMPLETE build path (synthetic time-series
+assembly, scaler stats, CV folds, threshold derivation, final fit, artifact
+dump) via ``build_project``, i.e. measurement config 4 ("builder fan-out
+from machine config").  Also measures the serving anomaly-scoring rate
+(config 5) and reports it alongside.
+
+Prints exactly ONE JSON line:
+``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}``
+
+``vs_baseline`` is measured models/hour/chip divided by the north-star
+per-chip rate (10,000 models/h on 64 chips = 156.25 models/h/chip).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+#: north star: 10k models < 1h on v5e-64 → per-chip rate to match.
+NORTH_STAR_MODELS_PER_HOUR_PER_CHIP = 10_000 / 64
+NORTH_STAR_SAMPLES_PER_SEC_PER_CHIP = 100_000
+
+N_MACHINES = int(os.environ.get("BENCH_MODELS", "512"))
+N_TAGS = int(os.environ.get("BENCH_TAGS", "10"))
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def make_machines(n: int):
+    from gordo_tpu.workflow.config import Machine
+
+    # 4 days @ 10-min resolution ≈ 576 rows/machine, N_TAGS sine-mixture tags.
+    return [
+        Machine.from_config(
+            {
+                "name": f"bench-machine-{i:04d}",
+                "dataset": {
+                    "type": "RandomDataset",
+                    "tag_list": [f"tag-{i:04d}-{j}" for j in range(N_TAGS)],
+                },
+            }
+        )
+        for i in range(n)
+    ]
+
+
+def bench_build(mesh) -> float:
+    """Steady-state project-build rate in models/hour (in-process jit cache
+    warm: run once to compile, time the second identical-shape run)."""
+    from gordo_tpu.builder.fleet_build import build_project
+
+    machines = make_machines(N_MACHINES)
+    rates = []
+    for run in range(2):
+        out_dir = tempfile.mkdtemp(prefix="gordo-bench-")
+        t0 = time.perf_counter()
+        result = build_project(
+            machines, out_dir, mesh=mesh, max_bucket_size=N_MACHINES
+        )
+        dt = time.perf_counter() - t0
+        shutil.rmtree(out_dir, ignore_errors=True)
+        n_ok = len(result.artifacts)
+        if result.failed:
+            log(f"WARNING: {len(result.failed)} builds failed: "
+                f"{dict(list(result.failed.items())[:3])}")
+        if n_ok == 0:
+            raise RuntimeError("All builds failed")
+        rates.append(n_ok / dt * 3600.0)
+        log(f"build run {run}: {n_ok} machines in {dt:.2f}s "
+            f"({rates[-1]:.0f} models/h)")
+    return rates[-1]
+
+
+def bench_serving() -> float:
+    """Warm anomaly-scoring rate (sensor-samples/sec) through the fused
+    jitted scorer on one machine's detector."""
+    from gordo_tpu.builder.build_model import build_model
+    from gordo_tpu.serve.scorer import CompiledScorer
+
+    machine = make_machines(1)[0]
+    model, _ = build_model(
+        machine.name, machine.model, machine.dataset, {}, machine.evaluation
+    )
+    scorer = CompiledScorer(model)
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((8192, N_TAGS)).astype(np.float32)
+    scorer.anomaly_arrays(X, None)  # compile
+    n_iter, t0 = 20, time.perf_counter()
+    for _ in range(n_iter):
+        scorer.anomaly_arrays(X, None)
+    dt = time.perf_counter() - t0
+    samples = n_iter * X.shape[0] * X.shape[1]
+    rate = samples / dt
+    log(f"serving: {rate:,.0f} sensor-samples/s (fused={scorer.fused})")
+    return rate
+
+
+def main() -> None:
+    import jax
+
+    from gordo_tpu.parallel.mesh import fleet_mesh
+
+    devices = jax.devices()
+    n_chips = len(devices)
+    log(f"jax {jax.__version__} devices: {[d.platform for d in devices]}")
+    mesh = fleet_mesh(devices) if n_chips > 1 else None
+
+    models_per_hour = bench_build(mesh)
+    per_chip = models_per_hour / n_chips
+    try:
+        samples_per_sec = bench_serving()
+    except Exception as exc:  # serving is the secondary metric
+        log(f"serving bench failed: {exc}")
+        samples_per_sec = None
+
+    print(
+        json.dumps(
+            {
+                "metric": "per-tag anomaly-detector builds/hour/chip (full build path)",
+                "value": round(per_chip, 1),
+                "unit": "models/hour/chip",
+                "vs_baseline": round(
+                    per_chip / NORTH_STAR_MODELS_PER_HOUR_PER_CHIP, 3
+                ),
+                "n_chips": n_chips,
+                "n_machines": N_MACHINES,
+                "serving_samples_per_sec_per_chip": (
+                    None if samples_per_sec is None else round(samples_per_sec)
+                ),
+                "serving_vs_target": (
+                    None
+                    if samples_per_sec is None
+                    else round(
+                        samples_per_sec / NORTH_STAR_SAMPLES_PER_SEC_PER_CHIP, 3
+                    )
+                ),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,14 @@
+"""Prediction client & forwarding.
+
+Reference equivalent: ``gordo_components/client/`` — the bulk-scoring
+client (``Client.predict``) that discovers machine endpoints, fetches raw
+sensor data itself, POSTs chunks concurrently, and optionally forwards
+prediction frames to a sink.
+"""
+
+from gordo_tpu.client.client import Client, PredictionResult  # noqa: F401
+from gordo_tpu.client.forwarders import (  # noqa: F401
+    ForwardPredictionsIntoInflux,
+    ForwardPredictionsToDisk,
+    PredictionForwarder,
+)

@@ -1,0 +1,132 @@
+"""Prediction forwarders — sinks for scored frames.
+
+Reference equivalent: ``gordo_components/client/forwarders.py`` —
+``PredictionForwarder`` contract + ``ForwardPredictionsIntoInflux`` (batch
+writes of prediction/anomaly frames into InfluxDB measurements).
+
+The Influx forwarder is import-gated (no influxdb client in this image);
+``ForwardPredictionsToDisk`` is the always-available sink (parquet/CSV per
+machine), which doubles as the test backend.
+"""
+
+from __future__ import annotations
+
+import abc
+import logging
+import os
+from typing import Optional
+
+import pandas as pd
+
+logger = logging.getLogger(__name__)
+
+
+class PredictionForwarder(abc.ABC):
+    """Callable sink: ``forward(predictions, machine_name, metadata)``."""
+
+    @abc.abstractmethod
+    def forward(
+        self,
+        predictions: pd.DataFrame,
+        machine_name: str,
+        metadata: Optional[dict] = None,
+    ) -> None:
+        ...
+
+    def __call__(self, predictions, machine_name, metadata=None):
+        return self.forward(predictions, machine_name, metadata)
+
+
+class ForwardPredictionsToDisk(PredictionForwarder):
+    """Append scored frames under ``{base_dir}/{machine}/`` as parquet (or
+    CSV when parquet engines are unavailable)."""
+
+    def __init__(self, base_dir: str, fmt: str = "parquet"):
+        self.base_dir = base_dir
+        self.fmt = fmt
+
+    def forward(self, predictions, machine_name, metadata=None):
+        dest = os.path.join(self.base_dir, machine_name)
+        os.makedirs(dest, exist_ok=True)
+        start = predictions.index[0] if len(predictions) else "empty"
+        stamp = str(start).replace(":", "-").replace(" ", "T")
+        path = os.path.join(dest, f"predictions-{stamp}.{self.fmt}")
+        if self.fmt == "parquet":
+            try:
+                predictions.to_parquet(path)
+                return
+            except Exception:  # no parquet engine — fall through to CSV
+                path = path[: -len("parquet")] + "csv"
+        predictions.to_csv(path)
+
+
+class ForwardPredictionsIntoInflux(PredictionForwarder):
+    """Write prediction/anomaly frames into InfluxDB measurements in
+    batches (reference parity).  Requires the ``influxdb`` client package,
+    which is not baked into this image — construction raises a clear
+    ImportError when absent."""
+
+    def __init__(
+        self,
+        destination_influx_uri: Optional[str] = None,
+        destination_influx_api_key: Optional[str] = None,
+        destination_influx_recreate: bool = False,
+        n_retries: int = 5,
+    ):
+        try:
+            import influxdb  # noqa: F401
+        except ImportError as exc:
+            raise ImportError(
+                "ForwardPredictionsIntoInflux requires the 'influxdb' package, "
+                "which is not installed in this environment. Use "
+                "ForwardPredictionsToDisk or a custom PredictionForwarder."
+            ) from exc
+        from influxdb import DataFrameClient
+
+        self.n_retries = n_retries
+        uri = destination_influx_uri or ""
+        # uri format (reference): <host>:<port>/<user>:<password>/<dbname>
+        host_port, user_pass, dbname = uri.split("/")
+        host, port = host_port.split(":")
+        user, password = user_pass.split(":")
+        self.client = DataFrameClient(
+            host=host,
+            port=int(port),
+            username=user,
+            password=password,
+            database=dbname,
+            headers=(
+                {"Authorization": destination_influx_api_key}
+                if destination_influx_api_key
+                else None
+            ),
+        )
+        if destination_influx_recreate:
+            self.client.drop_database(dbname)
+            self.client.create_database(dbname)
+
+    def forward(self, predictions, machine_name, metadata=None):
+        # Flatten multi-level columns into per-measurement frames:
+        # top level (model-output / tag-anomaly-scores / ...) = measurement.
+        for top in predictions.columns.get_level_values(0).unique():
+            sub = predictions[top]
+            if isinstance(sub, pd.Series):
+                sub = sub.to_frame(name=top)
+            sub = sub.copy()
+            sub.columns = [str(c) if str(c) else top for c in sub.columns]
+            for attempt in range(self.n_retries):
+                try:
+                    self.client.write_points(
+                        sub,
+                        measurement=str(top),
+                        tags={"machine": machine_name},
+                        batch_size=10_000,
+                    )
+                    break
+                except Exception:
+                    if attempt == self.n_retries - 1:
+                        raise
+                    logger.warning(
+                        "Influx write retry %d for %s/%s",
+                        attempt + 1, machine_name, top,
+                    )

@@ -1,0 +1,81 @@
+"""HTTP coroutines with typed errors and retry.
+
+Reference equivalent: ``gordo_components/client/io.py`` — thin aiohttp
+wrappers (``fetch_json``/``post_json``) raising ``HttpUnprocessableEntity``
+/ ``ResourceGone``-style typed errors so the client loop can distinguish
+"model can't do that" from "endpoint is down".
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict, Optional
+
+import aiohttp
+
+
+class HttpUnprocessableEntity(Exception):
+    """422 — the endpoint understood the request but the model refuses it
+    (e.g. anomaly route on a non-anomaly model)."""
+
+
+class BadGordoRequest(Exception):
+    """4xx — permanent client-side error; retrying cannot help."""
+
+
+class BadGordoResponse(Exception):
+    """5xx / non-JSON — endpoint-side failure; retry may help."""
+
+
+#: statuses worth retrying (transient by convention)
+_RETRYABLE_STATUSES = {408, 425, 429, 500, 502, 503, 504}
+
+
+async def request_json(
+    session: aiohttp.ClientSession,
+    method: str,
+    url: str,
+    *,
+    json: Optional[Dict[str, Any]] = None,
+    retries: int = 3,
+    backoff: float = 0.5,
+    timeout: float = 120.0,
+) -> Dict[str, Any]:
+    """``method url`` → parsed JSON with bounded exponential-backoff retry."""
+    last_exc: Optional[Exception] = None
+    for attempt in range(retries + 1):
+        try:
+            async with session.request(
+                method,
+                url,
+                json=json,
+                timeout=aiohttp.ClientTimeout(total=timeout),
+            ) as resp:
+                if resp.status == 422:
+                    raise HttpUnprocessableEntity(await resp.text())
+                if 400 <= resp.status < 500 and resp.status not in _RETRYABLE_STATUSES:
+                    raise BadGordoRequest(
+                        f"{method} {url} -> {resp.status}: {await resp.text()}"
+                    )
+                if resp.status >= 400:
+                    raise BadGordoResponse(
+                        f"{method} {url} -> {resp.status}: {await resp.text()}"
+                    )
+                return await resp.json()
+        except (HttpUnprocessableEntity, BadGordoRequest):
+            raise
+        except (aiohttp.ClientError, asyncio.TimeoutError, BadGordoResponse) as exc:
+            last_exc = exc
+            if attempt < retries:
+                await asyncio.sleep(backoff * (2 ** attempt))
+    raise BadGordoResponse(f"{method} {url} failed after {retries + 1} attempts") from last_exc
+
+
+async def get_json(session: aiohttp.ClientSession, url: str, **kw) -> Dict[str, Any]:
+    return await request_json(session, "GET", url, **kw)
+
+
+async def post_json(
+    session: aiohttp.ClientSession, url: str, payload: Dict[str, Any], **kw
+) -> Dict[str, Any]:
+    return await request_json(session, "POST", url, json=payload, **kw)

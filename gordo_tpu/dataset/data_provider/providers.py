@@ -32,13 +32,28 @@ from gordo_tpu.utils.args import capture_args
 
 
 class RandomDataProvider(GordoBaseDataProvider):
-    """Deterministic pseudo-random series per tag (seeded by tag name)."""
+    """Deterministic pseudo-random series per tag (seeded by tag name).
+
+    Series are emitted on a regular ``frequency`` grid (default denser than
+    the dataset layer's default 10-min resolution) so every resample bucket
+    is populated and tags align after the inner join — with irregular
+    per-tag sampling, `resample → inner-join → dropna` keeps only buckets
+    where EVERY tag happens to have a sample, collapsing the matrix.
+    ``min_size``/``max_size`` bound the point count for very long ranges.
+    """
 
     @capture_args
-    def __init__(self, min_size: int = 100, max_size: int = 300, seed: int = 0):
+    def __init__(
+        self,
+        min_size: int = 100,
+        max_size: int = 50_000,
+        seed: int = 0,
+        frequency: str = "5min",
+    ):
         self.min_size = min_size
         self.max_size = max_size
         self.seed = seed
+        self.frequency = frequency
 
     def can_handle_tag(self, tag) -> bool:
         return True
@@ -57,7 +72,8 @@ class RandomDataProvider(GordoBaseDataProvider):
             rng = np.random.default_rng(
                 zlib.crc32(f"{tag.name}:{self.seed}".encode())
             )
-            n = int(rng.integers(self.min_size, self.max_size + 1))
+            n_grid = len(pd.date_range(start=from_ts, end=to_ts, freq=self.frequency))
+            n = int(np.clip(n_grid, self.min_size, self.max_size))
             index = pd.date_range(start=from_ts, end=to_ts, periods=n, name="time")
             values = rng.standard_normal(n).cumsum() * 0.1 + rng.uniform(-1, 1)
             yield pd.Series(values, index=index, name=tag.name)
