@@ -66,14 +66,14 @@ class RandomDataProvider(GordoBaseDataProvider):
         dry_run: bool = False,
     ) -> Iterable[pd.Series]:
         tags = normalize_sensor_tags(list(tag_list))
+        n_grid = int((to_ts - from_ts) // pd.Timedelta(self.frequency)) + 1
+        n = int(np.clip(n_grid, self.min_size, self.max_size))
         for tag in tags:
             # Stable digest (Python's hash() is salted per process and would
             # break cross-process reproducibility / the build cache contract).
             rng = np.random.default_rng(
                 zlib.crc32(f"{tag.name}:{self.seed}".encode())
             )
-            n_grid = len(pd.date_range(start=from_ts, end=to_ts, freq=self.frequency))
-            n = int(np.clip(n_grid, self.min_size, self.max_size))
             index = pd.date_range(start=from_ts, end=to_ts, periods=n, name="time")
             values = rng.standard_normal(n).cumsum() * 0.1 + rng.uniform(-1, 1)
             yield pd.Series(values, index=index, name=tag.name)

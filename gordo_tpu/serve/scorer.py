@@ -28,7 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from gordo_tpu.anomaly.base import AnomalyDetectorBase
-from gordo_tpu.anomaly.diff import DiffBasedAnomalyDetector
+from gordo_tpu.anomaly.diff import DiffBasedAnomalyDetector, scores_fn
 from gordo_tpu.models.estimator import (
     BaseJaxEstimator,
     LSTMAutoEncoder,
@@ -86,7 +86,6 @@ def _extract_chain(model) -> Optional[Dict[str, Any]]:
         "params": est.params_,
         "mode": mode,
         "lookback": lookback,
-        "offset": est.offset,
         "detector": None,
     }
     if detector is not None:
@@ -97,11 +96,26 @@ def _extract_chain(model) -> Optional[Dict[str, Any]]:
             "scaler_stats": detector.scaler.stats_,
             "feature_thresholds": detector.feature_thresholds_,
             "aggregate_threshold": detector.aggregate_threshold_,
+            "require_thresholds": detector.require_thresholds,
+            "window": int(detector.window or 0),
         }
     return chain
 
 
-@partial(jax.jit, static_argnames=("module", "scaler_classes", "mode", "lookback", "det_cls", "with_anomaly"))
+def _rolling_median(a: jnp.ndarray, window: int) -> jnp.ndarray:
+    """Trailing rolling median with ``min_periods=1`` — matches the pandas
+    smoothing in ``DiffBasedAnomalyDetector.anomaly`` exactly (early rows
+    take the median of however many samples exist)."""
+    squeeze = a.ndim == 1
+    if squeeze:
+        a = a[:, None]
+    pad = jnp.full((window - 1,) + a.shape[1:], jnp.nan, a.dtype)
+    windows = make_windows(jnp.concatenate([pad, a], axis=0), window)
+    out = jnp.nanmedian(windows, axis=1)
+    return out[:, 0] if squeeze else out
+
+
+@partial(jax.jit, static_argnames=("module", "scaler_classes", "mode", "lookback", "det_cls", "with_anomaly", "smooth_window"))
 def _score_program(
     module,
     scaler_classes,
@@ -109,6 +123,7 @@ def _score_program(
     lookback,
     det_cls,
     with_anomaly,
+    smooth_window,
     scaler_stats,
     params,
     det_stats,
@@ -131,11 +146,12 @@ def _score_program(
     if with_anomaly:
         offset = X.shape[0] - pred.shape[0]
         y_al = X[offset:]
-        y_s = det_cls.apply(det_stats, y_al)
-        p_s = det_cls.apply(det_stats, pred)
-        tag = jnp.abs(p_s - y_s)
+        tag, total = scores_fn(det_cls, det_stats, y_al, pred)
+        if smooth_window:
+            tag = _rolling_median(tag, smooth_window)
+            total = _rolling_median(total, smooth_window)
         out["tag-anomaly-scores"] = tag
-        out["total-anomaly-score"] = jnp.linalg.norm(tag, axis=-1)
+        out["total-anomaly-score"] = total
     return out
 
 
@@ -169,6 +185,7 @@ class CompiledScorer:
             c["lookback"],
             det["scaler_cls"] if det else None,
             bool(with_anomaly and det),
+            det["window"] if (det and with_anomaly) else 0,
             tuple(stats for _, stats in c["scalers"]),
             c["params"],
             det["scaler_stats"] if det else None,
@@ -192,8 +209,16 @@ class CompiledScorer:
             )
         X = np.asarray(X, np.float32)
         if self.fused and (y is None or y is X):
-            out = self._run(X, with_anomaly=True)
             det = self.chain["detector"]
+            if det["feature_thresholds"] is None and det["require_thresholds"]:
+                # same contract as DiffBasedAnomalyDetector.anomaly: refuse
+                # to emit unthresholded scores.
+                raise AttributeError(
+                    "DiffBasedAnomalyDetector.anomaly called with "
+                    "require_thresholds=True but cross_validate() has not "
+                    "been run to derive thresholds"
+                )
+            out = self._run(X, with_anomaly=True)
             result = {
                 "model-output": out["model-output"],
                 "tag-anomaly-scores": out["tag-anomaly-scores"],

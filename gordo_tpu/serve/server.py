@@ -106,7 +106,11 @@ def parse_X(payload: Any, tags: List[str]) -> np.ndarray:
             X = [[rec[t] for t in tags] for rec in X]
         except KeyError as exc:
             raise ValueError(f"Record missing tag {exc}")
-    arr = np.asarray(X, dtype=np.float32)
+    try:
+        arr = np.asarray(X, dtype=np.float32)
+    except (TypeError, ValueError) as exc:
+        # e.g. JSON nulls / non-numeric entries — a client error, not a 500
+        raise ValueError(f"X is not a numeric matrix: {exc}")
     if arr.ndim == 1:
         arr = arr[:, None]
     if arr.ndim != 2:
@@ -213,10 +217,10 @@ async def anomaly_prediction(request: web.Request) -> web.Response:
 
 async def download_model(request: web.Request) -> web.Response:
     entry = _entry_or_404(request)
-    return web.Response(
-        body=serializer.dumps(entry.model),
-        content_type="application/octet-stream",
-    )
+    loop = asyncio.get_running_loop()
+    # pickling a params pytree can take long enough to stall the accept loop
+    body = await loop.run_in_executor(None, serializer.dumps, entry.model)
+    return web.Response(body=body, content_type="application/octet-stream")
 
 
 async def project_index(request: web.Request) -> web.Response:

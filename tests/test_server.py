@@ -213,3 +213,71 @@ class TestCompiledScorer:
         for n in (10, 40, 63, 64, 65, 200):
             out = scorer.predict(np.zeros((n, 3), np.float32))
             assert out.shape == (n, 3)
+
+
+class TestScorerContractParity:
+    """Fused path must match DiffBasedAnomalyDetector.anomaly semantics."""
+
+    def _fitted_detector(self, sine_tags, window=None, cv=True):
+        from gordo_tpu.anomaly.diff import DiffBasedAnomalyDetector
+        from gordo_tpu.models.estimator import AutoEncoder
+        from gordo_tpu.ops.scalers import MinMaxScaler
+        from gordo_tpu.pipeline import Pipeline
+
+        det = DiffBasedAnomalyDetector(
+            base_estimator=Pipeline(
+                [MinMaxScaler(), AutoEncoder(epochs=2, batch_size=64)]
+            ),
+            window=window,
+        )
+        if cv:
+            det.cross_validate(sine_tags)
+        det.fit(sine_tags)
+        return det
+
+    def test_window_smoothing_matches_model(self, sine_tags):
+        det = self._fitted_detector(sine_tags, window=5)
+        scorer = CompiledScorer(det)
+        assert scorer.fused
+        X = sine_tags[:80]
+        out = scorer.anomaly_arrays(X)
+        frame = det.anomaly(X)
+        np.testing.assert_allclose(
+            out["total-anomaly-score"],
+            frame[("total-anomaly-score", "")].to_numpy(),
+            rtol=1e-5,
+            atol=1e-6,
+        )
+        np.testing.assert_allclose(
+            out["tag-anomaly-scores"],
+            frame["tag-anomaly-scores"].to_numpy(),
+            rtol=1e-5,
+            atol=1e-6,
+        )
+
+    def test_require_thresholds_raises_like_model(self, sine_tags):
+        det = self._fitted_detector(sine_tags, cv=False)  # no thresholds
+        scorer = CompiledScorer(det)
+        assert scorer.fused
+        with pytest.raises(AttributeError):
+            det.anomaly(sine_tags[:32])
+        with pytest.raises(AttributeError):
+            scorer.anomaly_arrays(sine_tags[:32])
+
+
+def test_non_numeric_payload_is_400(model_dir):
+    """Strings in X are a client error (400), not an unhandled 500; JSON
+    nulls coerce to NaN and propagate (reference-compatible looseness)."""
+
+    async def fn(client):
+        bad = await client.post(
+            "/gordo/v0/testproj/machine-a/prediction",
+            json={"X": [["a", "b", "c"]]},
+        )
+        nulls = await client.post(
+            "/gordo/v0/testproj/machine-a/prediction",
+            json={"X": [[1.0, None, 2.0]] * 4},
+        )
+        return bad.status, nulls.status
+
+    assert _call(model_dir, fn) == (400, 200)
