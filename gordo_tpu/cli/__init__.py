@@ -1,0 +1,3 @@
+"""Command-line interface (reference: ``gordo_components/cli/``)."""
+
+from gordo_tpu.cli.cli import gordo  # noqa: F401

@@ -1,0 +1,328 @@
+"""Bulk-scoring prediction client.
+
+Reference equivalent: ``gordo_components/client/client.py`` — ``Client``:
+discovers a project's machine endpoints, **fetches the raw sensor data
+itself** (dataset layer, using each machine's recorded dataset config),
+splits the time range into chunks, POSTs them concurrently under an asyncio
+semaphore with retry/revival, returns per-machine ``PredictionResult``s and
+optionally forwards frames to a sink.
+
+TPU-era differences: endpoints are discovered from the ML server's project
+index route (one server hosts many machines) rather than a watchman k8s
+query, and responses come from the fused jitted scorer — the wire contract
+is unchanged.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import aiohttp
+import numpy as np
+import pandas as pd
+
+from gordo_tpu.client.forwarders import PredictionForwarder
+from gordo_tpu.client.io import HttpUnprocessableEntity, get_json, post_json
+from gordo_tpu.dataset.data_provider.base import GordoBaseDataProvider
+from gordo_tpu.dataset.datasets import TimeSeriesDataset
+
+logger = logging.getLogger(__name__)
+
+API_PREFIX = "/gordo/v0"
+
+
+@dataclasses.dataclass
+class PredictionResult:
+    """Per-machine outcome (reference: ``client/utils.py::PredictionResult``)."""
+
+    name: str
+    predictions: Optional[pd.DataFrame]
+    error_messages: List[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.error_messages
+
+
+def _frame_from_payload(
+    data: Dict[str, Any], tags: List[str], index: pd.Index
+) -> pd.DataFrame:
+    """Response ``data`` dict → MultiIndex-column frame aligned to ``index``.
+
+    Mirrors the column layout of ``DiffBasedAnomalyDetector.anomaly`` /
+    ``make_base_dataframe`` so forwarders and user code see one schema
+    whether frames came from a local model or over HTTP.
+    """
+    n = None
+    for key in ("model-output", "total-anomaly-score"):
+        if key in data:
+            n = len(data[key])
+            break
+    if n is None:
+        raise ValueError(f"Response has no recognised outputs: {sorted(data)}")
+    idx = index[-n:] if len(index) >= n else pd.RangeIndex(n)
+
+    columns: Dict[Tuple[str, str], Any] = {}
+    for key, value in data.items():
+        arr = np.asarray(value)
+        if arr.ndim == 2 and arr.shape[0] == n:
+            names = tags if arr.shape[1] == len(tags) else [
+                str(i) for i in range(arr.shape[1])
+            ]
+            for j, tag in enumerate(names):
+                columns[(key, str(tag))] = arr[:, j]
+        elif arr.ndim == 1 and arr.shape[0] == n:
+            columns[(key, "")] = arr
+        elif arr.ndim == 1:  # per-tag constants (thresholds)
+            names = tags if arr.shape[0] == len(tags) else [
+                str(i) for i in range(arr.shape[0])
+            ]
+            for j, tag in enumerate(names):
+                columns[(key, str(tag))] = np.full(n, arr[j])
+        elif arr.ndim == 0:  # scalar (aggregate threshold)
+            columns[(key, "")] = np.full(n, float(arr))
+    frame = pd.DataFrame(columns, index=idx)
+    frame.columns = pd.MultiIndex.from_tuples(frame.columns)
+    return frame
+
+
+class Client:
+    """Score a project's machines over a time range.
+
+    Parameters (reference-compatible where meaningful):
+
+    - ``project``: project name (URL path segment).
+    - ``host``/``port``/``scheme`` or ``base_url``: where the ML server runs.
+    - ``batch_size``: max rows per POST (reference default 1000).
+    - ``parallelism``: concurrent in-flight requests (semaphore bound).
+    - ``forward_resampled_sensors``: unsupported reference flag, accepted
+      and ignored for config compatibility.
+    - ``data_provider``: override the provider recorded in each machine's
+      metadata (the reference requires this for providers needing creds).
+    - ``prediction_forwarder``: ``PredictionForwarder`` sink for scored
+      frames.
+    """
+
+    def __init__(
+        self,
+        project: str,
+        host: str = "localhost",
+        port: int = 5555,
+        scheme: str = "http",
+        base_url: Optional[str] = None,
+        metadata: Optional[dict] = None,
+        data_provider: Optional[GordoBaseDataProvider] = None,
+        prediction_forwarder: Optional[PredictionForwarder] = None,
+        batch_size: int = 1000,
+        parallelism: int = 10,
+        forward_resampled_sensors: bool = False,
+        n_retries: int = 3,
+        use_anomaly: bool = True,
+        timeout: float = 120.0,
+    ):
+        self.project = project
+        self.base_url = base_url or f"{scheme}://{host}:{port}"
+        self.metadata = metadata or {}
+        self.data_provider = data_provider
+        self.prediction_forwarder = prediction_forwarder
+        self.batch_size = int(batch_size)
+        self.parallelism = int(parallelism)
+        self.n_retries = int(n_retries)
+        self.use_anomaly = use_anomaly
+        self.timeout = timeout
+
+    # -- URLs ----------------------------------------------------------------
+    def _project_url(self) -> str:
+        return f"{self.base_url}{API_PREFIX}/{self.project}/"
+
+    def _machine_url(self, machine: str) -> str:
+        return f"{self.base_url}{API_PREFIX}/{self.project}/{machine}"
+
+    # -- discovery / metadata ------------------------------------------------
+    async def machine_names_async(self, session: aiohttp.ClientSession) -> List[str]:
+        body = await get_json(
+            session, self._project_url(), retries=self.n_retries, timeout=self.timeout
+        )
+        return list(body.get("machines", []))
+
+    async def machine_metadata_async(
+        self, session: aiohttp.ClientSession, machine: str
+    ) -> Dict[str, Any]:
+        body = await get_json(
+            session,
+            f"{self._machine_url(machine)}/metadata",
+            retries=self.n_retries,
+            timeout=self.timeout,
+        )
+        return body.get("metadata", {})
+
+    def machine_names(self) -> List[str]:
+        return _run(self._with_session(self.machine_names_async))
+
+    def machine_metadata(self, machine: str) -> Dict[str, Any]:
+        return _run(
+            self._with_session(self.machine_metadata_async, machine)
+        )
+
+    async def download_model_async(
+        self, session: aiohttp.ClientSession, machine: str
+    ) -> Any:
+        from gordo_tpu import serializer
+
+        async with session.get(
+            f"{self._machine_url(machine)}/download-model",
+            timeout=aiohttp.ClientTimeout(total=self.timeout),
+        ) as resp:
+            resp.raise_for_status()
+            return serializer.loads(await resp.read())
+
+    def download_model(self, machine: str) -> Any:
+        return _run(self._with_session(self.download_model_async, machine))
+
+    # -- scoring -------------------------------------------------------------
+    def predict(
+        self,
+        start: Any,
+        end: Any,
+        machine_names: Optional[Sequence[str]] = None,
+    ) -> List[PredictionResult]:
+        """Fetch data for ``[start, end]``, score every machine, return one
+        ``PredictionResult`` per machine (reference: ``Client.predict``)."""
+        return _run(self.predict_async(start, end, machine_names))
+
+    async def predict_async(
+        self,
+        start: Any,
+        end: Any,
+        machine_names: Optional[Sequence[str]] = None,
+    ) -> List[PredictionResult]:
+        sem = asyncio.Semaphore(self.parallelism)
+        async with aiohttp.ClientSession() as session:
+            names = (
+                list(machine_names)
+                if machine_names
+                else await self.machine_names_async(session)
+            )
+            tasks = [
+                self._predict_machine(session, sem, name, start, end)
+                for name in names
+            ]
+            return list(await asyncio.gather(*tasks))
+
+    async def _predict_machine(
+        self,
+        session: aiohttp.ClientSession,
+        sem: asyncio.Semaphore,
+        machine: str,
+        start: Any,
+        end: Any,
+    ) -> PredictionResult:
+        loop = asyncio.get_running_loop()
+        try:
+            meta = await self.machine_metadata_async(session, machine)
+            X = await loop.run_in_executor(
+                None, self._fetch_data, meta.get("dataset", {}), start, end
+            )
+        except Exception as exc:
+            logger.exception("Data fetch failed for %s", machine)
+            return PredictionResult(machine, None, [f"data: {exc}"])
+
+        route = "anomaly/prediction" if self.use_anomaly else "prediction"
+        chunks = [
+            X.iloc[i : i + self.batch_size]
+            for i in range(0, len(X), self.batch_size)
+        ]
+        tags = [str(c) for c in X.columns]
+
+        async def score_chunk(chunk: pd.DataFrame):
+            payload = {"X": chunk.to_numpy(dtype=np.float32).tolist()}
+            url = f"{self._machine_url(machine)}/{route}"
+            async with sem:
+                try:
+                    body = await post_json(
+                        session, url, payload,
+                        retries=self.n_retries, timeout=self.timeout,
+                    )
+                except HttpUnprocessableEntity:
+                    # not an anomaly model — retry on the plain route
+                    body = await post_json(
+                        session,
+                        f"{self._machine_url(machine)}/prediction",
+                        payload,
+                        retries=self.n_retries,
+                        timeout=self.timeout,
+                    )
+            return _frame_from_payload(body["data"], tags, chunk.index)
+
+        frames: List[pd.DataFrame] = []
+        errors: List[str] = []
+        results = await asyncio.gather(
+            *(score_chunk(c) for c in chunks if len(c)), return_exceptions=True
+        )
+        for res in results:
+            if isinstance(res, BaseException):
+                errors.append(str(res))
+            else:
+                frames.append(res)
+
+        predictions = pd.concat(frames).sort_index() if frames else None
+        if predictions is not None and self.prediction_forwarder is not None:
+            try:
+                await loop.run_in_executor(
+                    None, self.prediction_forwarder, predictions, machine, meta
+                )
+            except Exception as exc:
+                # a sink failure must not sink the scoring result (nor the
+                # other machines' gathered results)
+                logger.exception("Forwarding failed for %s", machine)
+                errors.append(f"forwarder: {exc}")
+        return PredictionResult(machine, predictions, errors)
+
+    # -- data fetch (host-side, reference behavior: client refetches raw) ----
+    def _fetch_data(
+        self, dataset_meta: Dict[str, Any], start: Any, end: Any
+    ) -> pd.DataFrame:
+        tag_list = [
+            t["name"] if isinstance(t, dict) else str(t)
+            for t in dataset_meta.get("tag_list", [])
+        ]
+        if not tag_list:
+            raise ValueError("Machine metadata has no dataset.tag_list")
+        provider = self.data_provider
+        if provider is None:
+            dp_cfg = dataset_meta.get("data_provider")
+            if not dp_cfg:
+                raise ValueError(
+                    "No data_provider in machine metadata and none supplied "
+                    "to Client(data_provider=...)"
+                )
+            provider = GordoBaseDataProvider.from_dict(dict(dp_cfg))
+        dataset = TimeSeriesDataset(
+            train_start_date=start,
+            train_end_date=end,
+            tag_list=tag_list,
+            resolution=dataset_meta.get("resolution", "10min"),
+            data_provider=provider,
+        )
+        X, _ = dataset.get_data()
+        return X
+
+    # -- plumbing ------------------------------------------------------------
+    async def _with_session(self, fn, *args):
+        async with aiohttp.ClientSession() as session:
+            return await fn(session, *args)
+
+
+def _run(coro):
+    """Run a coroutine from sync code (error out inside a running loop)."""
+    try:
+        asyncio.get_running_loop()
+    except RuntimeError:
+        return asyncio.run(coro)
+    raise RuntimeError(
+        "Client sync methods cannot be called from inside a running event "
+        "loop; use the *_async variants"
+    )

@@ -55,7 +55,7 @@ class ForwardPredictionsToDisk(PredictionForwarder):
             try:
                 predictions.to_parquet(path)
                 return
-            except Exception:  # no parquet engine — fall through to CSV
+            except ImportError:  # no parquet engine — fall through to CSV
                 path = path[: -len("parquet")] + "csv"
         predictions.to_csv(path)
 
@@ -86,9 +86,15 @@ class ForwardPredictionsIntoInflux(PredictionForwarder):
         self.n_retries = n_retries
         uri = destination_influx_uri or ""
         # uri format (reference): <host>:<port>/<user>:<password>/<dbname>
-        host_port, user_pass, dbname = uri.split("/")
-        host, port = host_port.split(":")
-        user, password = user_pass.split(":")
+        parts = uri.split("/")
+        if len(parts) != 3 or ":" not in parts[0] or ":" not in parts[1]:
+            raise ValueError(
+                "destination_influx_uri must look like "
+                f"'<host>:<port>/<user>:<password>/<dbname>', got {uri!r}"
+            )
+        host_port, user_pass, dbname = parts
+        host, port = host_port.rsplit(":", 1)   # IPv6-safe
+        user, password = user_pass.split(":", 1)  # ':' allowed in password
         self.client = DataFrameClient(
             host=host,
             port=int(port),

@@ -66,7 +66,8 @@ class RandomDataProvider(GordoBaseDataProvider):
         dry_run: bool = False,
     ) -> Iterable[pd.Series]:
         tags = normalize_sensor_tags(list(tag_list))
-        n_grid = int((to_ts - from_ts) // pd.Timedelta(self.frequency)) + 1
+        step = pd.tseries.frequencies.to_offset(self.frequency).nanos
+        n_grid = int((to_ts - from_ts).value // step) + 1
         n = int(np.clip(n_grid, self.min_size, self.max_size))
         for tag in tags:
             # Stable digest (Python's hash() is salted per process and would

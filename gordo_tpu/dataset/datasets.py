@@ -61,8 +61,12 @@ class TimeSeriesDataset(GordoBaseDataset):
         row_filter_buffer_size: int = 0,
         n_samples_threshold: int = 0,
         asset: Optional[str] = None,
+        tags: Optional[List] = None,
         **_ignored,
     ):
+        # project-YAML spelling (reference config uses ``tags:``)
+        if tag_list is None and tags is not None:
+            tag_list = tags
         if train_start_date is None or train_end_date is None:
             raise ValueError("train_start_date and train_end_date are required")
         self.train_start_date = _to_timestamp(train_start_date)
@@ -190,10 +194,12 @@ class RandomDataset(TimeSeriesDataset):
         **kwargs,
     ):
         kwargs.pop("data_provider", None)
+        if not tag_list and not kwargs.get("tags"):
+            tag_list = ["tag-1", "tag-2", "tag-3"]
         super().__init__(
             train_start_date=train_start_date,
             train_end_date=train_end_date,
-            tag_list=tag_list or ["tag-1", "tag-2", "tag-3"],
+            tag_list=tag_list,
             data_provider=RandomDataProvider(),
             **kwargs,
         )

@@ -208,7 +208,14 @@ class CompiledScorer:
                 f"{type(self.model).__name__} is not an anomaly detector"
             )
         X = np.asarray(X, np.float32)
-        if self.fused and (y is None or y is X):
+        use_fused = self.fused and (y is None or y is X)
+        if use_fused and self.chain["detector"]["window"]:
+            # smoothing materializes an (n, window, tags) tensor on device;
+            # bound it (~512MB of f32) and fall back to the host path beyond
+            det_w = self.chain["detector"]["window"]
+            if _bucket_rows(X.shape[0]) * det_w * max(X.shape[1], 1) > 2 ** 27:
+                use_fused = False
+        if use_fused:
             det = self.chain["detector"]
             if det["feature_thresholds"] is None and det["require_thresholds"]:
                 # same contract as DiffBasedAnomalyDetector.anomaly: refuse

@@ -1,0 +1,13 @@
+"""Watchman — per-project fleet status service.
+
+Reference equivalent: ``gordo_components/watchman/`` — a service that knows
+the project's expected machine list and continuously polls every machine
+endpoint's ``/healthcheck`` + ``/metadata``, aggregating into one
+``GET /`` JSON status document consumed by dashboards and the client.
+"""
+
+from gordo_tpu.watchman.endpoints_status import (  # noqa: F401
+    EndpointStatus,
+    poll_endpoints,
+)
+from gordo_tpu.watchman.server import Watchman, build_watchman_app, run_watchman  # noqa: F401

@@ -1,0 +1,116 @@
+"""Endpoint polling.
+
+Reference equivalent: ``gordo_components/watchman/endpoints_status.py`` —
+build the expected endpoint list from the project config's machine names,
+poll each ML server's ``/healthcheck`` and ``/metadata``, and record
+per-endpoint health + metadata.  The reference watched kubernetes events
+to discover pods; here the server list is explicit config (one TPU-host
+server serves many machines) and discovery is a poll of each server's
+project index.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import aiohttp
+
+API_PREFIX = "/gordo/v0"
+
+
+@dataclasses.dataclass
+class EndpointStatus:
+    """One machine endpoint's latest observed state."""
+
+    machine: str
+    endpoint: str                       # path under the ambassador/base url
+    base_url: Optional[str]             # which server answered (None: nobody)
+    healthy: bool
+    metadata: Dict[str, Any]
+    last_checked: float
+    last_seen: Optional[float] = None
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "endpoint": self.endpoint,
+            "target-name": self.machine,
+            "healthy": self.healthy,
+            "endpoint-metadata": self.metadata,
+            "last-checked": self.last_checked,
+            "last-seen": self.last_seen,
+        }
+
+
+async def _check_one(
+    session: aiohttp.ClientSession,
+    project: str,
+    machine: str,
+    base_urls: Sequence[str],
+    timeout: float,
+) -> EndpointStatus:
+    path = f"{API_PREFIX}/{project}/{machine}/"
+    now = time.time()
+    for base in base_urls:
+        try:
+            async with session.get(
+                f"{base}{path}healthcheck",
+                timeout=aiohttp.ClientTimeout(total=timeout),
+            ) as resp:
+                if resp.status != 200:
+                    continue
+            # healthcheck decided health; a slow/failed metadata fetch only
+            # degrades metadata, it must not flip the machine unhealthy
+            try:
+                async with session.get(
+                    f"{base}{path}metadata",
+                    timeout=aiohttp.ClientTimeout(total=timeout),
+                ) as resp:
+                    meta = await resp.json() if resp.status == 200 else {}
+            except (aiohttp.ClientError, asyncio.TimeoutError):
+                meta = {}
+            return EndpointStatus(
+                machine=machine,
+                endpoint=path,
+                base_url=base,
+                healthy=True,
+                metadata=meta,
+                last_checked=now,
+                last_seen=now,
+            )
+        except (aiohttp.ClientError, asyncio.TimeoutError):
+            continue
+    return EndpointStatus(
+        machine=machine,
+        endpoint=path,
+        base_url=None,
+        healthy=False,
+        metadata={},
+        last_checked=now,
+    )
+
+
+async def poll_endpoints(
+    project: str,
+    machines: Sequence[str],
+    base_urls: Sequence[str],
+    timeout: float = 5.0,
+    session: Optional[aiohttp.ClientSession] = None,
+) -> List[EndpointStatus]:
+    """Poll every machine endpoint once, concurrently."""
+    own_session = session is None
+    session = session or aiohttp.ClientSession()
+    try:
+        return list(
+            await asyncio.gather(
+                *(
+                    _check_one(session, project, m, base_urls, timeout)
+                    for m in machines
+                )
+            )
+        )
+    finally:
+        if own_session:
+            await session.close()

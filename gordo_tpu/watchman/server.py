@@ -1,0 +1,143 @@
+"""Watchman HTTP service.
+
+Reference equivalent: ``gordo_components/watchman/server.py`` — Flask app
+whose ``GET /`` returns the aggregate project status JSON
+(``{project-name, endpoints: [{endpoint, healthy, endpoint-metadata}]}``)
+built by background polling threads.  Here: one aiohttp app with an
+asyncio background poller (no thread pool needed), same response schema.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Dict, List, Optional, Sequence
+
+from aiohttp import web
+
+import gordo_tpu
+from gordo_tpu.watchman.endpoints_status import EndpointStatus, poll_endpoints
+
+logger = logging.getLogger(__name__)
+
+WATCHMAN_KEY: "web.AppKey[Watchman]" = web.AppKey("watchman", object)
+
+
+class Watchman:
+    """Holds the latest per-endpoint statuses, refreshed by a background
+    task every ``poll_interval`` seconds."""
+
+    def __init__(
+        self,
+        project: str,
+        machines: Sequence[str],
+        target_base_urls: Sequence[str],
+        poll_interval: float = 30.0,
+        request_timeout: float = 5.0,
+        namespace: Optional[str] = None,
+    ):
+        self.project = project
+        self.machines = list(machines)
+        self.target_base_urls = list(target_base_urls)
+        self.poll_interval = poll_interval
+        self.request_timeout = request_timeout
+        self.namespace = namespace
+        self.started_at = time.time()
+        self.statuses: Dict[str, EndpointStatus] = {}
+        self._task: Optional[asyncio.Task] = None
+
+    async def refresh(self) -> List[EndpointStatus]:
+        statuses = await poll_endpoints(
+            self.project,
+            self.machines,
+            self.target_base_urls,
+            timeout=self.request_timeout,
+        )
+        for status in statuses:
+            prev = self.statuses.get(status.machine)
+            if not status.healthy and prev is not None:
+                status.last_seen = prev.last_seen
+            self.statuses[status.machine] = status
+        return statuses
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                await self.refresh()
+            except Exception:
+                logger.exception("Watchman poll cycle failed")
+            await asyncio.sleep(self.poll_interval)
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    def to_json(self) -> Dict:
+        return {
+            "project-name": self.project,
+            "gordo-server-version": gordo_tpu.__version__,
+            "uptime-seconds": round(time.time() - self.started_at, 1),
+            "target-base-urls": self.target_base_urls,
+            "endpoints": [
+                self.statuses[m].to_json()
+                for m in self.machines
+                if m in self.statuses
+            ],
+        }
+
+
+async def _index(request: web.Request) -> web.Response:
+    watchman: Watchman = request.app[WATCHMAN_KEY]
+    if not watchman.statuses:  # first request before the poller has run
+        await watchman.refresh()
+    return web.json_response(watchman.to_json())
+
+
+async def _healthcheck(request: web.Request) -> web.Response:
+    return web.json_response({"gordo-server-version": gordo_tpu.__version__})
+
+
+def build_watchman_app(watchman: Watchman) -> web.Application:
+    app = web.Application()
+    app[WATCHMAN_KEY] = watchman
+
+    async def _start(app):
+        watchman.start()
+
+    async def _stop(app):
+        await watchman.stop()
+
+    app.on_startup.append(_start)
+    app.on_cleanup.append(_stop)
+    app.router.add_get("/", _index)
+    app.router.add_get("/healthcheck", _healthcheck)
+    return app
+
+
+def run_watchman(
+    project: str,
+    machines: Sequence[str],
+    target_base_urls: Sequence[str],
+    host: str = "0.0.0.0",
+    port: int = 5556,
+    poll_interval: float = 30.0,
+) -> None:
+    """Blocking entrypoint (reference: ``gordo run-watchman``)."""
+    watchman = Watchman(
+        project, machines, target_base_urls, poll_interval=poll_interval
+    )
+    logger.info(
+        "Watchman for project %r: %d machines, %d targets, every %.0fs",
+        project, len(machines), len(target_base_urls), poll_interval,
+    )
+    web.run_app(build_watchman_app(watchman), host=host, port=port)

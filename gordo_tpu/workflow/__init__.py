@@ -13,5 +13,20 @@ from gordo_tpu.workflow.config import (
     NormalizedConfig,
     load_machine_config,
 )
+from gordo_tpu.workflow.generator import (
+    build_plan,
+    generate_workflow,
+    unique_tags,
+    workflow_to_yaml,
+)
 
-__all__ = ["DEFAULT_MODEL", "Machine", "NormalizedConfig", "load_machine_config"]
+__all__ = [
+    "DEFAULT_MODEL",
+    "Machine",
+    "NormalizedConfig",
+    "load_machine_config",
+    "build_plan",
+    "generate_workflow",
+    "unique_tags",
+    "workflow_to_yaml",
+]

@@ -1,0 +1,132 @@
+"""CLI tests via click.testing.CliRunner (reference pattern: CLI tests
+drive `build`/`workflow generate` with env vars, SURVEY.md §5)."""
+
+import json
+import os
+
+import yaml
+from click.testing import CliRunner
+
+from gordo_tpu.cli.cli import gordo
+
+DATA_CONFIG = {
+    "type": "RandomDataset",
+    "tags": ["cli-1", "cli-2"],
+    "train_start_date": "2017-12-25T06:00:00Z",
+    "train_end_date": "2017-12-26T06:00:00Z",
+}
+
+MODEL_CONFIG = {
+    "gordo_tpu.anomaly.diff.DiffBasedAnomalyDetector": {
+        "base_estimator": {
+            "gordo_tpu.pipeline.Pipeline": {
+                "steps": [
+                    "gordo_tpu.ops.scalers.MinMaxScaler",
+                    {"gordo_tpu.models.estimator.AutoEncoder": {
+                        "kind": "feedforward_hourglass",
+                        "epochs": 1,
+                        "batch_size": 64,
+                    }},
+                ]
+            }
+        }
+    }
+}
+
+PROJECT_YAML = {
+    "machines": [
+        {"name": "cli-machine", "dataset": DATA_CONFIG},
+    ],
+    "globals": {"model": MODEL_CONFIG},
+}
+
+
+def test_build_with_env_vars(tmp_path):
+    out = tmp_path / "models"
+    runner = CliRunner()
+    result = runner.invoke(
+        gordo,
+        ["build", str(out)],
+        env={
+            "MACHINE_NAME": "env-machine",
+            "MODEL_CONFIG": json.dumps(MODEL_CONFIG),
+            "DATA_CONFIG": json.dumps(DATA_CONFIG),
+        },
+    )
+    assert result.exit_code == 0, result.output
+    artifact = result.output.strip().splitlines()[-1]
+    assert os.path.isdir(artifact)
+    assert os.path.exists(os.path.join(artifact, "model.pkl"))
+
+
+def test_build_print_cv_scores_and_cache(tmp_path):
+    out = tmp_path / "models"
+    reg = tmp_path / "register"
+    runner = CliRunner()
+    args = [
+        "build", str(out),
+        "--name", "cvm",
+        "--model-config", json.dumps(MODEL_CONFIG),
+        "--data-config", json.dumps(DATA_CONFIG),
+        "--model-register-dir", str(reg),
+        "--print-cv-scores",
+    ]
+    first = runner.invoke(gordo, args)
+    assert first.exit_code == 0, first.output
+    assert "explained_variance_score" in first.output
+    # second run: cache hit, same artifact path
+    second = runner.invoke(gordo, args)
+    assert second.exit_code == 0
+    assert first.output.strip().splitlines()[-1] == second.output.strip().splitlines()[-1]
+
+
+def test_build_project_cli(tmp_path):
+    cfg = tmp_path / "project.yaml"
+    cfg.write_text(yaml.safe_dump(PROJECT_YAML))
+    out = tmp_path / "models"
+    runner = CliRunner()
+    result = runner.invoke(
+        gordo,
+        ["build-project", "--machine-config", str(cfg),
+         "--output-dir", str(out), "--project-name", "cliproj"],
+    )
+    assert result.exit_code == 0, result.output
+    summary = json.loads(result.output.strip().splitlines()[-1])
+    assert summary["n_machines"] == 1
+    assert not summary["failed"]
+    assert os.path.isdir(out / "cli-machine")
+
+
+def test_workflow_generate_and_unique_tags(tmp_path):
+    cfg = tmp_path / "project.yaml"
+    cfg.write_text(yaml.safe_dump(PROJECT_YAML))
+    runner = CliRunner()
+
+    gen = runner.invoke(
+        gordo,
+        ["workflow", "generate", "--machine-config", str(cfg),
+         "--project-name", "wfproj"],
+    )
+    assert gen.exit_code == 0, gen.output
+    docs = list(yaml.safe_load_all(gen.output))
+    assert any(d["kind"] == "Job" for d in docs)
+
+    tags = runner.invoke(
+        gordo, ["workflow", "unique-tags", "--machine-config", str(cfg)]
+    )
+    assert tags.exit_code == 0
+    assert tags.output.split() == ["cli-1", "cli-2"]
+
+    plan = runner.invoke(
+        gordo, ["workflow", "plan", "--machine-config", str(cfg)]
+    )
+    assert plan.exit_code == 0
+    assert yaml.safe_load(plan.output)["n_buckets"] == 1
+
+
+def test_help_lists_all_verbs():
+    runner = CliRunner()
+    result = runner.invoke(gordo, ["--help"])
+    for verb in ("build", "build-project", "run-server", "run-watchman",
+                 "client", "workflow"):
+        assert verb in result.output

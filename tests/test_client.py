@@ -1,0 +1,177 @@
+"""Client integration tests against an in-process ML server.
+
+Reference pattern (SURVEY.md §5): client tests run against real server
+code with the RandomDataProvider-style synthetic backend — no external
+services.
+"""
+
+import asyncio
+
+import numpy as np
+import pandas as pd
+import pytest
+from aiohttp import web
+
+from gordo_tpu.builder import build_project
+from gordo_tpu.client import Client, ForwardPredictionsToDisk, PredictionResult
+from gordo_tpu.client.client import _frame_from_payload
+from gordo_tpu.serve import ModelCollection, build_app
+from gordo_tpu.workflow import NormalizedConfig
+
+PROJECT = {
+    "machines": [
+        {"name": "client-machine-a", "dataset": {
+            "type": "RandomDataset",
+            "tags": ["ct-1", "ct-2", "ct-3"],
+            "train_start_date": "2017-12-25T06:00:00Z",
+            "train_end_date": "2017-12-27T06:00:00Z",
+        }},
+        {"name": "client-machine-b", "dataset": {
+            "type": "RandomDataset",
+            "tags": ["ct-4", "ct-5", "ct-6"],
+            "train_start_date": "2017-12-25T06:00:00Z",
+            "train_end_date": "2017-12-27T06:00:00Z",
+        }},
+    ],
+    "globals": {
+        "model": {
+            "gordo_tpu.anomaly.diff.DiffBasedAnomalyDetector": {
+                "base_estimator": {
+                    "gordo_tpu.pipeline.Pipeline": {
+                        "steps": [
+                            "gordo_tpu.ops.scalers.MinMaxScaler",
+                            {"gordo_tpu.models.estimator.AutoEncoder": {
+                                "kind": "feedforward_hourglass",
+                                "epochs": 2,
+                                "batch_size": 64,
+                            }},
+                        ]
+                    }
+                }
+            }
+        }
+    },
+}
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    out = tmp_path_factory.mktemp("client-artifacts")
+    result = build_project(NormalizedConfig(PROJECT, "cliproj").machines, str(out))
+    assert not result.failed
+    return str(out)
+
+
+def _serve_and(model_dir, fn):
+    """Start a real aiohttp server on an ephemeral port, run ``fn(port)``
+    in a worker thread (the sync Client API), return its result."""
+
+    async def runner():
+        collection = ModelCollection.from_directory(model_dir, project="cliproj")
+        app_runner = web.AppRunner(build_app(collection))
+        await app_runner.setup()
+        site = web.TCPSite(app_runner, "127.0.0.1", 0)
+        await site.start()
+        port = app_runner.addresses[0][1]
+        try:
+            return await asyncio.get_running_loop().run_in_executor(
+                None, fn, port
+            )
+        finally:
+            await app_runner.cleanup()
+
+    return asyncio.run(runner())
+
+
+class TestClient:
+    def test_discovery_and_metadata(self, model_dir):
+        def run(port):
+            c = Client("cliproj", port=port)
+            names = c.machine_names()
+            meta = c.machine_metadata(names[0])
+            return names, meta
+
+        names, meta = _serve_and(model_dir, run)
+        assert names == ["client-machine-a", "client-machine-b"]
+        assert meta["name"] == "client-machine-a"
+        assert [t["name"] for t in meta["dataset"]["tag_list"]] == [
+            "ct-1", "ct-2", "ct-3",
+        ]
+
+    def test_predict_full_project(self, model_dir):
+        def run(port):
+            return Client("cliproj", port=port, batch_size=100).predict(
+                "2017-12-27T06:00:00Z", "2017-12-28T06:00:00Z"
+            )
+
+        results = _serve_and(model_dir, run)
+        assert len(results) == 2
+        for res in results:
+            assert isinstance(res, PredictionResult)
+            assert res.ok, res.error_messages
+            frame = res.predictions
+            # 24h at 10min resolution, several 100-row chunks reassembled
+            assert len(frame) == 145
+            assert frame.index.is_monotonic_increasing
+            total = frame[("total-anomaly-score", "")].to_numpy()
+            assert np.isfinite(total).all()
+            assert ("tag-anomaly-scores", "ct-1") in frame.columns or (
+                "tag-anomaly-scores", "ct-4") in frame.columns
+            assert ("total-anomaly-threshold", "") in frame.columns
+
+    def test_predict_forwards(self, model_dir, tmp_path):
+        sink = tmp_path / "sink"
+
+        def run(port):
+            return Client(
+                "cliproj",
+                port=port,
+                prediction_forwarder=ForwardPredictionsToDisk(str(sink)),
+            ).predict(
+                "2017-12-27T06:00:00Z",
+                "2017-12-27T12:00:00Z",
+                machine_names=["client-machine-a"],
+            )
+
+        results = _serve_and(model_dir, run)
+        assert results[0].ok
+        files = list((sink / "client-machine-a").iterdir())
+        assert len(files) == 1
+        stored = pd.read_csv(files[0]) if files[0].suffix == ".csv" else pd.read_parquet(files[0])
+        assert len(stored) == len(results[0].predictions)
+
+    def test_download_model(self, model_dir):
+        def run(port):
+            return Client("cliproj", port=port).download_model("client-machine-a")
+
+        model = _serve_and(model_dir, run)
+        assert hasattr(model, "anomaly")
+
+    def test_unknown_machine_reports_error(self, model_dir):
+        def run(port):
+            return Client("cliproj", port=port).predict(
+                "2017-12-27T06:00:00Z",
+                "2017-12-27T12:00:00Z",
+                machine_names=["nope"],
+            )
+
+        results = _serve_and(model_dir, run)
+        assert not results[0].ok
+        assert results[0].predictions is None
+
+
+def test_frame_from_payload_shapes():
+    data = {
+        "model-output": np.ones((5, 2)).tolist(),
+        "tag-anomaly-scores": np.ones((5, 2)).tolist(),
+        "total-anomaly-score": np.ones(5).tolist(),
+        "tag-anomaly-thresholds": [0.5, 0.7],
+        "total-anomaly-threshold": 0.9,
+    }
+    idx = pd.date_range("2020-01-01", periods=7, freq="10min")
+    frame = _frame_from_payload(data, ["a", "b"], idx)
+    assert len(frame) == 5
+    # aligned to the TAIL of the index (offset rows consumed at the front)
+    assert frame.index[0] == idx[2]
+    assert frame[("tag-anomaly-thresholds", "b")].iloc[0] == 0.7
+    assert frame[("total-anomaly-threshold", "")].iloc[-1] == 0.9

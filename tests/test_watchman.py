@@ -1,0 +1,104 @@
+"""Watchman tests — polling against a real in-process ML server (the
+reference mocked kubernetes; we have no k8s layer to mock, the server
+list is explicit config)."""
+
+import asyncio
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from gordo_tpu.builder import build_project
+from gordo_tpu.serve import ModelCollection, build_app
+from gordo_tpu.watchman import Watchman, build_watchman_app
+from gordo_tpu.workflow import NormalizedConfig
+
+PROJECT = {
+    "machines": [
+        {"name": "wm-machine", "dataset": {
+            "type": "RandomDataset",
+            "tags": ["w-1", "w-2"],
+            "train_start_date": "2017-12-25T06:00:00Z",
+            "train_end_date": "2017-12-26T06:00:00Z",
+        }},
+    ],
+    "globals": {
+        "model": {
+            "gordo_tpu.anomaly.diff.DiffBasedAnomalyDetector": {
+                "base_estimator": {
+                    "gordo_tpu.pipeline.Pipeline": {
+                        "steps": [
+                            "gordo_tpu.ops.scalers.MinMaxScaler",
+                            {"gordo_tpu.models.estimator.AutoEncoder": {
+                                "kind": "feedforward_hourglass",
+                                "epochs": 1,
+                                "batch_size": 64,
+                            }},
+                        ]
+                    }
+                }
+            }
+        }
+    },
+}
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    out = tmp_path_factory.mktemp("wm-artifacts")
+    result = build_project(NormalizedConfig(PROJECT, "wmproj").machines, str(out))
+    assert not result.failed
+    return str(out)
+
+
+def test_watchman_aggregates_status(model_dir):
+    async def main():
+        # real ML server on an ephemeral port
+        collection = ModelCollection.from_directory(model_dir, project="wmproj")
+        runner = web.AppRunner(build_app(collection))
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = runner.addresses[0][1]
+
+        watchman = Watchman(
+            "wmproj",
+            machines=["wm-machine", "missing-machine"],
+            target_base_urls=[f"http://127.0.0.1:{port}"],
+            poll_interval=3600,  # poll on demand only
+        )
+        client = TestClient(TestServer(build_watchman_app(watchman)))
+        await client.start_server()
+        try:
+            resp = await client.get("/")
+            assert resp.status == 200
+            body = await resp.json()
+        finally:
+            await client.close()
+            await runner.cleanup()
+        return body
+
+    body = asyncio.run(main())
+    assert body["project-name"] == "wmproj"
+    by_name = {e["target-name"]: e for e in body["endpoints"]}
+    assert by_name["wm-machine"]["healthy"] is True
+    assert (
+        by_name["wm-machine"]["endpoint-metadata"]["metadata"]["name"]
+        == "wm-machine"
+    )
+    assert by_name["missing-machine"]["healthy"] is False
+    assert by_name["missing-machine"]["endpoint-metadata"] == {}
+
+
+def test_watchman_healthcheck():
+    async def main():
+        watchman = Watchman("p", [], [], poll_interval=3600)
+        client = TestClient(TestServer(build_watchman_app(watchman)))
+        await client.start_server()
+        try:
+            resp = await client.get("/healthcheck")
+            return resp.status
+        finally:
+            await client.close()
+
+    assert asyncio.run(main()) == 200

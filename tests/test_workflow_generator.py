@@ -1,0 +1,86 @@
+"""Workflow-generator tests — assert on the generated orchestration
+documents, never a live cluster (reference test pattern, SURVEY.md §5)."""
+
+import yaml
+
+from gordo_tpu.workflow import (
+    NormalizedConfig,
+    build_plan,
+    generate_workflow,
+    unique_tags,
+    workflow_to_yaml,
+)
+
+PROJECT = {
+    "machines": [
+        {"name": "gen-a", "dataset": {
+            "type": "RandomDataset", "tags": ["t1", "t2"],
+            "train_start_date": "2017-01-01T00:00:00Z",
+            "train_end_date": "2017-01-02T00:00:00Z"}},
+        {"name": "gen-b", "dataset": {
+            "type": "RandomDataset", "tags": ["t2", "t3"],
+            "train_start_date": "2017-01-01T00:00:00Z",
+            "train_end_date": "2017-01-02T00:00:00Z"}},
+        {"name": "gen-c", "dataset": {
+            "type": "RandomDataset", "tags": ["t4", "t5", "t6"],
+            "train_start_date": "2017-01-01T00:00:00Z",
+            "train_end_date": "2017-01-02T00:00:00Z"}},
+    ],
+}
+
+
+def _config():
+    return NormalizedConfig(PROJECT, "genproj")
+
+
+def test_unique_tags():
+    assert unique_tags(_config().machines) == ["t1", "t2", "t3", "t4", "t5", "t6"]
+
+
+def test_build_plan_buckets_by_signature():
+    plan = build_plan(_config())
+    assert plan["project-name"] == "genproj"
+    assert plan["n_machines"] == 3
+    # same default model: 2-tag machines bucket together, 3-tag separately
+    assert plan["n_buckets"] == 2
+    sizes = sorted(b["n_machines"] for b in plan["buckets"])
+    assert sizes == [1, 2]
+    two_tag = next(b for b in plan["buckets"] if b["n_machines"] == 2)
+    assert sorted(two_tag["machines"]) == ["gen-a", "gen-b"]
+    assert set(two_tag["cache_keys"]) == {"gen-a", "gen-b"}
+
+
+def test_build_plan_respects_max_bucket_size():
+    plan = build_plan(_config(), max_bucket_size=1)
+    assert plan["n_buckets"] == 3
+    assert all(b["n_machines"] == 1 for b in plan["buckets"])
+
+
+def test_generate_workflow_documents():
+    docs = generate_workflow(_config())
+    kinds = [d["kind"] for d in docs]
+    assert kinds.count("Job") == 1              # ONE builder job, not 3 pods
+    assert kinds.count("Deployment") == 2       # ml-server + watchman
+    assert kinds.count("Service") == 2
+    assert kinds.count("Mapping") == 3          # per-machine URL contract
+    assert kinds.count("ConfigMap") == 1        # embedded build plan
+
+    job = next(d for d in docs if d["kind"] == "Job")
+    container = job["spec"]["template"]["spec"]["containers"][0]
+    assert container["command"] == ["gordo", "build-project"]
+    assert "google.com/tpu" in container["resources"]["limits"]
+
+    mappings = [d for d in docs if d["kind"] == "Mapping"]
+    prefixes = {m["spec"]["prefix"] for m in mappings}
+    assert "/gordo/v0/genproj/gen-a/" in prefixes
+
+    plan_cm = next(d for d in docs if d["kind"] == "ConfigMap")
+    embedded = yaml.safe_load(plan_cm["data"]["plan.yaml"])
+    assert embedded["n_machines"] == 3
+
+
+def test_workflow_yaml_roundtrip():
+    docs = generate_workflow(_config())
+    parsed = list(yaml.safe_load_all(workflow_to_yaml(docs)))
+    assert len(parsed) == len(docs)
+    assert parsed[0]["kind"] == "Job"
