@@ -26,7 +26,7 @@ import numpy as np
 import gordo_tpu
 from gordo_tpu import serializer
 from gordo_tpu.dataset.base import GordoBaseDataset
-from gordo_tpu.utils import disk_registry
+from gordo_tpu.utils import disk_registry, profiling
 
 logger = logging.getLogger(__name__)
 
@@ -79,7 +79,8 @@ def build_model(
     cv_mode = evaluation_config.get("cv_mode", "full_build")
     if cv_mode != "build_only" and hasattr(model, "cross_validate"):
         t0 = time.time()
-        model.cross_validate(X_arr, y_arr, cv=evaluation_config.get("cv"))
+        with profiling.trace(f"cv/{name}"):
+            model.cross_validate(X_arr, y_arr, cv=evaluation_config.get("cv"))
         cv_duration = time.time() - t0
         cv_meta = getattr(model, "cv_metadata_", {})
 
@@ -87,7 +88,8 @@ def build_model(
         fit_duration = 0.0
     else:
         t0 = time.time()
-        model.fit(X_arr, y_arr)
+        with profiling.trace(f"fit/{name}"):
+            model.fit(X_arr, y_arr)
         fit_duration = time.time() - t0
 
     build_metadata = assemble_metadata(
@@ -133,6 +135,15 @@ def assemble_metadata(
             "data_query_duration_sec": data_query_duration,
             "cross_validation_duration_sec": cv_duration,
             "model_builder_duration_sec": fit_duration,
+            **(
+                {
+                    "fit_samples_per_second": round(
+                        dataset_metadata["rows_after_filter"] / fit_duration, 1
+                    )
+                }
+                if fit_duration and dataset_metadata.get("rows_after_filter")
+                else {}
+            ),
             **(
                 {"cross_validation": cv_meta}
                 if cv_meta

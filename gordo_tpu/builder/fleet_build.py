@@ -37,7 +37,7 @@ from gordo_tpu.builder.build_model import (
 )
 from gordo_tpu.dataset.base import GordoBaseDataset
 from gordo_tpu.parallel.anomaly import FleetDiffBuilder, analyze_definition
-from gordo_tpu.utils import disk_registry
+from gordo_tpu.utils import disk_registry, profiling
 from gordo_tpu.workflow.config import Machine
 
 logger = logging.getLogger(__name__)
@@ -161,10 +161,11 @@ def build_project(
             t0 = time.time()
             try:
                 builder = FleetDiffBuilder(spec, cv=cv, mesh=mesh)
-                detectors = builder.build(
-                    [loaded[m.name][0] for m in chunk],
-                    [loaded[m.name][1] for m in chunk],
-                )
+                with profiling.trace(f"fleet_bucket/{len(chunk)}"):
+                    detectors = builder.build(
+                        [loaded[m.name][0] for m in chunk],
+                        [loaded[m.name][1] for m in chunk],
+                    )
             except Exception as exc:
                 logger.exception("Fleet bucket failed; falling back to singles")
                 singles.extend(chunk)

@@ -65,7 +65,10 @@ class BaseJaxEstimator(ParamsMixin, GordoBase):
         X = as_float2d(X)
         y_arr = None if y is None else as_float2d(y)
 
-        cfg, factory_kwargs = TrainConfig.from_kwargs({**self.kwargs, **fit_kwargs})
+        merged = {**self.kwargs, **fit_kwargs}
+        checkpoint_dir = merged.pop("checkpoint_dir", None)
+        checkpoint_every = int(merged.pop("checkpoint_every", 10) or 10)
+        cfg, factory_kwargs = TrainConfig.from_kwargs(merged)
         inputs = self._make_inputs(X)
         targets = self._make_targets(X, y_arr)
 
@@ -80,9 +83,20 @@ class BaseJaxEstimator(ParamsMixin, GordoBase):
         self._train_cfg = cfg
 
         seed = int(factory_kwargs.get("seed", 0) or 0)
-        params, history = fit_model(
-            self.module_, inputs, targets, cfg, rng=jax.random.PRNGKey(seed)
-        )
+        if checkpoint_dir:
+            # mid-fit checkpoint/resume for long fits (SURVEY.md §6.4)
+            from gordo_tpu.train.checkpoint import fit_checkpointed
+
+            params, history = fit_checkpointed(
+                self.module_, inputs, targets, cfg,
+                ckpt_dir=checkpoint_dir,
+                checkpoint_every=checkpoint_every,
+                rng=jax.random.PRNGKey(seed),
+            )
+        else:
+            params, history = fit_model(
+                self.module_, inputs, targets, cfg, rng=jax.random.PRNGKey(seed)
+            )
         self.params_ = params
         self.history_ = np.asarray(history)
         self._predict_jit = None

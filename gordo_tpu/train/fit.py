@@ -179,6 +179,31 @@ def make_epoch_fn(loss_fn: Callable, tx: optax.GradientTransformation,
     return epoch
 
 
+def make_stateful_fit_fn(module, cfg: TrainConfig, steps: int, bs: int) -> Callable:
+    """Resumable fit: ``(params, opt_state, X, y, w, epoch_keys) ->
+    (params, opt_state, history)``.
+
+    Unlike :func:`make_fit_fn` the optimizer state flows through, and the
+    per-epoch shuffle keys come in as an array — so a fit chunked across
+    checkpoints (``gordo_tpu.train.checkpoint``) is bit-identical to the
+    uninterrupted run.
+    """
+    tx = make_optimizer(cfg)
+    loss_fn = make_loss_fn(module.apply, cfg.loss)
+    epoch = make_epoch_fn(loss_fn, tx, steps, bs, cfg.shuffle)
+
+    def fit_fn(params, opt_state, X, y, w, epoch_keys):
+        def body(carry, key):
+            return epoch(carry, key, X, y, w)
+
+        (params, opt_state), history = jax.lax.scan(
+            body, (params, opt_state), epoch_keys
+        )
+        return params, opt_state, history
+
+    return fit_fn
+
+
 def make_fit_fn(module, cfg: TrainConfig, steps: int, bs: int) -> Callable:
     """The whole multi-epoch fit as ONE pure function
     ``(params, X, y, w, rng) -> (params, history)``.

@@ -1,0 +1,43 @@
+"""Profiling & step-metrics hooks.
+
+Reference status (SURVEY.md §6.1): essentially absent — the reference only
+records build wall-times into metadata.  The TPU build keeps that
+metadata-first design and adds opt-in ``jax.profiler`` tracing: set
+``GORDO_PROFILE_DIR`` (or pass ``profile_dir``) and every wrapped section
+dumps a Perfetto/TensorBoard-loadable trace.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+from typing import Iterator, Optional
+
+logger = logging.getLogger(__name__)
+
+ENV_VAR = "GORDO_PROFILE_DIR"
+
+
+def profile_dir() -> Optional[str]:
+    return os.environ.get(ENV_VAR) or None
+
+
+@contextlib.contextmanager
+def trace(section: str, directory: Optional[str] = None) -> Iterator[None]:
+    """Wrap a section in a ``jax.profiler`` trace when profiling is enabled,
+    else a no-op.  Traces land in ``<dir>/<section>/`` (one subdir per
+    section so repeated builds don't clobber each other)."""
+    directory = directory or profile_dir()
+    if not directory:
+        yield
+        return
+    import jax
+
+    dest = os.path.join(directory, section.replace("/", "_"))
+    os.makedirs(dest, exist_ok=True)
+    logger.info("Profiling %r -> %s", section, dest)
+    with jax.profiler.trace(dest):
+        yield
+
+

@@ -1,0 +1,146 @@
+"""Mid-fit checkpoint/resume tests (SURVEY.md §6.4: an addition over the
+reference, which only checkpoints at the artifact level)."""
+
+import numpy as np
+import pytest
+
+import gordo_tpu.models.factories  # noqa: F401 — registers factories
+from gordo_tpu.registry import lookup_factory
+from gordo_tpu.train.checkpoint import fit_checkpointed, load_checkpoint
+from gordo_tpu.train.fit import TrainConfig, fit
+
+
+@pytest.fixture()
+def module(sine_tags):
+    factory = lookup_factory("AutoEncoder", "feedforward_hourglass")
+    return factory(n_features=sine_tags.shape[1],
+                   n_features_out=sine_tags.shape[1])
+
+
+CFG = TrainConfig(epochs=6, batch_size=128)
+
+
+def _leaves_equal(a, b):
+    import jax
+
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_uninterrupted_checkpointed_fit_matches_plain_fit(
+    module, sine_tags, tmp_path
+):
+    import jax
+
+    plain_params, plain_hist = fit(
+        module, sine_tags, sine_tags, CFG, rng=jax.random.PRNGKey(7)
+    )
+    ckpt_params, ckpt_hist = fit_checkpointed(
+        module, sine_tags, sine_tags, CFG,
+        ckpt_dir=str(tmp_path / "ck"),
+        checkpoint_every=2,
+        rng=jax.random.PRNGKey(7),
+    )
+    _leaves_equal(plain_params, ckpt_params)
+    np.testing.assert_allclose(plain_hist, ckpt_hist, rtol=1e-6)
+
+
+def test_resume_is_bit_identical(module, sine_tags, tmp_path):
+    import jax
+
+    full_dir = tmp_path / "full"
+    full_params, _ = fit_checkpointed(
+        module, sine_tags, sine_tags, CFG,
+        ckpt_dir=str(full_dir), checkpoint_every=10,
+        rng=jax.random.PRNGKey(7),
+    )
+
+    # interrupted run: only 2 epochs' worth of config, same seed/dir
+    part_dir = str(tmp_path / "part")
+    import dataclasses
+
+    partial_cfg = dataclasses.replace(CFG, epochs=2)
+    fit_checkpointed(
+        module, sine_tags, sine_tags, partial_cfg,
+        ckpt_dir=part_dir, checkpoint_every=2, rng=jax.random.PRNGKey(7),
+    )
+    assert load_checkpoint(part_dir) is not None
+
+    # resume to the full 6 epochs
+    resumed_params, resumed_hist = fit_checkpointed(
+        module, sine_tags, sine_tags, CFG,
+        ckpt_dir=part_dir, checkpoint_every=2, rng=jax.random.PRNGKey(7),
+    )
+    assert len(resumed_hist) == CFG.epochs
+    _leaves_equal(full_params, resumed_params)
+
+
+def test_checkpoint_files_written(module, sine_tags, tmp_path):
+    ckpt = tmp_path / "files"
+    fit_checkpointed(
+        module, sine_tags, sine_tags,
+        TrainConfig(epochs=2, batch_size=128),
+        ckpt_dir=str(ckpt), checkpoint_every=1,
+    )
+    restored = load_checkpoint(str(ckpt))
+    assert restored is not None
+    assert restored[3] == 2  # epochs_done
+    assert len(restored[2]) == 2  # history rides inside the checkpoint
+
+
+def test_stale_checkpoint_not_reused(module, sine_tags, tmp_path):
+    """A checkpoint from different data/config must be ignored, not
+    silently returned (the CV-fold clone scenario)."""
+    cfg = TrainConfig(epochs=2, batch_size=128)
+    ckpt = str(tmp_path / "stale")
+    fit_checkpointed(module, sine_tags, sine_tags, cfg, ckpt, 1)
+
+    other = sine_tags[: len(sine_tags) // 2]
+    params_other, hist = fit_checkpointed(module, other, other, cfg, ckpt, 1)
+    assert len(hist) == cfg.epochs  # retrained, not skipped
+    import jax
+
+    fresh, _ = fit(module, other, other, cfg, rng=jax.random.PRNGKey(0))
+    _leaves_equal(params_other, fresh)
+
+
+def test_checkpoint_every_validation(module, sine_tags, tmp_path):
+    with pytest.raises(ValueError):
+        fit_checkpointed(
+            module, sine_tags, sine_tags,
+            TrainConfig(epochs=2, batch_size=128),
+            ckpt_dir=str(tmp_path / "x"), checkpoint_every=0,
+        )
+
+
+def test_profiling_trace_noop_and_active(tmp_path, monkeypatch):
+    import jax.numpy as jnp
+
+    from gordo_tpu.utils import profiling
+
+    # no env var → no-op
+    monkeypatch.delenv(profiling.ENV_VAR, raising=False)
+    with profiling.trace("noop"):
+        pass
+
+    monkeypatch.setenv(profiling.ENV_VAR, str(tmp_path))
+    with profiling.trace("section"):
+        jnp.ones(8).sum().block_until_ready()
+    assert (tmp_path / "section").exists()
+
+
+
+
+def test_estimator_checkpoint_dir_kwarg(sine_tags, tmp_path):
+    from gordo_tpu.models.estimator import AutoEncoder
+
+    est = AutoEncoder(
+        epochs=3, batch_size=128,
+        checkpoint_dir=str(tmp_path / "est-ck"), checkpoint_every=1,
+    )
+    est.fit(sine_tags)
+    assert load_checkpoint(str(tmp_path / "est-ck")) is not None
+    plain = AutoEncoder(epochs=3, batch_size=128).fit(sine_tags)
+    _leaves_equal(est.params_, plain.params_)
