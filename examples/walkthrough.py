@@ -75,7 +75,8 @@ def main():
                 "2017-12-28T06:00:00Z", "2017-12-29T06:00:00Z"
             )
             for res in results:
-                print(res.name, "->", len(res.predictions), "scored rows",
+                rows = 0 if res.predictions is None else len(res.predictions)
+                print(res.name, "->", rows, "scored rows",
                       "(ok)" if res.ok else res.error_messages)
         finally:
             await runner.cleanup()

@@ -152,6 +152,80 @@ class FileSystemTagProvider(GordoBaseDataProvider):
             yield series
 
 
+class IrocBundleProvider(GordoBaseDataProvider):
+    """Bundle-CSV reader (reference: ``iroc_reader.IrocReader``).
+
+    The IROC on-lake layout stores MANY tags per CSV — rows of
+    ``tag,timestamp,value`` — instead of one file per tag.  This provider
+    reads every ``*.csv`` under ``base_dir`` (or an explicit file list),
+    filters to the requested window, and yields one series per tag.
+
+    Column names are matched case-insensitively against
+    ``(tag, timestamp|time, value)``; headerless files are assumed to be in
+    that order.
+    """
+
+    @capture_args
+    def __init__(self, base_dir: str, files: Optional[List[str]] = None):
+        self.base_dir = base_dir
+        self.files = files
+
+    def _bundle_files(self) -> List[str]:
+        if self.files:
+            return [os.path.join(self.base_dir, f) for f in self.files]
+        return sorted(glob.glob(os.path.join(self.base_dir, "*.csv")))
+
+    def can_handle_tag(self, tag) -> bool:
+        return bool(self._bundle_files())
+
+    @staticmethod
+    def _read_bundle(path: str) -> pd.DataFrame:
+        head = pd.read_csv(path, nrows=0)
+        cols = [c.strip().lower() for c in head.columns]
+        if "tag" in cols and "value" in cols:
+            df = pd.read_csv(path)
+            df.columns = [c.strip().lower() for c in df.columns]
+            time_candidates = [
+                c for c in ("timestamp", "time", "datetime") if c in df.columns
+            ]
+            if not time_candidates:
+                raise ValueError(
+                    f"Bundle CSV {path!r} has no recognized time column "
+                    f"(expected one of timestamp/time/datetime, got {cols})"
+                )
+            time_col = time_candidates[0]
+        else:  # headerless: tag,timestamp,value order
+            df = pd.read_csv(path, header=None, names=["tag", "timestamp", "value"])
+            time_col = "timestamp"
+        df = df.rename(columns={time_col: "time"})[["tag", "time", "value"]]
+        df["time"] = pd.to_datetime(df["time"], utc=True)
+        return df
+
+    def load_series(
+        self,
+        from_ts: pd.Timestamp,
+        to_ts: pd.Timestamp,
+        tag_list: List,
+        dry_run: bool = False,
+    ) -> Iterable[pd.Series]:
+        files = self._bundle_files()
+        if not files:
+            raise FileNotFoundError(f"No bundle CSVs under {self.base_dir!r}")
+        bundle = pd.concat([self._read_bundle(p) for p in files])
+        bundle = bundle[(bundle["time"] >= from_ts) & (bundle["time"] < to_ts)]
+        by_tag = dict(tuple(bundle.groupby("tag")))
+        for tag in normalize_sensor_tags(list(tag_list)):
+            if tag.name not in by_tag:
+                raise KeyError(
+                    f"Tag {tag.name!r} not present in IROC bundles under "
+                    f"{self.base_dir!r} (have: {sorted(by_tag)[:10]}...)"
+                )
+            group = by_tag[tag.name].sort_values("time")
+            series = group.set_index("time")["value"].astype(float)
+            series.name = tag.name
+            yield series
+
+
 class InfluxDataProvider(GordoBaseDataProvider):
     """InfluxDB-measurement provider (reference: ``InfluxDataProvider``).
 

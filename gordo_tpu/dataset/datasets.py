@@ -94,16 +94,61 @@ class TimeSeriesDataset(GordoBaseDataset):
         self._metadata: Dict[str, Any] = {}
 
     # -- assembly ------------------------------------------------------------
+    def _resample_one(self, series: pd.Series) -> pd.Series:
+        """Resample a single tag's series to ``self.resolution``.
+
+        Mean aggregation of a UTC series over a fixed-width resolution takes
+        a vectorized O(n) path (``np.add.reduceat`` over bin boundaries) —
+        at fleet scale the per-tag pandas ``resample().mean()`` dominated
+        project-build wall time by ~10x.  The output is bin-for-bin
+        identical to pandas (origin = midnight of the first sample's day,
+        left-closed/left-labeled, empty bins NaN).  Non-mean aggregations,
+        non-fixed frequencies, and non-UTC/naive indexes (DST-dependent bin
+        labels) use pandas.
+        """
+        if (
+            self.aggregation_methods != "mean"
+            or len(series) == 0
+            or str(series.index.tz) != "UTC"
+        ):
+            return series.resample(self.resolution).agg(self.aggregation_methods)
+        try:
+            nanos = pd.tseries.frequencies.to_offset(self.resolution).nanos
+        except ValueError:  # non-fixed frequency (e.g. months) — pandas path
+            return series.resample(self.resolution).agg(self.aggregation_methods)
+
+        if not series.index.is_monotonic_increasing:
+            series = series.sort_index()
+        # pandas 2.x indexes may be us/ms-resolution; do all math in ns
+        idx = series.index.as_unit("ns").asi8
+        values = series.to_numpy(dtype=np.float64, copy=False)
+        origin = series.index[0].normalize().as_unit("ns").value
+        bins = (idx - origin) // nanos
+        starts = np.concatenate(
+            [[0], np.flatnonzero(np.diff(bins)) + 1]
+        )
+        # NaN samples must not poison bucket means (pandas mean skips them)
+        nan_mask = np.isnan(values)
+        sums = np.add.reduceat(np.where(nan_mask, 0.0, values), starts)
+        valid = np.add.reduceat((~nan_mask).astype(np.int64), starts)
+        with np.errstate(invalid="ignore"):
+            means = np.where(valid > 0, sums / np.maximum(valid, 1), np.nan)
+        # scatter onto the COMPLETE bin grid (empty bins NaN) so length,
+        # labels, and metadata match the pandas path exactly
+        grid = np.full(int(bins[-1] - bins[0]) + 1, np.nan)
+        grid[(bins[starts] - bins[0]).astype(np.int64)] = means
+        label_ns = origin + np.arange(bins[0], bins[-1] + 1) * nanos
+        index = pd.DatetimeIndex(
+            label_ns.view("datetime64[ns]"), name=series.index.name
+        ).tz_localize("UTC")
+        return pd.Series(grid, index=index, name=series.name)
+
     def _join_timeseries(self, series_iter) -> pd.DataFrame:
         frames = []
         metadata = {}
         for series in series_iter:
             raw_len = len(series)
-            agg = (
-                series.resample(self.resolution).agg(self.aggregation_methods)
-                if raw_len
-                else series
-            )
+            agg = self._resample_one(series) if raw_len else series
             if isinstance(agg, pd.DataFrame):  # multiple aggregation methods
                 agg.columns = [f"{series.name}_{m}" for m in agg.columns]
             else:

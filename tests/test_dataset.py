@@ -187,3 +187,137 @@ def test_dataset_from_dict_dispatch():
     assert isinstance(ds, RandomDataset)
     X, y = ds.get_data()
     assert list(X.columns) == ["a", "b"]
+
+
+class TestFastResampleParity:
+    """The vectorized mean-resample must match pandas bin-for-bin."""
+
+    def _series(self, n=500, seed=0, with_nans=True):
+        import numpy as np
+        import pandas as pd
+
+        rng = np.random.default_rng(seed)
+        # irregular timestamps over 2 days
+        ts = np.sort(rng.integers(0, 2 * 24 * 3600, size=n)) * 10**9
+        base = pd.Timestamp("2020-03-01T07:13:00Z").value
+        idx = pd.DatetimeIndex((base + ts).astype("datetime64[ns]")).tz_localize("UTC")
+        vals = rng.standard_normal(n)
+        if with_nans:
+            vals[rng.integers(0, n, size=20)] = np.nan
+        return pd.Series(vals, index=idx, name="t")
+
+    def test_matches_pandas_mean(self):
+        import numpy as np
+
+        from gordo_tpu.dataset.datasets import TimeSeriesDataset
+
+        ds = TimeSeriesDataset(
+            train_start_date="2020-03-01T00:00:00Z",
+            train_end_date="2020-03-04T00:00:00Z",
+            tag_list=["t"],
+        )
+        for resolution in ("10min", "1h", "37s"):
+            ds.resolution = resolution
+            s = self._series()
+            fast = ds._resample_one(s)
+            ref = s.resample(resolution).mean()
+            # bin-for-bin identical, INCLUDING empty (NaN) bins
+            assert np.array_equal(
+                fast.index.as_unit("ns").asi8, ref.index.as_unit("ns").asi8
+            )
+            np.testing.assert_allclose(
+                fast.to_numpy(), ref.to_numpy(), rtol=1e-12
+            )
+
+    def test_non_utc_tz_falls_back_to_pandas(self):
+        import pandas as pd
+
+        from gordo_tpu.dataset.datasets import TimeSeriesDataset
+
+        ds = TimeSeriesDataset(
+            train_start_date="2020-03-28T00:00:00Z",
+            train_end_date="2020-03-31T00:00:00Z",
+            tag_list=["t"],
+        )
+        # Oslo series over the 2020-03-29 DST transition
+        s = self._series().tz_convert("Europe/Oslo")
+        got = ds._resample_one(s)
+        ref = s.resample("10min").mean()
+        assert got.equals(ref)
+        assert str(got.index.tz) == str(ref.index.tz)
+
+    def test_unsorted_input(self):
+        import numpy as np
+
+        from gordo_tpu.dataset.datasets import TimeSeriesDataset
+
+        ds = TimeSeriesDataset(
+            train_start_date="2020-03-01T00:00:00Z",
+            train_end_date="2020-03-04T00:00:00Z",
+            tag_list=["t"],
+        )
+        s = self._series(with_nans=False)
+        shuffled = s.sample(frac=1.0, random_state=1)
+        fast = ds._resample_one(shuffled)
+        ref = s.resample("10min").mean().dropna()
+        np.testing.assert_allclose(
+            fast.dropna().to_numpy(), ref.to_numpy(), rtol=1e-12
+        )
+
+    def test_non_mean_agg_falls_back(self):
+        from gordo_tpu.dataset.datasets import TimeSeriesDataset
+
+        ds = TimeSeriesDataset(
+            train_start_date="2020-03-01T00:00:00Z",
+            train_end_date="2020-03-04T00:00:00Z",
+            tag_list=["t"],
+            aggregation_methods="max",
+        )
+        s = self._series(with_nans=False)
+        ref = s.resample("10min").agg("max")
+        got = ds._resample_one(s)
+        assert got.equals(ref)
+
+
+def test_iroc_bundle_provider(tmp_path):
+    import numpy as np
+    import pandas as pd
+
+    from gordo_tpu.dataset.data_provider.providers import IrocBundleProvider
+    from gordo_tpu.dataset.datasets import TimeSeriesDataset
+
+    # two bundle files, three tags interleaved (the IROC many-tags-per-CSV
+    # layout), one headerless
+    times = pd.date_range("2020-01-01", periods=200, freq="5min", tz="UTC")
+    rows = []
+    for i, t in enumerate(times):
+        for tag in ("iroc-a", "iroc-b", "iroc-c"):
+            rows.append((tag, t.isoformat(), float(i)))
+    df = pd.DataFrame(rows, columns=["tag", "timestamp", "value"])
+    df.iloc[:300].to_csv(tmp_path / "bundle1.csv", index=False)
+    df.iloc[300:].to_csv(tmp_path / "bundle2.csv", index=False, header=False)
+
+    provider = IrocBundleProvider(str(tmp_path))
+    series = list(
+        provider.load_series(times[0], times[-1] + pd.Timedelta("1min"),
+                             ["iroc-a", "iroc-b"])
+    )
+    assert [s.name for s in series] == ["iroc-a", "iroc-b"]
+    assert all(len(s) == 200 for s in series)
+    np.testing.assert_allclose(series[0].to_numpy(), np.arange(200.0))
+
+    # through the dataset layer (resample + join)
+    ds = TimeSeriesDataset(
+        train_start_date=str(times[0]),
+        train_end_date=str(times[-1]),
+        tag_list=["iroc-a", "iroc-b", "iroc-c"],
+        data_provider=provider,
+        resolution="10min",
+    )
+    X, y = ds.get_data()
+    assert X.shape[1] == 3 and len(X) > 50
+
+    import pytest
+
+    with pytest.raises(KeyError):
+        list(provider.load_series(times[0], times[-1], ["nope"]))
