@@ -1,0 +1,24 @@
+# gordo-tpu — one image, four runtime roles (reference shipped one image
+# per role: ModelBuilder / ModelServer / Watchman / Client; the roles here
+# share a wheel and differ only in entrypoint, selected by the k8s
+# manifests `gordo workflow generate` emits).
+#
+# Base note: for real TPU pods use a JAX TPU base image (e.g.
+# a python image + `jax[tpu]` from the libtpu releases); CI can build on
+# plain python for CPU-only tests.
+ARG BASE_IMAGE=python:3.12-slim
+FROM ${BASE_IMAGE}
+
+WORKDIR /opt/gordo-tpu
+
+COPY pyproject.toml README.md ./
+COPY gordo_tpu ./gordo_tpu
+RUN pip install --no-cache-dir .
+
+# role entrypoints (override command per role):
+#   model-builder: gordo build-project --machine-config /config/project.yaml ...
+#   ml-server:     gordo run-server --model-dir /models ...
+#   watchman:      gordo run-watchman --machine-config /config/project.yaml ...
+#   client:        gordo client predict <start> <end> ...
+ENTRYPOINT ["gordo"]
+CMD ["--help"]

@@ -1,0 +1,30 @@
+# gordo-tpu developer targets (reference parity: the Makefile drives
+# tests/lint/images).
+
+PYTHON ?= python
+IMAGE  ?= gordo-tpu
+TAG    ?= latest
+
+.PHONY: test test-fast lint bench install image docs clean
+
+install:
+	$(PYTHON) -m pip install -e . --no-build-isolation --no-deps
+
+test:
+	$(PYTHON) -m pytest tests/ -q
+
+test-fast:
+	$(PYTHON) -m pytest tests/ -q -x -k "not fleet_build and not client and not watchman"
+
+bench:
+	$(PYTHON) bench.py
+
+image:
+	docker build -t $(IMAGE):$(TAG) .
+
+docs:
+	@ls docs/*.md
+
+clean:
+	rm -rf build dist *.egg-info .pytest_cache
+	find . -name __pycache__ -type d -exec rm -rf {} +

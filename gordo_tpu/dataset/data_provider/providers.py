@@ -212,14 +212,24 @@ class IrocBundleProvider(GordoBaseDataProvider):
         if not files:
             raise FileNotFoundError(f"No bundle CSVs under {self.base_dir!r}")
         bundle = pd.concat([self._read_bundle(p) for p in files])
+        known_tags = set(bundle["tag"].unique())
         bundle = bundle[(bundle["time"] >= from_ts) & (bundle["time"] < to_ts)]
         by_tag = dict(tuple(bundle.groupby("tag")))
         for tag in normalize_sensor_tags(list(tag_list)):
-            if tag.name not in by_tag:
+            if tag.name not in known_tags:
                 raise KeyError(
                     f"Tag {tag.name!r} not present in IROC bundles under "
-                    f"{self.base_dir!r} (have: {sorted(by_tag)[:10]}...)"
+                    f"{self.base_dir!r} (have: {sorted(known_tags)[:10]}...)"
                 )
+            if tag.name not in by_tag:
+                # tag exists but had no samples in the window: yield empty so
+                # the dataset layer reports the data gap, not a missing tag
+                yield pd.Series(
+                    dtype=float,
+                    index=pd.DatetimeIndex([], tz="UTC", name="time"),
+                    name=tag.name,
+                )
+                continue
             group = by_tag[tag.name].sort_values("time")
             series = group.set_index("time")["value"].astype(float)
             series.name = tag.name

@@ -1,0 +1,302 @@
+"""Stacked multi-machine serving: many models resident per chip, scored in
+one dispatch.
+
+Reference equivalent: none — the reference serves one model per pod, so
+aggregate project throughput is bounded by per-request Python/Flask
+overhead times N pods.  SURVEY.md §8 step 6 calls for the TPU-native
+answer: stack every (structurally identical) machine's params on device
+and score a whole project's stream as ONE vmapped fused program — a
+bucket of tiny per-tag scoring programs becomes MXU-filling batched GEMMs,
+exactly like the fleet trainer.
+
+Used by the bulk serving route (``POST .../_bulk/anomaly/prediction``) and
+the replayed-stream benchmark (BASELINE config 5).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gordo_tpu.anomaly.diff import scores_fn
+from gordo_tpu.ops.windows import make_windows
+from gordo_tpu.serve.scorer import (
+    CompiledScorer,
+    _bucket_rows,
+    _extract_chain,
+    _rolling_median,
+)
+
+#: same device-memory bound as CompiledScorer's smoothing guard (elements of
+#: the rolling-median windows tensor), applied across the stacked machine axis
+SMOOTH_ELEMENT_BOUND = 2 ** 27
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "module", "scaler_classes", "mode", "lookback", "det_cls",
+        "with_thresholds", "smooth_window",
+    ),
+)
+def _fleet_score_program(
+    module,
+    scaler_classes,
+    mode,
+    lookback,
+    det_cls,
+    with_thresholds,
+    smooth_window,
+    scaler_stats,    # tuple of stacked stats pytrees, leaves (M, ...)
+    params,          # stacked params pytree, leaves (M, ...)
+    det_stats,       # stacked detector-scaler stats
+    agg_thresholds,  # (M,) stacked aggregate thresholds (or None)
+    X,               # (M, N, F)
+):
+    """The fused anomaly program of ``serve.scorer``, vmapped over the
+    machine axis."""
+
+    def one(stats_i, params_i, det_i, x):
+        xs = x
+        for cls, st in zip(scaler_classes, stats_i):
+            xs = cls.apply(st, xs)
+        if mode == "none":
+            inputs = xs
+        elif mode == "ae":
+            inputs = make_windows(xs, lookback)
+        else:  # forecast
+            inputs = make_windows(xs[:-1], lookback)
+        pred = module.apply({"params": params_i}, inputs)
+        offset = x.shape[0] - pred.shape[0]
+        tag, total = scores_fn(det_cls, det_i, x[offset:], pred)
+        if smooth_window:
+            tag = _rolling_median(tag, smooth_window)
+            total = _rolling_median(total, smooth_window)
+        return pred, tag, total
+
+    pred, tag, total = jax.vmap(one)(scaler_stats, params, det_stats, X)
+    out = {
+        "model-output": pred,
+        "tag-anomaly-scores": tag,
+        "total-anomaly-score": total,
+    }
+    if with_thresholds:
+        out["anomaly-confidence"] = total / jnp.maximum(
+            agg_thresholds[:, None], 1e-12
+        )
+    return out
+
+
+class _Bucket:
+    """One structurally identical group of machines, params stacked."""
+
+    def __init__(self, names: List[str], chains: List[Dict[str, Any]]):
+        self.names = names
+        c0 = chains[0]
+        self.module = c0["module"]
+        self.scaler_classes = tuple(cls for cls, _ in c0["scalers"])
+        self.mode = c0["mode"]
+        self.lookback = c0["lookback"]
+        det0 = c0["detector"]
+        self.det_cls = det0["scaler_cls"]
+        self.smooth_window = det0["window"]
+        self.with_thresholds = all(
+            c["detector"]["feature_thresholds"] is not None for c in chains
+        )
+
+        stack = lambda trees: jax.tree.map(  # noqa: E731
+            lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *trees
+        )
+        self.params = stack([c["params"] for c in chains])
+        self.scaler_stats = tuple(
+            stack([c["scalers"][i][1] for c in chains])
+            for i in range(len(self.scaler_classes))
+        )
+        self.det_stats = stack([c["detector"]["scaler_stats"] for c in chains])
+        if self.with_thresholds:
+            self.thresholds = jnp.stack(
+                [jnp.asarray(c["detector"]["feature_thresholds"]) for c in chains]
+            )
+            self.agg_thresholds = jnp.stack(
+                [
+                    jnp.asarray(c["detector"]["aggregate_threshold"], jnp.float32)
+                    for c in chains
+                ]
+            )
+        else:
+            self.thresholds = None
+            self.agg_thresholds = None
+
+    def score(self, X_stack: np.ndarray) -> Dict[str, np.ndarray]:
+        return _fleet_score_program(
+            self.module,
+            self.scaler_classes,
+            self.mode,
+            self.lookback,
+            self.det_cls,
+            self.with_thresholds,
+            self.smooth_window,
+            self.scaler_stats,
+            self.params,
+            self.det_stats,
+            self.agg_thresholds,
+            jnp.asarray(X_stack, jnp.float32),
+        )
+
+
+def _signature(chain: Dict[str, Any]) -> Optional[Tuple]:
+    det = chain["detector"]
+    if det is None:
+        return None
+    if det["feature_thresholds"] is None and det["require_thresholds"]:
+        # the per-machine path refuses to serve this model; route it through
+        # the fallback so the same per-machine error surfaces here
+        return None
+    return (
+        chain["module"],                      # flax modules: frozen, hashable
+        tuple(cls for cls, _ in chain["scalers"]),
+        chain["mode"],
+        chain["lookback"],
+        det["scaler_cls"],
+        det["window"],
+        det["feature_thresholds"] is not None,
+    )
+
+
+class FleetScorer:
+    """Serve MANY machines' anomaly scoring as stacked device programs.
+
+    ``from_models`` buckets machines whose fused chains are structurally
+    identical; ``score_all`` runs one vmapped dispatch per bucket.
+    Machines that cannot fuse (or bucket alone) still work — they fall
+    back to their own ``CompiledScorer`` path.
+    """
+
+    def __init__(self):
+        self.buckets: List[_Bucket] = []
+        self.fallbacks: Dict[str, CompiledScorer] = {}
+        self.machine_bucket: Dict[str, Tuple[int, int]] = {}
+        self.models: Dict[str, Any] = {}
+        self._machine_scorers: Dict[str, CompiledScorer] = {}
+
+    def _machine_scorer(self, name: str) -> CompiledScorer:
+        if name not in self._machine_scorers:
+            self._machine_scorers[name] = CompiledScorer(self.models[name])
+        return self._machine_scorers[name]
+
+    @classmethod
+    def from_models(cls, models: Dict[str, Any]) -> "FleetScorer":
+        self = cls()
+        self.models = dict(models)
+        groups: Dict[Tuple, Tuple[List[str], List[Dict]]] = {}
+        for name, model in sorted(models.items()):
+            chain = _extract_chain(model)
+            sig = _signature(chain) if chain else None
+            if sig is None:
+                self.fallbacks[name] = CompiledScorer(model)
+                continue
+            names, chains = groups.setdefault(sig, ([], []))
+            names.append(name)
+            chains.append(chain)
+        for names, chains in groups.values():
+            bucket = _Bucket(names, chains)
+            idx = len(self.buckets)
+            self.buckets.append(bucket)
+            for pos, name in enumerate(names):
+                self.machine_bucket[name] = (idx, pos)
+        return self
+
+    @property
+    def n_stacked(self) -> int:
+        return sum(len(b.names) for b in self.buckets)
+
+    def score_all(
+        self, X_by_name: Dict[str, np.ndarray]
+    ) -> Dict[str, Dict[str, np.ndarray]]:
+        """Score every machine's rows in as few dispatches as buckets.
+
+        Rows are padded (repeat-last) to a shared power-of-two bucket per
+        program; outputs are sliced back per machine.
+        """
+        results: Dict[str, Dict[str, np.ndarray]] = {}
+        for bucket in self.buckets:
+            wanted = [n for n in bucket.names if n in X_by_name]
+            if not wanted:
+                continue
+            offset_check = (
+                bucket.lookback - 1
+                if bucket.mode == "ae"
+                else bucket.lookback if bucket.mode == "forecast" else 0
+            )
+            for n in wanted:
+                rows = np.asarray(X_by_name[n]).shape[0]
+                if rows <= offset_check:
+                    raise ValueError(
+                        f"Machine {n!r} needs more than {offset_check} rows "
+                        f"(lookback window), got {rows}"
+                    )
+            arrays = {n: np.asarray(X_by_name[n], np.float32) for n in wanted}
+            n_rows = _bucket_rows(max(a.shape[0] for a in arrays.values()))
+            n_feat = next(iter(arrays.values())).shape[1]
+            if (
+                bucket.smooth_window
+                and len(bucket.names) * n_rows * bucket.smooth_window * n_feat
+                > SMOOTH_ELEMENT_BOUND
+            ):
+                # smoothing windows tensor would blow device memory at this
+                # stacked size — score these machines individually (the
+                # per-machine scorer has its own memory guard + host
+                # fallback)
+                for n in wanted:
+                    results[n] = self._machine_scorer(n).anomaly_arrays(arrays[n])
+                continue
+            # build (M, n_rows, F) in bucket.names order: requested machines
+            # get repeat-last row padding; absent slots score a dummy copy
+            # whose output is discarded
+            spare = next(iter(arrays.values()))
+            stacked = np.empty(
+                (len(bucket.names), n_rows, n_feat), np.float32
+            )
+            for pos, name in enumerate(bucket.names):
+                a = arrays.get(name, spare)
+                stacked[pos, : a.shape[0]] = a
+                stacked[pos, a.shape[0]:] = a[-1:]
+            # ONE device->host transfer per output array; slicing per
+            # machine afterwards is pure numpy (per-machine indexing of
+            # device arrays would issue hundreds of tiny transfers)
+            out = jax.device_get(bucket.score(stacked))
+            offset_rows = (
+                bucket.lookback - 1
+                if bucket.mode == "ae"
+                else bucket.lookback if bucket.mode == "forecast" else 0
+            )
+            for name in wanted:
+                _, pos = self.machine_bucket[name]
+                n_valid = arrays[name].shape[0] - offset_rows
+                res = {
+                    k: np.asarray(v[pos])[:n_valid] for k, v in out.items()
+                }
+                if bucket.with_thresholds:
+                    res["tag-anomaly-thresholds"] = np.asarray(
+                        bucket.thresholds[pos]
+                    )
+                    res["total-anomaly-threshold"] = float(
+                        bucket.agg_thresholds[pos]
+                    )
+                results[name] = res
+
+        for name, scorer in self.fallbacks.items():
+            if name in X_by_name:
+                try:
+                    results[name] = scorer.anomaly_arrays(
+                        np.asarray(X_by_name[name], np.float32)
+                    )
+                except (TypeError, AttributeError) as exc:
+                    # e.g. non-anomaly model or missing thresholds — report
+                    # per machine instead of sinking the whole bulk request
+                    results[name] = {"error": str(exc)}
+        return results

@@ -65,6 +65,18 @@ class ModelCollection:
     def __init__(self, entries: Dict[str, ModelEntry], project: str = "project"):
         self.entries = entries
         self.project = project
+        self._fleet_scorer = None
+
+    @property
+    def fleet_scorer(self):
+        """Stacked multi-machine scorer (built lazily on first bulk call)."""
+        if self._fleet_scorer is None:
+            from gordo_tpu.serve.fleet_scorer import FleetScorer
+
+            self._fleet_scorer = FleetScorer.from_models(
+                {name: e.model for name, e in self.entries.items()}
+            )
+        return self._fleet_scorer
 
     @classmethod
     def from_directory(cls, path: str, project: str = "project") -> "ModelCollection":
@@ -215,6 +227,48 @@ async def anomaly_prediction(request: web.Request) -> web.Response:
     )
 
 
+async def bulk_anomaly_prediction(request: web.Request) -> web.Response:
+    """Score MANY machines in one request via the stacked fleet scorer
+    (one vmapped device program per structure bucket).  Payload:
+    ``{"X": {"<machine>": [[...rows...]], ...}}``."""
+    collection: ModelCollection = request.app[COLLECTION_KEY]
+    t0 = time.perf_counter()
+    try:
+        payload = await request.json()
+        if not isinstance(payload, dict) or not isinstance(payload.get("X"), dict):
+            raise ValueError(
+                "Payload must be {'X': {machine: rows}} for bulk scoring"
+            )
+        X_by_name = {}
+        for name, rows in payload["X"].items():
+            entry = collection.get(name)
+            if entry is None:
+                raise ValueError(f"Unknown machine {name!r}")
+            X = parse_X({"X": rows}, entry.tags)
+            _validate_width(X, entry)
+            X_by_name[name] = X
+    except ValueError as exc:
+        return web.json_response({"error": str(exc)}, status=400)
+    loop = asyncio.get_running_loop()
+    try:
+        # resolve the lazy scorer inside the executor too: first-call param
+        # stacking for a large project must not stall the accept loop
+        out = await loop.run_in_executor(
+            None, lambda: collection.fleet_scorer.score_all(X_by_name)
+        )
+    except ValueError as exc:
+        return web.json_response({"error": str(exc)}, status=400)
+    except Exception as exc:
+        logger.exception("Bulk anomaly scoring failed")
+        return web.json_response({"error": str(exc)}, status=500)
+    return web.json_response(
+        {
+            "data": {name: _jsonable(res) for name, res in out.items()},
+            "time-seconds": round(time.perf_counter() - t0, 6),
+        }
+    )
+
+
 async def download_model(request: web.Request) -> web.Response:
     entry = _entry_or_404(request)
     loop = asyncio.get_running_loop()
@@ -257,6 +311,9 @@ def build_app(collection: ModelCollection) -> web.Application:
     app[COLLECTION_KEY] = collection
     p = f"{API_PREFIX}/{{project}}"
     app.router.add_get(f"{p}/", project_index)
+    # registered before the {machine} routes so "_bulk" never resolves as a
+    # machine name
+    app.router.add_post(f"{p}/_bulk/anomaly/prediction", bulk_anomaly_prediction)
     app.router.add_get(f"{p}/{{machine}}/healthcheck", healthcheck)
     app.router.add_get(f"{p}/{{machine}}/metadata", metadata)
     app.router.add_post(f"{p}/{{machine}}/prediction", prediction)

@@ -321,3 +321,21 @@ def test_iroc_bundle_provider(tmp_path):
 
     with pytest.raises(KeyError):
         list(provider.load_series(times[0], times[-1], ["nope"]))
+
+
+def test_iroc_tag_without_window_samples_yields_empty(tmp_path):
+    import pandas as pd
+
+    from gordo_tpu.dataset.data_provider.providers import IrocBundleProvider
+
+    times = pd.date_range("2020-01-01", periods=10, freq="1h", tz="UTC")
+    rows = [("present", t.isoformat(), 1.0) for t in times]
+    rows += [("early", times[0].isoformat(), 2.0)]
+    pd.DataFrame(rows, columns=["tag", "timestamp", "value"]).to_csv(
+        tmp_path / "b.csv", index=False
+    )
+    provider = IrocBundleProvider(str(tmp_path))
+    # window AFTER 'early' tag's only sample
+    out = list(provider.load_series(times[2], times[-1], ["present", "early"]))
+    assert len(out[0]) > 0
+    assert len(out[1]) == 0  # empty series, not a KeyError

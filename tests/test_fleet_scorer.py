@@ -1,0 +1,164 @@
+"""Stacked multi-machine serving tests: the FleetScorer must match each
+machine's own CompiledScorer/model output exactly."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from gordo_tpu.builder import build_project
+from gordo_tpu.serve import ModelCollection, build_app
+from gordo_tpu.serve.fleet_scorer import FleetScorer
+from gordo_tpu.serve.scorer import CompiledScorer
+from gordo_tpu.workflow import NormalizedConfig
+from gordo_tpu import serializer
+
+PROJECT = {
+    "machines": [
+        {"name": f"fs-machine-{i}", "dataset": {
+            "type": "RandomDataset",
+            "tags": [f"fs-{i}-{j}" for j in range(3)],
+            "train_start_date": "2017-12-25T06:00:00Z",
+            "train_end_date": "2017-12-26T06:00:00Z",
+        }}
+        for i in range(4)
+    ],
+    "globals": {
+        "model": {
+            "gordo_tpu.anomaly.diff.DiffBasedAnomalyDetector": {
+                "base_estimator": {
+                    "gordo_tpu.pipeline.Pipeline": {
+                        "steps": [
+                            "gordo_tpu.ops.scalers.MinMaxScaler",
+                            {"gordo_tpu.models.estimator.AutoEncoder": {
+                                "kind": "feedforward_hourglass",
+                                "epochs": 1,
+                                "batch_size": 64,
+                            }},
+                        ]
+                    }
+                }
+            }
+        }
+    },
+}
+
+
+@pytest.fixture(scope="module")
+def models(tmp_path_factory):
+    out = tmp_path_factory.mktemp("fs-artifacts")
+    result = build_project(NormalizedConfig(PROJECT, "fsproj").machines, str(out))
+    assert not result.failed
+    return {
+        name: serializer.load(path) for name, path in result.artifacts.items()
+    }, str(out)
+
+
+class TestFleetScorer:
+    def test_buckets_stack_homogeneous_machines(self, models):
+        scorer = FleetScorer.from_models(models[0])
+        assert scorer.n_stacked == 4
+        assert len(scorer.buckets) == 1
+        assert not scorer.fallbacks
+
+    def test_matches_per_machine_scorer(self, models):
+        scorer = FleetScorer.from_models(models[0])
+        rng = np.random.default_rng(5)
+        X_by = {
+            name: rng.standard_normal((40 + 7 * i, 3)).astype(np.float32)
+            for i, name in enumerate(sorted(models[0]))
+        }
+        bulk = scorer.score_all(X_by)
+        for name, model in models[0].items():
+            single = CompiledScorer(model).anomaly_arrays(X_by[name])
+            for key in ("model-output", "tag-anomaly-scores",
+                        "total-anomaly-score", "anomaly-confidence"):
+                np.testing.assert_allclose(
+                    bulk[name][key], single[key], rtol=1e-5, atol=1e-6,
+                    err_msg=f"{name}/{key}",
+                )
+            assert bulk[name]["total-anomaly-threshold"] == pytest.approx(
+                single["total-anomaly-threshold"]
+            )
+
+    def test_subset_of_machines(self, models):
+        scorer = FleetScorer.from_models(models[0])
+        names = sorted(models[0])[:2]
+        X_by = {n: np.zeros((10, 3), np.float32) for n in names}
+        out = scorer.score_all(X_by)
+        assert sorted(out) == names
+        assert out[names[0]]["model-output"].shape == (10, 3)
+
+
+def test_bulk_route(models):
+    model_dir = models[1]
+
+    async def main():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        collection = ModelCollection.from_directory(model_dir, project="fsproj")
+        client = TestClient(TestServer(build_app(collection)))
+        await client.start_server()
+        try:
+            names = sorted(collection.entries)[:3]
+            payload = {"X": {n: [[0.1, 0.2, 0.3]] * 12 for n in names}}
+            resp = await client.post(
+                "/gordo/v0/fsproj/_bulk/anomaly/prediction", json=payload
+            )
+            body = await resp.json()
+            assert resp.status == 200, body
+            assert sorted(body["data"]) == names
+            for n in names:
+                assert len(body["data"][n]["total-anomaly-score"]) == 12
+
+            bad = await client.post(
+                "/gordo/v0/fsproj/_bulk/anomaly/prediction",
+                json={"X": {"nope": [[1, 2, 3]]}},
+            )
+            assert bad.status == 400
+        finally:
+            await client.close()
+
+    asyncio.run(main())
+
+
+def test_short_rows_rejected(models):
+    """Requests with fewer rows than the model can consume must 400-style
+    error, not silently return padded garbage."""
+    from gordo_tpu.models.estimator import LSTMAutoEncoder
+    from gordo_tpu.anomaly.diff import DiffBasedAnomalyDetector
+    from gordo_tpu.ops.scalers import MinMaxScaler
+    from gordo_tpu.pipeline import Pipeline
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((200, 3)).astype(np.float32)
+    det = DiffBasedAnomalyDetector(
+        base_estimator=Pipeline([
+            MinMaxScaler(),
+            LSTMAutoEncoder(lookback_window=12, epochs=1, batch_size=64),
+        ]),
+        require_thresholds=False,
+    )
+    det.fit(X)
+    scorer = FleetScorer.from_models({"lstm-m": det})
+    assert scorer.n_stacked == 1
+    with pytest.raises(ValueError, match="lookback"):
+        scorer.score_all({"lstm-m": X[:4]})
+
+
+def test_unthresholded_require_thresholds_goes_to_fallback(models):
+    from gordo_tpu.anomaly.diff import DiffBasedAnomalyDetector
+    from gordo_tpu.models.estimator import AutoEncoder
+    from gordo_tpu.ops.scalers import MinMaxScaler
+    from gordo_tpu.pipeline import Pipeline
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((100, 3)).astype(np.float32)
+    det = DiffBasedAnomalyDetector(
+        base_estimator=Pipeline([MinMaxScaler(), AutoEncoder(epochs=1)]),
+    )  # require_thresholds=True, no cross_validate
+    det.fit(X)
+    scorer = FleetScorer.from_models({"nothresh": det})
+    assert scorer.n_stacked == 0 and "nothresh" in scorer.fallbacks
+    out = scorer.score_all({"nothresh": X[:10]})
+    assert "error" in out["nothresh"]  # per-machine error, not an exception

@@ -1,0 +1,69 @@
+"""Numerics CI (SURVEY.md §6.2): the reference has no sanitizers to port
+(pure Python); the TPU-native substitute is jit-vs-eager equivalence and
+NaN-debug-mode runs over the hot paths."""
+
+import jax
+import numpy as np
+import pytest
+
+import gordo_tpu.models.factories  # noqa: F401
+from gordo_tpu.registry import lookup_factory
+from gordo_tpu.train.fit import TrainConfig, fit
+
+
+@pytest.fixture()
+def module(sine_tags):
+    factory = lookup_factory("AutoEncoder", "feedforward_hourglass")
+    return factory(n_features=sine_tags.shape[1],
+                   n_features_out=sine_tags.shape[1])
+
+
+def test_fit_jit_vs_eager_equivalence(module, sine_tags):
+    cfg = TrainConfig(epochs=2, batch_size=128)
+    jit_params, jit_hist = fit(module, sine_tags, sine_tags, cfg,
+                               rng=jax.random.PRNGKey(3))
+    with jax.disable_jit():
+        eager_params, eager_hist = fit(module, sine_tags, sine_tags, cfg,
+                                       rng=jax.random.PRNGKey(3))
+    # float32 fusion/accumulation order differs between the compiled and
+    # op-by-op programs; the check guards SEMANTIC divergence, not ulps
+    np.testing.assert_allclose(jit_hist, eager_hist, rtol=1e-3, atol=1e-5)
+    for a, b in zip(jax.tree.leaves(jit_params), jax.tree.leaves(eager_params)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-2, atol=5e-4
+        )
+
+
+def test_scoring_jit_vs_eager(module, sine_tags):
+    from gordo_tpu.anomaly.diff import DiffBasedAnomalyDetector
+    from gordo_tpu.models.estimator import AutoEncoder
+    from gordo_tpu.ops.scalers import MinMaxScaler
+    from gordo_tpu.pipeline import Pipeline
+
+    det = DiffBasedAnomalyDetector(
+        base_estimator=Pipeline([MinMaxScaler(), AutoEncoder(epochs=1, batch_size=128)]),
+        require_thresholds=False,
+    )
+    det.fit(sine_tags)
+    jit_frame = det.anomaly(sine_tags[:50])
+    with jax.disable_jit():
+        eager_frame = det.anomaly(sine_tags[:50])
+    np.testing.assert_allclose(
+        jit_frame[("total-anomaly-score", "")].to_numpy(),
+        eager_frame[("total-anomaly-score", "")].to_numpy(),
+        rtol=1e-3, atol=1e-4,
+    )
+
+
+def test_fit_under_debug_nans(module, sine_tags):
+    """The whole training program must stay finite under jax_debug_nans
+    (any NaN raises immediately instead of poisoning params silently)."""
+    jax.config.update("jax_debug_nans", True)
+    try:
+        params, hist = fit(
+            module, sine_tags, sine_tags,
+            TrainConfig(epochs=1, batch_size=128),
+        )
+        assert np.all(np.isfinite(hist))
+    finally:
+        jax.config.update("jax_debug_nans", False)
