@@ -239,14 +239,19 @@ def _make_client(ctx, **kwargs):
 @click.option("--output-dir", default=None,
               help="Forward scored frames to this directory.")
 @click.option("--parallelism", default=10, show_default=True)
+@click.option("--bulk", is_flag=True,
+              help="Use the server's stacked bulk route (one vmapped "
+                   "dispatch per chunk across all machines).")
 @click.pass_context
-def client_predict(ctx, start, end, machine_names, output_dir, parallelism):
+def client_predict(ctx, start, end, machine_names, output_dir, parallelism,
+                   bulk):
     """Score [START, END] for the project's machines."""
     from gordo_tpu.client.forwarders import ForwardPredictionsToDisk
 
     forwarder = ForwardPredictionsToDisk(output_dir) if output_dir else None
     client = _make_client(
-        ctx, prediction_forwarder=forwarder, parallelism=parallelism
+        ctx, prediction_forwarder=forwarder, parallelism=parallelism,
+        use_bulk=bulk,
     )
     results = client.predict(start, end, machine_names or None)
     ok = sum(r.ok for r in results)

@@ -121,6 +121,7 @@ class Client:
         forward_resampled_sensors: bool = False,
         n_retries: int = 3,
         use_anomaly: bool = True,
+        use_bulk: bool = False,
         timeout: float = 120.0,
     ):
         self.project = project
@@ -132,6 +133,7 @@ class Client:
         self.parallelism = int(parallelism)
         self.n_retries = int(n_retries)
         self.use_anomaly = use_anomaly
+        self.use_bulk = use_bulk
         self.timeout = timeout
 
     # -- URLs ----------------------------------------------------------------
@@ -206,11 +208,109 @@ class Client:
                 if machine_names
                 else await self.machine_names_async(session)
             )
+            if self.use_bulk and not self.use_anomaly:
+                logger.warning(
+                    "use_bulk=True requires use_anomaly=True (the bulk route "
+                    "is anomaly-only); falling back to per-machine requests"
+                )
+            if self.use_bulk and self.use_anomaly:
+                return await self._predict_bulk(session, sem, names, start, end)
             tasks = [
                 self._predict_machine(session, sem, name, start, end)
                 for name in names
             ]
             return list(await asyncio.gather(*tasks))
+
+    async def _predict_bulk(
+        self,
+        session: aiohttp.ClientSession,
+        sem: asyncio.Semaphore,
+        names: List[str],
+        start: Any,
+        end: Any,
+    ) -> List[PredictionResult]:
+        """Score via the server's stacked bulk route: the i-th request
+        carries every machine's i-th chunk, so the server dispatches one
+        vmapped program per chunk instead of ``machines x chunks`` singles."""
+        loop = asyncio.get_running_loop()
+
+        async def fetch(name: str):
+            meta = await self.machine_metadata_async(session, name)
+            X = await loop.run_in_executor(
+                None, self._fetch_data, meta.get("dataset", {}), start, end
+            )
+            return name, meta, X
+
+        data: Dict[str, pd.DataFrame] = {}
+        metas: Dict[str, Dict] = {}
+        errors: Dict[str, List[str]] = {name: [] for name in names}
+        fetched = await asyncio.gather(
+            *(fetch(n) for n in names), return_exceptions=True
+        )
+        for name, res in zip(names, fetched):
+            if isinstance(res, BaseException):
+                logger.error("Data fetch failed for %s: %s", name, res)
+                errors[name].append(f"data: {res}")
+            else:
+                data[res[0]], metas[res[0]] = res[2], res[1]
+
+        n_chunks = {
+            name: -(-len(X) // self.batch_size) for name, X in data.items()
+        }
+        frames: Dict[str, List[pd.DataFrame]] = {name: [] for name in data}
+
+        async def score_round(idx: int):
+            payload_X = {}
+            chunk_index: Dict[str, pd.Index] = {}
+            for name, X in data.items():
+                if idx < n_chunks[name]:
+                    chunk = X.iloc[idx * self.batch_size : (idx + 1) * self.batch_size]
+                    payload_X[name] = chunk.to_numpy(np.float32).tolist()
+                    chunk_index[name] = chunk.index
+            if not payload_X:
+                return
+            url = f"{self.base_url}{API_PREFIX}/{self.project}/_bulk/anomaly/prediction"
+            try:
+                async with sem:
+                    body = await post_json(
+                        session, url, {"X": payload_X},
+                        retries=self.n_retries, timeout=self.timeout,
+                    )
+            except Exception as exc:
+                # a failed round affects ONLY the machines whose chunks
+                # rode in it — machines complete in other rounds stay ok
+                for name in payload_X:
+                    errors[name].append(f"chunk {idx}: {exc}")
+                return
+            for name, res in body["data"].items():
+                if "error" in res:
+                    errors[name].append(str(res["error"]))
+                    continue
+                tags = [str(c) for c in data[name].columns]
+                frames[name].append(
+                    _frame_from_payload(res, tags, chunk_index[name])
+                )
+
+        rounds = max(n_chunks.values(), default=0)
+        await asyncio.gather(*(score_round(i) for i in range(rounds)))
+
+        async def finish(name: str) -> PredictionResult:
+            machine_frames = frames.get(name) or []
+            predictions = (
+                pd.concat(machine_frames).sort_index() if machine_frames else None
+            )
+            if predictions is not None and self.prediction_forwarder is not None:
+                try:
+                    await loop.run_in_executor(
+                        None, self.prediction_forwarder, predictions, name,
+                        metas.get(name),
+                    )
+                except Exception as exc:
+                    logger.exception("Forwarding failed for %s", name)
+                    errors[name].append(f"forwarder: {exc}")
+            return PredictionResult(name, predictions, errors[name])
+
+        return list(await asyncio.gather(*(finish(n) for n in names)))
 
     async def _predict_machine(
         self,

@@ -232,13 +232,23 @@ class FleetScorer:
                 if bucket.mode == "ae"
                 else bucket.lookback if bucket.mode == "forecast" else 0
             )
+            ok_names = []
             for n in wanted:
                 rows = np.asarray(X_by_name[n]).shape[0]
                 if rows <= offset_check:
-                    raise ValueError(
-                        f"Machine {n!r} needs more than {offset_check} rows "
-                        f"(lookback window), got {rows}"
-                    )
+                    # report per machine; one short machine must not sink
+                    # the whole bulk request
+                    results[n] = {
+                        "error": (
+                            f"needs more than {offset_check} rows "
+                            f"(lookback window), got {rows}"
+                        )
+                    }
+                else:
+                    ok_names.append(n)
+            wanted = ok_names
+            if not wanted:
+                continue
             arrays = {n: np.asarray(X_by_name[n], np.float32) for n in wanted}
             n_rows = _bucket_rows(max(a.shape[0] for a in arrays.values()))
             n_feat = next(iter(arrays.values())).shape[1]
@@ -291,12 +301,15 @@ class FleetScorer:
 
         for name, scorer in self.fallbacks.items():
             if name in X_by_name:
+                X = np.asarray(X_by_name[name], np.float32)
                 try:
-                    results[name] = scorer.anomaly_arrays(
-                        np.asarray(X_by_name[name], np.float32)
-                    )
-                except (TypeError, AttributeError) as exc:
-                    # e.g. non-anomaly model or missing thresholds — report
-                    # per machine instead of sinking the whole bulk request
+                    results[name] = scorer.anomaly_arrays(X)
+                except TypeError:
+                    # non-anomaly model: serve its plain prediction (mirrors
+                    # the client's 422 -> /prediction fallback)
+                    results[name] = {"model-output": scorer.predict(X)}
+                except AttributeError as exc:
+                    # missing thresholds with require_thresholds — report per
+                    # machine instead of sinking the whole bulk request
                     results[name] = {"error": str(exc)}
         return results

@@ -175,3 +175,27 @@ def test_frame_from_payload_shapes():
     assert frame.index[0] == idx[2]
     assert frame[("tag-anomaly-thresholds", "b")].iloc[0] == 0.7
     assert frame[("total-anomaly-threshold", "")].iloc[-1] == 0.9
+
+
+def test_predict_bulk_matches_per_machine(model_dir):
+    """use_bulk=True must return the same frames as the per-machine path."""
+
+    def run(port):
+        normal = Client("cliproj", port=port, batch_size=60).predict(
+            "2017-12-27T06:00:00Z", "2017-12-27T18:00:00Z"
+        )
+        bulk = Client("cliproj", port=port, batch_size=60, use_bulk=True).predict(
+            "2017-12-27T06:00:00Z", "2017-12-27T18:00:00Z"
+        )
+        return normal, bulk
+
+    normal, bulk = _serve_and(model_dir, run)
+    assert [r.name for r in normal] == [r.name for r in bulk]
+    for a, b in zip(normal, bulk):
+        assert b.ok, b.error_messages
+        assert len(a.predictions) == len(b.predictions)
+        np.testing.assert_allclose(
+            a.predictions[("total-anomaly-score", "")].to_numpy(),
+            b.predictions[("total-anomaly-score", "")].to_numpy(),
+            rtol=1e-4, atol=1e-5,
+        )

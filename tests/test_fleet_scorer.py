@@ -142,8 +142,8 @@ def test_short_rows_rejected(models):
     det.fit(X)
     scorer = FleetScorer.from_models({"lstm-m": det})
     assert scorer.n_stacked == 1
-    with pytest.raises(ValueError, match="lookback"):
-        scorer.score_all({"lstm-m": X[:4]})
+    out = scorer.score_all({"lstm-m": X[:4]})
+    assert "error" in out["lstm-m"] and "lookback" in out["lstm-m"]["error"]
 
 
 def test_unthresholded_require_thresholds_goes_to_fallback(models):
