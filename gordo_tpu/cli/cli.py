@@ -217,17 +217,23 @@ def run_watchman_cmd(project, machines, machine_config, targets, host, port,
 @click.option("--project", envvar="PROJECT_NAME", default="project")
 @click.option("--host", default="localhost", show_default=True)
 @click.option("--port", default=5555, show_default=True)
+@click.option("--watchman-url", default=None,
+              help="Discover machines from this watchman (healthy only).")
 @click.pass_context
-def client_group(ctx, project, host, port):
+def client_group(ctx, project, host, port, watchman_url):
     """Query ML servers: bulk predictions, metadata, model download."""
-    ctx.obj = {"project": project, "host": host, "port": port}
+    ctx.obj = {
+        "project": project, "host": host, "port": port,
+        "watchman_url": watchman_url,
+    }
 
 
 def _make_client(ctx, **kwargs):
     from gordo_tpu.client import Client
 
     return Client(
-        ctx.obj["project"], host=ctx.obj["host"], port=ctx.obj["port"], **kwargs
+        ctx.obj["project"], host=ctx.obj["host"], port=ctx.obj["port"],
+        watchman_url=ctx.obj["watchman_url"], **kwargs
     )
 
 

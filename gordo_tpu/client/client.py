@@ -122,6 +122,7 @@ class Client:
         n_retries: int = 3,
         use_anomaly: bool = True,
         use_bulk: bool = False,
+        watchman_url: Optional[str] = None,
         timeout: float = 120.0,
     ):
         self.project = project
@@ -134,6 +135,7 @@ class Client:
         self.n_retries = int(n_retries)
         self.use_anomaly = use_anomaly
         self.use_bulk = use_bulk
+        self.watchman_url = watchman_url
         self.timeout = timeout
 
     # -- URLs ----------------------------------------------------------------
@@ -145,6 +147,23 @@ class Client:
 
     # -- discovery / metadata ------------------------------------------------
     async def machine_names_async(self, session: aiohttp.ClientSession) -> List[str]:
+        """Discover machines: from the watchman status document when
+        ``watchman_url`` is configured (reference behavior — only healthy
+        endpoints are scored), else from the ML server's project index."""
+        if self.watchman_url:
+            body = await get_json(
+                session, self.watchman_url.rstrip("/") + "/",
+                retries=self.n_retries, timeout=self.timeout,
+            )
+            names = []
+            for ep in body.get("endpoints", []):
+                if ep.get("healthy"):
+                    names.append(ep["target-name"])
+                else:
+                    logger.warning(
+                        "Skipping unhealthy endpoint %s", ep.get("target-name")
+                    )
+            return names
         body = await get_json(
             session, self._project_url(), retries=self.n_retries, timeout=self.timeout
         )
@@ -299,18 +318,30 @@ class Client:
             predictions = (
                 pd.concat(machine_frames).sort_index() if machine_frames else None
             )
-            if predictions is not None and self.prediction_forwarder is not None:
-                try:
-                    await loop.run_in_executor(
-                        None, self.prediction_forwarder, predictions, name,
-                        metas.get(name),
-                    )
-                except Exception as exc:
-                    logger.exception("Forwarding failed for %s", name)
-                    errors[name].append(f"forwarder: {exc}")
+            await self._forward(predictions, name, metas.get(name), errors[name])
             return PredictionResult(name, predictions, errors[name])
 
         return list(await asyncio.gather(*(finish(n) for n in names)))
+
+    async def _forward(
+        self,
+        predictions: Optional[pd.DataFrame],
+        machine: str,
+        meta: Optional[Dict],
+        errors: List[str],
+    ) -> None:
+        """Push a scored frame to the configured sink; a sink failure is a
+        per-machine error, never an exception."""
+        if predictions is None or self.prediction_forwarder is None:
+            return
+        loop = asyncio.get_running_loop()
+        try:
+            await loop.run_in_executor(
+                None, self.prediction_forwarder, predictions, machine, meta
+            )
+        except Exception as exc:
+            logger.exception("Forwarding failed for %s", machine)
+            errors.append(f"forwarder: {exc}")
 
     async def _predict_machine(
         self,
@@ -369,16 +400,7 @@ class Client:
                 frames.append(res)
 
         predictions = pd.concat(frames).sort_index() if frames else None
-        if predictions is not None and self.prediction_forwarder is not None:
-            try:
-                await loop.run_in_executor(
-                    None, self.prediction_forwarder, predictions, machine, meta
-                )
-            except Exception as exc:
-                # a sink failure must not sink the scoring result (nor the
-                # other machines' gathered results)
-                logger.exception("Forwarding failed for %s", machine)
-                errors.append(f"forwarder: {exc}")
+        await self._forward(predictions, machine, meta, errors)
         return PredictionResult(machine, predictions, errors)
 
     # -- data fetch (host-side, reference behavior: client refetches raw) ----

@@ -303,13 +303,14 @@ class FleetScorer:
             if name in X_by_name:
                 X = np.asarray(X_by_name[name], np.float32)
                 try:
-                    results[name] = scorer.anomaly_arrays(X)
-                except TypeError:
-                    # non-anomaly model: serve its plain prediction (mirrors
-                    # the client's 422 -> /prediction fallback)
-                    results[name] = {"model-output": scorer.predict(X)}
-                except AttributeError as exc:
-                    # missing thresholds with require_thresholds — report per
-                    # machine instead of sinking the whole bulk request
+                    if scorer.is_anomaly:
+                        results[name] = scorer.anomaly_arrays(X)
+                    else:
+                        # non-anomaly model: serve its plain prediction
+                        # (mirrors the client's 422 -> /prediction fallback)
+                        results[name] = {"model-output": scorer.predict(X)}
+                except Exception as exc:
+                    # missing thresholds, short rows, model-internal errors —
+                    # report per machine instead of sinking the bulk request
                     results[name] = {"error": str(exc)}
         return results

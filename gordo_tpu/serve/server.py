@@ -239,16 +239,28 @@ async def bulk_anomaly_prediction(request: web.Request) -> web.Response:
             raise ValueError(
                 "Payload must be {'X': {machine: rows}} for bulk scoring"
             )
-        X_by_name = {}
-        for name, rows in payload["X"].items():
-            entry = collection.get(name)
+    except ValueError as exc:
+        return web.json_response({"error": str(exc)}, status=400)
+    # per-machine validation: one bad machine reports in ITS result slot and
+    # must not 400 the rest of the fleet
+    X_by_name: Dict[str, np.ndarray] = {}
+    machine_errors: Dict[str, Dict[str, str]] = {}
+    for name, rows in payload["X"].items():
+        entry = collection.get(name)
+        try:
             if entry is None:
                 raise ValueError(f"Unknown machine {name!r}")
             X = parse_X({"X": rows}, entry.tags)
             _validate_width(X, entry)
             X_by_name[name] = X
-    except ValueError as exc:
-        return web.json_response({"error": str(exc)}, status=400)
+        except ValueError as exc:
+            machine_errors[name] = {"error": str(exc)}
+    if not X_by_name and machine_errors:
+        return web.json_response(
+            {"error": "No valid machines in payload",
+             "data": machine_errors},
+            status=400,
+        )
     loop = asyncio.get_running_loop()
     try:
         # resolve the lazy scorer inside the executor too: first-call param
@@ -256,14 +268,14 @@ async def bulk_anomaly_prediction(request: web.Request) -> web.Response:
         out = await loop.run_in_executor(
             None, lambda: collection.fleet_scorer.score_all(X_by_name)
         )
-    except ValueError as exc:
-        return web.json_response({"error": str(exc)}, status=400)
     except Exception as exc:
         logger.exception("Bulk anomaly scoring failed")
         return web.json_response({"error": str(exc)}, status=500)
+    data = {name: _jsonable(res) for name, res in out.items()}
+    data.update(machine_errors)
     return web.json_response(
         {
-            "data": {name: _jsonable(res) for name, res in out.items()},
+            "data": data,
             "time-seconds": round(time.perf_counter() - t0, 6),
         }
     )

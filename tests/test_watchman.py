@@ -102,3 +102,45 @@ def test_watchman_healthcheck():
             await client.close()
 
     assert asyncio.run(main()) == 200
+
+
+def test_client_discovers_via_watchman(model_dir):
+    """Reference behavior: the client gets its machine list from watchman
+    and skips unhealthy endpoints."""
+    from gordo_tpu.client import Client
+    from gordo_tpu.watchman import Watchman, build_watchman_app
+
+    async def main():
+        collection = ModelCollection.from_directory(model_dir, project="wmproj")
+        ml_runner = web.AppRunner(build_app(collection))
+        await ml_runner.setup()
+        ml_site = web.TCPSite(ml_runner, "127.0.0.1", 0)
+        await ml_site.start()
+        ml_port = ml_runner.addresses[0][1]
+
+        watchman = Watchman(
+            "wmproj",
+            machines=["wm-machine", "ghost-machine"],
+            target_base_urls=[f"http://127.0.0.1:{ml_port}"],
+            poll_interval=3600,
+        )
+        wm_runner = web.AppRunner(build_watchman_app(watchman))
+        await wm_runner.setup()
+        wm_site = web.TCPSite(wm_runner, "127.0.0.1", 0)
+        await wm_site.start()
+        wm_port = wm_runner.addresses[0][1]
+
+        try:
+            client = Client(
+                "wmproj", port=ml_port,
+                watchman_url=f"http://127.0.0.1:{wm_port}",
+            )
+            import aiohttp
+            async with aiohttp.ClientSession() as session:
+                return await client.machine_names_async(session)
+        finally:
+            await wm_runner.cleanup()
+            await ml_runner.cleanup()
+
+    names = asyncio.run(main())
+    assert names == ["wm-machine"]  # ghost skipped as unhealthy
