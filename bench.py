@@ -21,6 +21,7 @@ import os
 import shutil
 import sys
 import tempfile
+import threading
 import time
 
 import numpy as np
@@ -32,9 +33,59 @@ NORTH_STAR_SAMPLES_PER_SEC_PER_CHIP = 100_000
 N_MACHINES = int(os.environ.get("BENCH_MODELS", "512"))
 N_TAGS = int(os.environ.get("BENCH_TAGS", "10"))
 
+#: hard wall-clock budget for the whole bench; must stay under the driver's
+#: own timeout so a wedge yields a diagnostic JSON line instead of rc=124.
+DEADLINE_S = float(os.environ.get("BENCH_DEADLINE_S", "1200"))
+#: budget for jax backend init alone — the axon tunnel's failure mode is an
+#: INDEFINITE BLOCK inside jax.devices() (see .claude/skills/verify/SKILL.md),
+#: which no amount of retry-on-exception can escape.
+INIT_TIMEOUT_S = float(os.environ.get("BENCH_INIT_TIMEOUT_S", "180"))
+
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
+
+
+_emit_lock = threading.Lock()
+_emitted = False
+
+
+def emit_once(out: dict) -> None:
+    """Print the single JSON result line exactly once (main path and the
+    watchdog race for it; whoever gets here first wins).
+
+    Serializes a SNAPSHOT (the watchdog may fire while main mutates ``out``)
+    and only marks emitted after the print actually succeeded, so a
+    serialization hiccup can't permanently swallow the output line.
+    """
+    global _emitted
+    with _emit_lock:
+        if _emitted:
+            return
+        try:
+            line = json.dumps(dict(out))
+        except Exception as exc:
+            line = json.dumps(
+                {"metric": "bench", "value": None, "error": f"emit: {exc}"}
+            )
+        print(line, flush=True)
+        _emitted = True
+
+
+def start_watchdog(out: dict) -> None:
+    """If the deadline passes, emit whatever has been measured so far and
+    hard-exit 0: a partial diagnostic line beats a dead rc=124."""
+
+    def fire():
+        out.setdefault("error", f"bench deadline ({DEADLINE_S:.0f}s) hit")
+        log(f"WATCHDOG: deadline {DEADLINE_S:.0f}s hit; emitting partial result")
+        emit_once(out)
+        sys.stdout.flush()
+        os._exit(0)
+
+    t = threading.Timer(DEADLINE_S, fire)
+    t.daemon = True
+    t.start()
 
 
 def make_machines(n: int):
@@ -123,48 +174,120 @@ def bench_serving() -> float:
     return max(single, stacked)
 
 
-def main() -> None:
+def init_devices(attempts: int = 5, backoff_s: float = 2.0):
+    """Initialize the jax backend with bounded retry.
+
+    The TPU tunnel (axon PJRT plugin) intermittently fails init with
+    UNAVAILABLE when another session holds the chip — the exact failure that
+    cost round 1 its only perf number (BENCH_r01.json rc=1).  jax caches
+    backend-init errors, so each retry clears backend state first.
+    """
     import jax
+
+    last_exc: Exception | None = None
+    for attempt in range(attempts):
+        try:
+            devices = jax.devices()
+            log(
+                f"jax {jax.__version__} devices (attempt {attempt + 1}): "
+                f"{[d.platform for d in devices]}"
+            )
+            return devices
+        except Exception as exc:  # backend init failed — clear cache, retry
+            last_exc = exc
+            delay = backoff_s * (2**attempt)
+            log(
+                f"backend init attempt {attempt + 1}/{attempts} failed: "
+                f"{exc!r}; retrying in {delay:.0f}s"
+            )
+            try:
+                import jax.extend.backend
+
+                jax.extend.backend.clear_backends()
+            except Exception as clear_exc:
+                log(f"clear_backends failed: {clear_exc!r}")
+            time.sleep(delay)
+    raise RuntimeError(
+        f"jax backend init failed after {attempts} attempts: {last_exc!r}"
+    )
+
+
+def init_devices_bounded():
+    """Backend init under a deadline: runs :func:`init_devices` in a side
+    thread so an indefinite block inside ``jax.devices()`` (wedged axon
+    relay grant) surfaces as a TimeoutError instead of hanging the bench."""
+    box: dict = {}
+
+    def target():
+        try:
+            box["devices"] = init_devices()
+        except Exception as exc:
+            box["error"] = exc
+
+    t = threading.Thread(target=target, daemon=True)
+    t.start()
+    t.join(INIT_TIMEOUT_S)
+    if t.is_alive():
+        raise TimeoutError(
+            f"jax backend init blocked for {INIT_TIMEOUT_S:.0f}s "
+            "(axon tunnel wedge — relay grant likely stuck)"
+        )
+    if "error" in box:
+        raise box["error"]
+    return box["devices"]
+
+
+def main() -> None:
+    """Run each bench stage independently; ALWAYS print exactly one JSON
+    line, even on failure (a diagnostic record instead of a dead rc=1)."""
+    out: dict = {
+        "metric": "per-tag anomaly-detector builds/hour/chip (full build path)",
+        "value": None,
+        "unit": "models/hour/chip",
+        "vs_baseline": None,
+        "n_machines": N_MACHINES,
+    }
+    start_watchdog(out)
+    try:
+        devices = init_devices_bounded()
+    except Exception as exc:
+        out["error"] = f"backend init: {exc}"
+        emit_once(out)
+        os._exit(0)  # init thread may still be wedged in jax.devices()
 
     from gordo_tpu.parallel.mesh import fleet_mesh
 
-    devices = jax.devices()
     n_chips = len(devices)
-    log(f"jax {jax.__version__} devices: {[d.platform for d in devices]}")
+    out["n_chips"] = n_chips
+    out["platform"] = devices[0].platform
     mesh = fleet_mesh(devices) if n_chips > 1 else None
 
-    models_per_hour = bench_build(mesh)
-    per_chip = models_per_hour / n_chips
+    try:
+        models_per_hour = bench_build(mesh)
+        per_chip = models_per_hour / n_chips
+        out["value"] = round(per_chip, 1)
+        out["vs_baseline"] = round(
+            per_chip / NORTH_STAR_MODELS_PER_HOUR_PER_CHIP, 3
+        )
+    except Exception as exc:
+        log(f"build bench failed: {exc!r}")
+        out["error"] = f"build bench: {exc}"
+
     try:
         samples_per_sec = bench_serving()
-    except Exception as exc:  # serving is the secondary metric
-        log(f"serving bench failed: {exc}")
-        samples_per_sec = None
-
-    print(
-        json.dumps(
-            {
-                "metric": "per-tag anomaly-detector builds/hour/chip (full build path)",
-                "value": round(per_chip, 1),
-                "unit": "models/hour/chip",
-                "vs_baseline": round(
-                    per_chip / NORTH_STAR_MODELS_PER_HOUR_PER_CHIP, 3
-                ),
-                "n_chips": n_chips,
-                "n_machines": N_MACHINES,
-                "serving_samples_per_sec_per_chip": (
-                    None if samples_per_sec is None else round(samples_per_sec)
-                ),
-                "serving_vs_target": (
-                    None
-                    if samples_per_sec is None
-                    else round(
-                        samples_per_sec / NORTH_STAR_SAMPLES_PER_SEC_PER_CHIP, 3
-                    )
-                ),
-            }
+        # Serving runs on a single device (scorers place work on one chip);
+        # report the raw rate under an honest name plus the device count so
+        # the headline can't silently inflate if serving ever shards.
+        out["serving_samples_per_sec"] = round(samples_per_sec)
+        out["serving_devices"] = 1
+        out["serving_vs_target"] = round(
+            samples_per_sec / NORTH_STAR_SAMPLES_PER_SEC_PER_CHIP, 3
         )
-    )
+    except Exception as exc:  # serving is the secondary metric
+        log(f"serving bench failed: {exc!r}")
+        out.setdefault("error", f"serving bench: {exc}")
+
+    emit_once(out)
 
 
 if __name__ == "__main__":

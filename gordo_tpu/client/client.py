@@ -65,25 +65,40 @@ def _frame_from_payload(
         raise ValueError(f"Response has no recognised outputs: {sorted(data)}")
     idx = index[-n:] if len(index) >= n else pd.RangeIndex(n)
 
+    # Known response keys dispatch on NAME, never shape: a 1-D per-tag
+    # constant is indistinguishable from a per-row series whenever a chunk's
+    # row count happens to equal the tag count, so shape-sniffing is only a
+    # fallback for keys this schema doesn't know.
+    PER_TAG_CONSTANT = {"tag-anomaly-thresholds"}
+    PER_ROW_SERIES = {"total-anomaly-score", "anomaly-confidence"}
+    SCALAR = {"total-anomaly-threshold"}
+
     columns: Dict[Tuple[str, str], Any] = {}
+
+    def tag_names(width: int) -> List[str]:
+        return (
+            [str(t) for t in tags]
+            if width == len(tags)
+            else [str(i) for i in range(width)]
+        )
+
     for key, value in data.items():
         arr = np.asarray(value)
-        if arr.ndim == 2 and arr.shape[0] == n:
-            names = tags if arr.shape[1] == len(tags) else [
-                str(i) for i in range(arr.shape[1])
-            ]
-            for j, tag in enumerate(names):
-                columns[(key, str(tag))] = arr[:, j]
-        elif arr.ndim == 1 and arr.shape[0] == n:
-            columns[(key, "")] = arr
-        elif arr.ndim == 1:  # per-tag constants (thresholds)
-            names = tags if arr.shape[0] == len(tags) else [
-                str(i) for i in range(arr.shape[0])
-            ]
-            for j, tag in enumerate(names):
-                columns[(key, str(tag))] = np.full(n, arr[j])
-        elif arr.ndim == 0:  # scalar (aggregate threshold)
+        if key in SCALAR or (key not in PER_TAG_CONSTANT and arr.ndim == 0):
             columns[(key, "")] = np.full(n, float(arr))
+        elif key in PER_TAG_CONSTANT and arr.ndim == 1:
+            for j, tag in enumerate(tag_names(arr.shape[0])):
+                columns[(key, tag)] = np.full(n, arr[j])
+        elif key in PER_ROW_SERIES and arr.ndim == 1:
+            columns[(key, "")] = arr
+        elif arr.ndim == 2 and arr.shape[0] == n:
+            for j, tag in enumerate(tag_names(arr.shape[1])):
+                columns[(key, tag)] = arr[:, j]
+        elif arr.ndim == 1 and arr.shape[0] == n:  # unknown key: per-row guess
+            columns[(key, "")] = arr
+        elif arr.ndim == 1:  # unknown key, wrong length: per-tag constant guess
+            for j, tag in enumerate(tag_names(arr.shape[0])):
+                columns[(key, tag)] = np.full(n, arr[j])
     frame = pd.DataFrame(columns, index=idx)
     frame.columns = pd.MultiIndex.from_tuples(frame.columns)
     return frame

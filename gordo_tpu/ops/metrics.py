@@ -57,51 +57,3 @@ METRICS = {
     "mean_squared_error": mean_squared_error,
     "mean_absolute_error": mean_absolute_error,
 }
-
-
-# -- masked variants ---------------------------------------------------------
-# Row-mask forms of the same metrics, for device-side CV where a fold's test
-# rows are selected by a static-shape boolean mask instead of fancy indexing
-# (fleet engine: folds and models are vmap axes, shapes must stay static).
-
-def _masked_moments(y, mask_col):
-    n = jnp.maximum(jnp.sum(mask_col), 1.0)
-    mean = jnp.sum(y * mask_col, axis=0) / n
-    var = jnp.sum(((y - mean) ** 2) * mask_col, axis=0) / n
-    return n, mean, var
-
-
-def masked_explained_variance(y_true, y_pred, mask) -> jnp.ndarray:
-    m = mask[:, None].astype(jnp.float32)
-    diff = (y_true - y_pred)
-    _, _, num = _masked_moments(diff, m)
-    _, _, den = _masked_moments(y_true, m)
-    return jnp.mean(1.0 - num / jnp.maximum(den, _EPS))
-
-
-def masked_r2(y_true, y_pred, mask) -> jnp.ndarray:
-    m = mask[:, None].astype(jnp.float32)
-    ss_res = jnp.sum(((y_true - y_pred) ** 2) * m, axis=0)
-    _, mean, _ = _masked_moments(y_true, m)
-    ss_tot = jnp.sum(((y_true - mean) ** 2) * m, axis=0)
-    return jnp.mean(1.0 - ss_res / jnp.maximum(ss_tot, _EPS))
-
-
-def masked_mse(y_true, y_pred, mask) -> jnp.ndarray:
-    m = mask[:, None].astype(jnp.float32)
-    n = jnp.maximum(jnp.sum(m), 1.0)
-    return jnp.sum(jnp.mean((y_true - y_pred) ** 2, axis=1, keepdims=True) * m) / n
-
-
-def masked_mae(y_true, y_pred, mask) -> jnp.ndarray:
-    m = mask[:, None].astype(jnp.float32)
-    n = jnp.maximum(jnp.sum(m), 1.0)
-    return jnp.sum(jnp.mean(jnp.abs(y_true - y_pred), axis=1, keepdims=True) * m) / n
-
-
-MASKED_METRICS = {
-    "explained_variance_score": masked_explained_variance,
-    "r2_score": masked_r2,
-    "mean_squared_error": masked_mse,
-    "mean_absolute_error": masked_mae,
-}

@@ -15,6 +15,7 @@ instead of round-tripping through host numpy between pipeline steps
 
 from __future__ import annotations
 
+import warnings
 from typing import Callable, Optional
 
 import jax
@@ -24,6 +25,21 @@ import numpy as np
 from gordo_tpu.utils.args import ParamsMixin, capture_args
 
 _EPS = 1e-12
+
+
+def _warn_ignored(cls_name: str, kwargs: dict) -> None:
+    """Sklearn-compat kwargs this implementation does not honour (e.g.
+    ``QuantileTransformer(subsample=...)``, ``PCA(whiten=True)``,
+    ``SimpleImputer(add_indicator=True)``) are accepted so reference YAML
+    loads unchanged — but silently changing behaviour is worse than a
+    loud warning, so say exactly what is being ignored."""
+    if kwargs:
+        warnings.warn(
+            f"{cls_name}: ignoring unsupported sklearn kwargs "
+            f"{sorted(kwargs)} — behaviour may differ from sklearn",
+            UserWarning,
+            stacklevel=3,
+        )
 
 
 def as_float2d(X) -> jnp.ndarray:
@@ -113,6 +129,7 @@ class MinMaxScaler(BaseTransform):
     @capture_args
     def __init__(self, feature_range=(0, 1), **_sklearn_kwargs):
         super().__init__()
+        _warn_ignored(type(self).__name__, _sklearn_kwargs)
         self.feature_range = tuple(feature_range)
 
     def _stat_options(self):
@@ -141,6 +158,7 @@ class StandardScaler(BaseTransform):
     @capture_args
     def __init__(self, with_mean: bool = True, with_std: bool = True, **_sklearn_kwargs):
         super().__init__()
+        _warn_ignored(type(self).__name__, _sklearn_kwargs)
         self.with_mean = with_mean
         self.with_std = with_std
 
@@ -173,6 +191,7 @@ class RobustScaler(BaseTransform):
     def __init__(self, with_centering: bool = True, with_scaling: bool = True,
                  quantile_range=(25.0, 75.0), **_sklearn_kwargs):
         super().__init__()
+        _warn_ignored(type(self).__name__, _sklearn_kwargs)
         self.with_centering = with_centering
         self.with_scaling = with_scaling
         self.quantile_range = tuple(quantile_range)
@@ -214,6 +233,7 @@ class QuantileTransformer(BaseTransform):
     def __init__(self, n_quantiles: int = 100, output_distribution: str = "uniform",
                  **_sklearn_kwargs):
         super().__init__()
+        _warn_ignored(type(self).__name__, _sklearn_kwargs)
         self.n_quantiles = int(n_quantiles)
         self.output_distribution = output_distribution
 
@@ -261,6 +281,7 @@ class SimpleImputer(BaseTransform):
     def __init__(self, strategy: str = "mean", fill_value: float = 0.0,
                  **_sklearn_kwargs):
         super().__init__()
+        _warn_ignored(type(self).__name__, _sklearn_kwargs)
         self.strategy = strategy
         self.fill_value = fill_value
 
@@ -301,6 +322,7 @@ class PCA(BaseTransform):
     @capture_args
     def __init__(self, n_components: Optional[int] = None, **_sklearn_kwargs):
         super().__init__()
+        _warn_ignored(type(self).__name__, _sklearn_kwargs)
         self.n_components = n_components
 
     def fit(self, X, y=None):

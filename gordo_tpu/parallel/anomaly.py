@@ -12,16 +12,16 @@ a few jitted dispatches, sharded over the mesh ``"models"`` axis.  Output is
 M individually fitted :class:`DiffBasedAnomalyDetector` objects, artifact-
 and metadata-compatible with the single-machine path.
 
-Equivalence contract (tests/test_fleet.py): for machines whose row count
-equals the bucket maximum, the FINAL model (params, scaler stats, anomaly
-scores) is bit-identical to the single-machine path — RNG derivation,
-padding, and shuffle match ``train.fit.fit`` exactly.  Shorter machines in
-a ragged bucket, and all CV-fold fits, are *statistically* equivalent but
-not bit-identical: batch geometry/fold membership come from the bucket-wide
-padded length, so the per-epoch shuffle permutes a different row count than
-the materialized single-machine arrays would, changing minibatch
-composition — same estimator, different sample of SGD noise (a few percent
-on fold-averaged thresholds at small epoch counts).
+Equivalence contract (tests/test_fleet.py): EVERY machine's result —
+CV-fold fits, fold metrics, thresholds, scaler stats, final params — is
+numerically identical to the single-machine path (same RNG derivation, same
+materialized fold rows, same per-fold batch geometry and shuffle).  This is
+achieved by grouping machines by row count inside each bucket: within a
+length-group, fold indices and batch geometry are shared static values, so
+each fold is materialized exactly as ``train.cv.cross_validate`` would
+(gather fold rows → fit scalers on them → window → pad to the fold's own
+``steps × bs``), then vmapped over machines.  A ragged bucket simply yields
+several length-groups, each exact — no weight-mask approximation anywhere.
 
 Fleetability is *checked, not assumed*: :func:`analyze_definition` inspects
 a prototype built from the model-config definition and returns a spec only
@@ -34,8 +34,9 @@ from __future__ import annotations
 
 import copy
 import dataclasses
+import hashlib
+import logging
 import time
-from functools import partial
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -45,7 +46,6 @@ from jax.sharding import Mesh
 
 from gordo_tpu.anomaly.diff import SMOOTHING_WINDOW, DiffBasedAnomalyDetector
 from gordo_tpu.models.estimator import BaseJaxEstimator
-from gordo_tpu.ops.metrics import MASKED_METRICS
 from gordo_tpu.ops.scalers import (
     BaseTransform,
     MinMaxScaler,
@@ -59,6 +59,8 @@ from gordo_tpu.registry import lookup_factory
 from gordo_tpu.train.cv import build_splitter
 from gordo_tpu.train.fit import TrainConfig, make_fit_fn
 from gordo_tpu.utils.trees import to_host
+
+logger = logging.getLogger(__name__)
 
 #: scalers whose stats are computable by a static pure function (vmappable).
 FLEETABLE_SCALERS = (MinMaxScaler, StandardScaler, RobustScaler)
@@ -146,46 +148,22 @@ def analyze_definition(model) -> Optional[FleetSpec]:
 # Pure device-side pieces
 # ---------------------------------------------------------------------------
 
-def _span_mask(row_mask: np.ndarray, offset: int, lengths: np.ndarray) -> np.ndarray:
-    """Aligned-axis mask: aligned index j is on iff rows ``j..j+offset`` are
-    ALL on in ``row_mask`` and row ``j+offset`` is a real (unpadded) row.
+def _smoothed_max(err: jnp.ndarray, window: int) -> jnp.ndarray:
+    """Max over rows of the trailing rolling-min of ``err``.
 
-    Works for train masks (window+target fully inside the train rows) and
-    test masks (prediction j only uses test rows) alike; host numpy, static
-    shapes. ``row_mask``: (..., N) bool; returns (..., N - offset) bool.
-    """
-    n = row_mask.shape[-1]
-    span = offset + 1
-    c = np.concatenate(
-        [np.zeros(row_mask.shape[:-1] + (1,), np.int64),
-         np.cumsum(row_mask.astype(np.int64), axis=-1)],
-        axis=-1,
-    )
-    full = (c[..., span:] - c[..., : n - offset]) == span  # (..., N - offset)
-    valid = (np.arange(n - offset) + offset) < lengths[..., None]
-    return full & valid
-
-
-def _smoothed_masked_max(err: jnp.ndarray, mask: jnp.ndarray, window: int) -> jnp.ndarray:
-    """Max over masked rows of the trailing rolling-min of ``err``.
-
-    Matches pandas ``rolling(window, min_periods=1).min()`` then ``max()`` on
-    the masked segment (DiffBasedAnomalyDetector threshold smoothing), as a
-    pure static-shape function: off-mask entries become +inf before the
-    rolling min (identity) and -inf before the max.
+    Matches ``anomaly.diff._rolling_min_max`` (pandas ``rolling(window,
+    min_periods=1).min()`` then ``max()``) as a pure static-shape function.
     ``err``: (N, F) — returns (F,).
     """
-    big = jnp.where(mask[:, None], err, jnp.inf)
     neg = -jax.lax.reduce_window(
-        -big,
+        -err,
         -jnp.inf,
         jax.lax.max,
         window_dimensions=(window, 1),
         window_strides=(1, 1),
         padding=((window - 1, 0), (0, 0)),
     )
-    vals = jnp.where(mask[:, None], neg, -jnp.inf)
-    return jnp.max(vals, axis=0)
+    return jnp.max(neg, axis=0)
 
 
 # ---------------------------------------------------------------------------
@@ -210,56 +188,71 @@ class FleetDiffBuilder:
         Xs: Sequence[np.ndarray],
         ys: Optional[Sequence[np.ndarray]] = None,
     ) -> List[DiffBasedAnomalyDetector]:
+        """Build detectors for ``Xs`` in input order.
+
+        Machines are grouped by row count; each length-group runs the exact
+        fold-materializing program, so every machine's result matches the
+        single-machine path (not just the bucket-max ones).
+        """
+        if ys is not None and len(ys) != len(Xs):
+            raise ValueError(
+                f"Got {len(Xs)} input series but {len(ys)} target series"
+            )
+        Xs = [np.asarray(x, np.float32) for x in Xs]
+        if ys is not None:
+            for i, (x, yy) in enumerate(zip(Xs, ys)):
+                if len(yy) != len(x):
+                    raise ValueError(
+                        f"Target row count differs from input for machine {i}: "
+                        f"{len(yy)} != {len(x)}"
+                    )
+
+        groups: Dict[int, List[int]] = {}
+        for i, x in enumerate(Xs):
+            groups.setdefault(int(x.shape[0]), []).append(i)
+        if len(groups) > 1 and len(groups) > len(Xs) // 2:
+            # Exact parity requires one program per distinct row count; a
+            # bucket where most machines differ in length loses the fleet
+            # vmap win and pays one XLA compile per length (still no worse
+            # than the per-machine fallback, but worth surfacing).
+            logger.warning(
+                "Fleet bucket of %d machines has %d distinct row counts; "
+                "each length compiles its own program — consider aligning "
+                "train windows for fleet efficiency",
+                len(Xs), len(groups),
+            )
+
+        detectors: List[Optional[DiffBasedAnomalyDetector]] = [None] * len(Xs)
+        for idxs in groups.values():
+            X_g = np.stack([Xs[i] for i in idxs])
+            y_g = (
+                X_g
+                if ys is None
+                else np.stack(
+                    [np.asarray(ys[i], np.float32) for i in idxs]
+                )
+            )
+            for i, det in zip(idxs, self._build_group(X_g, y_g)):
+                detectors[i] = det
+        return detectors  # type: ignore[return-value]
+
+    def _build_group(
+        self, X: np.ndarray, y: np.ndarray
+    ) -> List[DiffBasedAnomalyDetector]:
+        """One length-homogeneous group as a single exact device program."""
         spec = self.spec
         est_proto = spec.estimator_proto
-        offset = est_proto.offset
+        offset = int(est_proto.offset)
         t0 = time.time()
+        m, n_rows = X.shape[:2]
+        n_features, n_out = X.shape[2], y.shape[2]
 
-        X, w_rows, lengths = fleet_mod.stack_rows(Xs)
-        if ys is None:
-            y = X
-        else:
-            if len(ys) != len(Xs):
-                raise ValueError(
-                    f"Got {len(Xs)} input series but {len(ys)} target series"
-                )
-            y, _, y_lengths = fleet_mod.stack_rows(ys)
-            mismatched = [
-                i for i, (a, b) in enumerate(zip(lengths, y_lengths)) if a != b
-            ]
-            if mismatched:
-                raise ValueError(
-                    "Target row counts differ from inputs for machines "
-                    f"{mismatched}: row masks are derived from X, so shorter "
-                    "targets would silently train on zero padding"
-                )
-        m, n = X.shape[:2]
-        n_features = X.shape[2]
-        n_out = y.shape[2]
-
-        # CV fold row-masks, per machine (fold geometry depends on length).
-        k_folds = self.splitter.get_n_splits()
-        train_rows = np.zeros((m, k_folds, n), dtype=bool)
-        test_rows = np.zeros((m, k_folds, n), dtype=bool)
-        for i, length in enumerate(lengths):
-            tr, te = fleet_mod.fold_masks(int(length), self.splitter)
-            train_rows[i, :, : int(length)] = tr
-            test_rows[i, :, : int(length)] = te
-
-        # Aligned-axis weights: K CV folds + 1 final full fit.
-        w_folds = _span_mask(train_rows, offset, lengths[:, None]).astype(np.float32)
-        w_test = _span_mask(test_rows, offset, lengths[:, None]).astype(np.float32)
-        w_full = _span_mask(
-            w_rows.astype(bool)[:, None, :], offset, lengths[:, None]
-        ).astype(np.float32)
-        w_all = np.concatenate([w_folds, w_full], axis=1)  # (M, K+1, NA)
-
-        # Row masks per fold for scaler fitting (single-machine parity: each
-        # CV fold refits the pipeline scalers on ITS train rows only; the
-        # final fit's scalers see every valid row).
-        rows_all = np.concatenate(
-            [train_rows, w_rows.astype(bool)[:, None, :]], axis=1
-        )  # (M, K+1, N)
+        # Static fold indices — identical to what cross_validate would use.
+        folds = tuple(
+            (tuple(int(i) for i in tr), tuple(int(i) for i in te))
+            for tr, te in self.splitter.split(np.empty((n_rows, 1)))
+        )
+        k_folds = len(folds)
 
         # Factory module for this bucket's shapes.
         factory = lookup_factory(est_proto.model_type, est_proto.kind)
@@ -268,26 +261,13 @@ class FleetDiffBuilder:
         )
         module = factory(**built_kwargs)
 
-        # Pad the model axis for the mesh.
+        # Pad the model axis for the mesh (dummy copies; results discarded).
         m_pad = m
         if self.mesh is not None:
             m_pad = pad_to_multiple(m, self.mesh.shape[MODEL_AXIS])
         if m_pad != m:
             X = fleet_mod._pad_models(X, m_pad)
             y = fleet_mod._pad_models(y, m_pad)
-            rows_all = fleet_mod._pad_models(rows_all, m_pad)
-            w_all = np.concatenate(
-                [w_all, np.zeros((m_pad - m,) + w_all.shape[1:], np.float32)], axis=0
-            )
-            w_test = np.concatenate(
-                [w_test, np.zeros((m_pad - m,) + w_test.shape[1:], np.float32)],
-                axis=0,
-            )
-
-        na = w_all.shape[-1]
-        bs = int(min(spec.train_cfg.batch_size, na))
-        steps = -(-na // bs)
-        na_pad = steps * bs - na
 
         scaler_opts = tuple(
             (type(s), tuple(sorted(s._stat_options().items())))
@@ -309,32 +289,23 @@ class FleetDiffBuilder:
         else:
             window_mode, lookback = "none", 1
 
-        seeds = np.full((m_pad,), spec.seed, dtype=np.uint32)
-        out = _fleet_diff_program(
+        program = _exact_fleet_program(
             module,
             scaler_opts,
             det_scaler_opts,
             window_mode,
-            lookback,
-            int(offset),
+            int(lookback),
+            offset,
             spec.train_cfg,
-            steps,
-            bs,
-            na_pad,
+            folds,
             self.mesh,
-            jnp.asarray(X),
-            jnp.asarray(y),
-            jnp.asarray(rows_all),
-            jnp.asarray(w_all),
-            jnp.asarray(w_test),
-            jnp.asarray(seeds),
         )
+        seeds = np.full((m_pad,), spec.seed, dtype=np.uint32)
+        out = program(jnp.asarray(X), jnp.asarray(y), jnp.asarray(seeds))
         out = to_host(out)
         fleet_seconds = time.time() - t0
 
-        return self._assemble(
-            out, m, built_kwargs, fleet_seconds, k_folds
-        )
+        return self._assemble(out, m, built_kwargs, fleet_seconds, k_folds)
 
     # -- unpacking into per-machine detector objects ------------------------
     def _assemble(
@@ -408,26 +379,16 @@ class FleetDiffBuilder:
 
 
 # ---------------------------------------------------------------------------
-# The single compiled program (cached across equal-signature buckets)
+# The exact compiled program (cached across equal-signature length-groups)
 # ---------------------------------------------------------------------------
 
-@partial(
-    jax.jit,
-    static_argnames=(
-        "module",
-        "scaler_opts",
-        "det_scaler_opts",
-        "window_mode",
-        "lookback",
-        "offset",
-        "cfg",
-        "steps",
-        "bs",
-        "na_pad",
-        "mesh",
-    ),
-)
-def _fleet_diff_program(
+#: jitted program per (module, scalers, windowing, cfg, folds, mesh) — the
+#: closure must be cached so repeat builds (bench warm runs, CV re-runs) hit
+#: jax's compile cache instead of re-tracing a fresh closure every call.
+_EXACT_PROGRAMS: Dict[Tuple, Any] = {}
+
+
+def _exact_fleet_program(
     module,
     scaler_opts,
     det_scaler_opts,
@@ -435,130 +396,189 @@ def _fleet_diff_program(
     lookback: int,
     offset: int,
     cfg: TrainConfig,
-    steps: int,
-    bs: int,
-    na_pad: int,
+    folds: Tuple[Tuple[Tuple[int, ...], Tuple[int, ...]], ...],
     mesh,
-    X,         # (M, N, F) raw stacked rows (zero-padded)
-    y,         # (M, N, Fout) raw targets
-    rows_all,  # (M, K+1, N) bool: each fold's scaler-fit rows (K = all valid)
-    w_all,     # (M, K+1, NA) aligned train weights; fold K is the final fit
-    w_test,    # (M, K, NA) aligned test-eval masks
-    seeds,     # (M,) uint32
 ):
-    """Scaler stats -> windows -> (K+1)-fold vmapped fits -> out-of-fold
-    scoring -> thresholds, as ONE jitted program over the whole bucket."""
-    m = X.shape[0]
-    k_folds = w_test.shape[1]
+    """Return the jitted exact program ``(X, y, seeds) -> out`` for one
+    length-group.
 
-    # 1. Pipeline scaler chain — stats PER FOLD on that fold's train rows
-    #    (single-machine parity: every CV fold refits its scalers), then
-    #    transform; stats of step i are computed on step i-1's output.
-    X_nan = jnp.where(rows_all[:, :, :, None], X[:, None], jnp.nan)  # (M,K+1,N,F)
-    scaler_stats = []
-    X_scaled = jnp.broadcast_to(X[:, None], X_nan.shape)
-    vv = lambda f: jax.vmap(jax.vmap(f))  # noqa: E731 — (models, folds) map
-    for scaler_cls, opts in scaler_opts:
-        stats = vv(lambda xm: scaler_cls.compute_stats(xm, **dict(opts)))(X_nan)
-        scaler_stats.append(stats)
-        X_scaled = vv(scaler_cls.apply)(stats, X_scaled)
-        X_nan = vv(scaler_cls.apply)(stats, X_nan)
-
-    # 2. Detector scaler stats on raw targets over ALL valid rows (the
-    #    detector scaler is fit once on the full series, not per fold).
-    det_cls, det_opts = det_scaler_opts
-    y_nan = jnp.where(rows_all[:, -1, :, None], y, jnp.nan)
-    det_stats = jax.vmap(lambda ym: det_cls.compute_stats(ym, **dict(det_opts)))(
-        y_nan
+    Single-machine parity by construction: each CV fold (and the final fit)
+    materializes exactly the rows ``train.cv.cross_validate`` would hand the
+    cloned pipeline — gather fold rows, fit the scaler chain on them, window,
+    pad to the fold's OWN ``steps x bs`` geometry, fit with the same derived
+    RNG keys.  No weight-mask approximations; the only difference from M
+    separate single fits is the vmap over machines.
+    """
+    # Fold indices are digested (they can be tens of thousands of ints —
+    # storing them verbatim in every cache key would bloat the cache and
+    # make each lookup re-hash the full tuples).
+    folds_digest = hashlib.md5(repr(folds).encode()).hexdigest()
+    key = (
+        module,
+        scaler_opts,
+        det_scaler_opts,
+        window_mode,
+        lookback,
+        offset,
+        cfg,
+        folds_digest,
+        mesh,
     )
+    cached = _EXACT_PROGRAMS.get(key)
+    if cached is not None:
+        return cached
+    if len(_EXACT_PROGRAMS) >= 128:  # bound growth across many-length fleets
+        _EXACT_PROGRAMS.pop(next(iter(_EXACT_PROGRAMS)))
 
-    # 3. Windowing (estimator semantics) on the scaled input.
+    from gordo_tpu.ops import metrics as jmetrics
     from gordo_tpu.ops.windows import make_windows
+    from gordo_tpu.train.fit import batch_geometry
 
-    if window_mode == "none":
-        inputs, targets = X_scaled, y                      # (M, K+1, NA, ...)
-    elif window_mode == "ae":
-        inputs = vv(lambda a: make_windows(a, lookback))(X_scaled)
-        targets = y[:, lookback - 1:]
-    elif window_mode == "forecast":
-        inputs = vv(lambda a: make_windows(a[:-1], lookback))(X_scaled)
-        targets = y[:, lookback:]
-    else:
+    det_cls, det_opts = det_scaler_opts
+    fold_idx = [
+        (np.asarray(tr, np.int32), np.asarray(te, np.int32)) for tr, te in folds
+    ]
+
+    def scale_chain(X_f):
+        """Fit the pipeline scaler chain on (M, n, F) rows; step i's stats
+        are computed on step i-1's output (pipeline semantics)."""
+        stats_list = []
+        cur = X_f
+        for scaler_cls, opts in scaler_opts:
+            st = jax.vmap(
+                lambda xm: scaler_cls.compute_stats(xm, **dict(opts))
+            )(cur)
+            stats_list.append(st)
+            cur = jax.vmap(scaler_cls.apply)(st, cur)
+        return stats_list, cur
+
+    def apply_chain(stats_list, X_f):
+        cur = X_f
+        for (scaler_cls, _), st in zip(scaler_opts, stats_list):
+            cur = jax.vmap(scaler_cls.apply)(st, cur)
+        return cur
+
+    def windowize(Xt, y_f):
+        """Estimator windowing semantics on already-scaled inputs."""
+        if window_mode == "none":
+            return Xt, y_f
+        if window_mode == "ae":
+            inputs = jax.vmap(lambda a: make_windows(a, lookback))(Xt)
+            return inputs, y_f[:, lookback - 1:]
+        if window_mode == "forecast":
+            inputs = jax.vmap(lambda a: make_windows(a[:-1], lookback))(Xt)
+            return inputs, y_f[:, lookback:]
         raise ValueError(f"Unknown window_mode {window_mode!r}")
 
-    # Pad aligned rows to whole minibatches.
-    if na_pad:
-        inputs = jnp.concatenate(
-            [inputs, jnp.zeros(inputs.shape[:2] + (na_pad,) + inputs.shape[3:], inputs.dtype)],
-            axis=2,
+    def one_fit(params0, inputs, targets, fit_keys):
+        """vmapped fit with THIS fold's true batch geometry (exactly
+        ``train.fit.fit``: pad to steps*bs, weight-mask the padding)."""
+        m = inputs.shape[0]
+        na = inputs.shape[1]
+        steps, bs, n_pad = batch_geometry(na, cfg.batch_size)
+        w = jnp.concatenate(
+            [jnp.ones((na,), jnp.float32), jnp.zeros((n_pad,), jnp.float32)]
         )
-        targets = jnp.concatenate(
-            [targets, jnp.zeros((m, na_pad) + targets.shape[2:], targets.dtype)],
-            axis=1,
-        )
-        w_all = jnp.concatenate(
-            [w_all, jnp.zeros((m, w_all.shape[1], na_pad), w_all.dtype)], axis=2
+        if n_pad:
+            inputs = jnp.concatenate(
+                [inputs, jnp.zeros((m, n_pad) + inputs.shape[2:], inputs.dtype)],
+                axis=1,
+            )
+            targets = jnp.concatenate(
+                [targets, jnp.zeros((m, n_pad) + targets.shape[2:], targets.dtype)],
+                axis=1,
+            )
+        fit_fn = make_fit_fn(module, cfg, steps, bs)
+        return jax.vmap(fit_fn, in_axes=(0, 0, 0, None, 0))(
+            params0, inputs, targets, w, fit_keys
         )
 
-    # 4. (K+1)-fold fits: vmapped over (models, folds); each fold sees its
-    #    own scaled inputs but the shared raw-target series.
-    init_keys, fit_keys = fleet_mod.fleet_keys(seeds)
-    params0 = fleet_mod.fleet_init(module, init_keys, inputs[0, 0, :1])
-    params0 = jax.tree.map(
-        lambda leaf: jnp.broadcast_to(
-            leaf[:, None], (m, k_folds + 1) + leaf.shape[1:]
-        ),
-        params0,
-    )
-    fit_fn = make_fit_fn(module, cfg, steps, bs)
-    vfit = jax.vmap(  # models axis
-        jax.vmap(fit_fn, in_axes=(0, 0, None, 0, None)),  # folds axis
-        in_axes=(0, 0, 0, 0, 0),
-    )
-    params, history = vfit(params0, inputs, targets, w_all, fit_keys)
+    vapply = jax.vmap(lambda p, x: module.apply({"params": p}, x))
 
-    # 5. Out-of-fold scoring on the K CV folds.
-    vapply = jax.vmap(
-        jax.vmap(lambda p, x: module.apply({"params": p}, x)),  # folds
-        in_axes=(0, 0),
-    )
-    cv_params = jax.tree.map(lambda leaf: leaf[:, :k_folds], params)
-    na = w_test.shape[2]
-    preds = vapply(cv_params, inputs[:, :k_folds])[:, :, :na]  # (M, K, NA, Fout)
-    y_al = targets[:, :na]
+    def program(X, y, seeds):
+        # X: (M, N, F) raw rows, y: (M, N, Fout) raw targets, seeds: (M,)
+        init_keys, fit_keys = fleet_mod.fleet_keys(seeds)
 
-    def fold_scores(pred_k, y_m, mask_k, det_stats_m):
-        y_s = det_cls.apply(det_stats_m, y_m)
-        p_s = det_cls.apply(det_stats_m, pred_k)
-        tag_err = jnp.abs(p_s - y_s)
-        total = jnp.linalg.norm(tag_err, axis=-1)
-        feat_max = _smoothed_masked_max(tag_err, mask_k > 0, SMOOTHING_WINDOW)
-        total_max = _smoothed_masked_max(
-            total[:, None], mask_k > 0, SMOOTHING_WINDOW
-        )[0]
-        metrics = {
-            name: MASKED_METRICS[name](y_m, pred_k, mask_k)
-            for name in METRIC_NAMES
+        # Detector scaler: fit ONCE on the full raw target series
+        # (cross_validate fits self.scaler before any fold).
+        det_stats = jax.vmap(
+            lambda ym: det_cls.compute_stats(ym, **dict(det_opts))
+        )(y)
+
+        # Final fit's scaler chain + windows (also provides the init shape).
+        full_stats, Xt_full = scale_chain(X)
+        inputs_full, targets_full = windowize(Xt_full, y)
+        params0 = fleet_mod.fleet_init(module, init_keys, inputs_full[0, :1])
+
+        per_step_stats: List[List[Any]] = [[] for _ in scaler_opts]
+        feat_maxes, total_maxes = [], []
+        metric_vals: Dict[str, List[Any]] = {n: [] for n in METRIC_NAMES}
+
+        for tr, te in fold_idx:
+            # Materialize the fold exactly as the single path would.
+            X_tr, y_tr = jnp.take(X, tr, axis=1), jnp.take(y, tr, axis=1)
+            stats_k, Xt = scale_chain(X_tr)
+            inputs, targets = windowize(Xt, y_tr)
+            params_k, _ = one_fit(params0, inputs, targets, fit_keys)
+
+            # Out-of-fold predictions on the materialized test slice.
+            X_te, y_te = jnp.take(X, te, axis=1), jnp.take(y, te, axis=1)
+            te_inputs, _ = windowize(apply_chain(stats_k, X_te), y_te)
+            pred = vapply(params_k, te_inputs)
+            y_true = y_te[:, offset:]
+
+            for name in METRIC_NAMES:
+                metric_vals[name].append(
+                    jax.vmap(getattr(jmetrics, name))(y_true, pred)
+                )
+            y_s = jax.vmap(det_cls.apply, in_axes=(0, 0))(det_stats, y_true)
+            p_s = jax.vmap(det_cls.apply, in_axes=(0, 0))(det_stats, pred)
+            tag_err = jnp.abs(p_s - y_s)
+            total = jnp.linalg.norm(tag_err, axis=-1)
+            feat_maxes.append(
+                jax.vmap(lambda e: _smoothed_max(e, SMOOTHING_WINDOW))(tag_err)
+            )
+            total_maxes.append(
+                jax.vmap(
+                    lambda t: _smoothed_max(t[:, None], SMOOTHING_WINDOW)[0]
+                )(total)
+            )
+            for j, st in enumerate(stats_k):
+                per_step_stats[j].append(st)
+
+        # Final full-data fit (fold index -1 in the stats layout).
+        final_params, final_history = one_fit(
+            params0, inputs_full, targets_full, fit_keys
+        )
+        for j, st in enumerate(full_stats):
+            per_step_stats[j].append(st)
+
+        out = {
+            # per scaler step: {stat: (M, K+1, ...)}; fold -1 = final fit
+            "scaler_stats": [
+                {
+                    stat: jnp.stack([s[stat] for s in fold_stats], axis=1)
+                    for stat in fold_stats[0]
+                }
+                for fold_stats in per_step_stats
+            ],
+            "det_scaler_stats": det_stats,
+            "final_params": final_params,
+            "final_history": final_history,
+            "feature_thresholds": jnp.mean(
+                jnp.stack(feat_maxes, axis=1), axis=1
+            ),
+            "aggregate_threshold": jnp.mean(
+                jnp.stack(total_maxes, axis=1), axis=1
+            ),
+            "metrics": {
+                name: jnp.stack(v, axis=1) for name, v in metric_vals.items()
+            },
         }
-        return feat_max, total_max, metrics
+        if mesh is not None:
+            out = jax.lax.with_sharding_constraint(out, model_sharding(mesh))
+        return out
 
-    vscores = jax.vmap(  # models
-        jax.vmap(fold_scores, in_axes=(0, None, 0, None)),  # folds
-        in_axes=(0, 0, 0, 0),
-    )
-    feat_max, total_max, metrics = vscores(preds, y_al, w_test, det_stats)
-
-    out = {
-        "scaler_stats": scaler_stats,
-        "det_scaler_stats": det_stats,
-        "final_params": jax.tree.map(lambda leaf: leaf[:, -1], params),
-        "final_history": history[:, -1],
-        "feature_thresholds": jnp.mean(feat_max, axis=1),
-        "aggregate_threshold": jnp.mean(total_max, axis=1),
-        "metrics": metrics,
-    }
-    if mesh is not None:
-        out = jax.lax.with_sharding_constraint(
-            out, model_sharding(mesh)
-        )
-    return out
+    jitted = jax.jit(program)
+    _EXACT_PROGRAMS[key] = jitted
+    return jitted

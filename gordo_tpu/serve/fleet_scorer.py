@@ -262,7 +262,15 @@ class FleetScorer:
                 # per-machine scorer has its own memory guard + host
                 # fallback)
                 for n in wanted:
-                    results[n] = self._machine_scorer(n).anomaly_arrays(arrays[n])
+                    try:
+                        results[n] = self._machine_scorer(n).anomaly_arrays(
+                            arrays[n]
+                        )
+                    except Exception as exc:
+                        # same per-machine isolation as the fallbacks loop:
+                        # one machine's model-internal error must not 500
+                        # the whole bulk request
+                        results[n] = {"error": str(exc)}
                 continue
             # build (M, n_rows, F) in bucket.names order: requested machines
             # get repeat-last row padding; absent slots score a dummy copy

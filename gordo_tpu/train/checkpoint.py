@@ -114,9 +114,20 @@ def save_checkpoint(
     np.save(os.path.join(tmp, "history.npy"), np.asarray(history, np.float32))
     with open(os.path.join(tmp, STATE_FILE), "w") as f:
         json.dump({"epochs_done": int(epochs_done), "fingerprint": fingerprint}, f)
+    # Keep a valid payload on disk at every instant: the previous checkpoint
+    # is moved aside (one atomic rename), the new one renamed in, and only
+    # then is the old one deleted.  A crash anywhere in between leaves either
+    # `ckpt` or `ckpt.old` restorable (load_checkpoint falls back to .old).
+    # A stale `.old` (from a crash that left ONLY it behind) must survive
+    # until the new payload is in place — never delete it up front.
+    old = final + ".old"
     if os.path.exists(final):
-        shutil.rmtree(final)
+        if os.path.exists(old):
+            shutil.rmtree(old)
+        os.replace(final, old)
     os.replace(tmp, final)
+    if os.path.exists(old):
+        shutil.rmtree(old)
 
 
 def load_checkpoint(
@@ -129,6 +140,9 @@ def load_checkpoint(
     None — stale checkpoints are never silently reused.
     """
     payload = os.path.join(ckpt_dir, PAYLOAD_DIR)
+    if not os.path.exists(os.path.join(payload, STATE_FILE)):
+        # a crash mid-save may have left only the moved-aside previous payload
+        payload = os.path.join(ckpt_dir, PAYLOAD_DIR + ".old")
     state_path = os.path.join(payload, STATE_FILE)
     if not os.path.exists(state_path):
         return None
@@ -186,6 +200,15 @@ def fit_checkpointed(
         target={"params": params, "opt_state": opt_state},
         fingerprint=fingerprint,
     )
+    if resumed is not None and resumed[3] > cfg.epochs:
+        # the fingerprint deliberately excludes epochs, so a re-run with a
+        # SMALLER epoch budget can match an over-trained checkpoint; using
+        # it would break the "same params as an uninterrupted fit" contract
+        logger.warning(
+            "Checkpoint in %s has %d epochs done > budget %d; "
+            "retraining from scratch", ckpt_dir, resumed[3], cfg.epochs,
+        )
+        resumed = None
     if resumed is not None:
         params, opt_state, hist_arr, epochs_done = resumed
         history = list(np.asarray(hist_arr))
@@ -205,4 +228,7 @@ def fit_checkpointed(
             ckpt_dir, params, opt_state,
             np.asarray(history, np.float32), epochs_done, fingerprint,
         )
+    assert len(history) == cfg.epochs, (
+        f"history has {len(history)} entries for a {cfg.epochs}-epoch fit"
+    )
     return params, np.asarray(history, dtype=np.float32)

@@ -144,3 +144,44 @@ def test_estimator_checkpoint_dir_kwarg(sine_tags, tmp_path):
     assert load_checkpoint(str(tmp_path / "est-ck")) is not None
     plain = AutoEncoder(epochs=3, batch_size=128).fit(sine_tags)
     _leaves_equal(est.params_, plain.params_)
+
+
+def test_overtrained_checkpoint_discarded(module, sine_tags, tmp_path):
+    """A checkpoint with more epochs done than the current budget must be
+    discarded (the fingerprint excludes epochs, so it would otherwise match)
+    and the fit retrained to exactly cfg.epochs."""
+    import jax
+
+    ckpt = str(tmp_path / "over")
+    fit_checkpointed(
+        module, sine_tags, sine_tags, CFG, ckpt, 2, rng=jax.random.PRNGKey(7)
+    )  # 6 epochs done
+
+    import dataclasses
+
+    smaller = dataclasses.replace(CFG, epochs=4)
+    params, hist = fit_checkpointed(
+        module, sine_tags, sine_tags, smaller, ckpt, 2,
+        rng=jax.random.PRNGKey(7),
+    )
+    assert len(hist) == smaller.epochs
+    fresh, _ = fit(module, sine_tags, sine_tags, smaller,
+                   rng=jax.random.PRNGKey(7))
+    _leaves_equal(params, fresh)
+
+
+def test_crash_between_renames_falls_back_to_old(module, sine_tags, tmp_path):
+    """Simulate a crash after the previous payload was moved aside but
+    before the new one landed: load_checkpoint must restore the .old
+    payload instead of silently retraining from scratch."""
+    import os
+
+    ckpt = str(tmp_path / "crash")
+    cfg = TrainConfig(epochs=2, batch_size=128)
+    fit_checkpointed(module, sine_tags, sine_tags, cfg, ckpt, 1)
+
+    final = os.path.join(ckpt, "ckpt")
+    os.replace(final, final + ".old")  # the mid-save crash window
+    restored = load_checkpoint(ckpt)
+    assert restored is not None
+    assert restored[3] == 2  # epochs_done from the moved-aside payload

@@ -199,3 +199,22 @@ def test_predict_bulk_matches_per_machine(model_dir):
             b.predictions[("total-anomaly-score", "")].to_numpy(),
             rtol=1e-4, atol=1e-5,
         )
+
+
+def test_frame_from_payload_thresholds_when_rows_equal_tags():
+    """Known keys dispatch by name: with n_rows == n_tags, a per-tag
+    threshold vector must still become per-tag constant columns and a
+    per-row series must stay a single ('key','') column."""
+    data = {
+        "model-output": np.ones((2, 2)).tolist(),
+        "total-anomaly-score": [1.0, 2.0],
+        "anomaly-confidence": [0.1, 0.2],
+        "tag-anomaly-thresholds": [0.5, 0.7],
+        "total-anomaly-threshold": 0.9,
+    }
+    idx = pd.date_range("2020-01-01", periods=2, freq="10min")
+    frame = _frame_from_payload(data, ["a", "b"], idx)
+    assert frame[("tag-anomaly-thresholds", "a")].tolist() == [0.5, 0.5]
+    assert frame[("tag-anomaly-thresholds", "b")].tolist() == [0.7, 0.7]
+    assert frame[("total-anomaly-score", "")].tolist() == [1.0, 2.0]
+    assert frame[("anomaly-confidence", "")].tolist() == [0.1, 0.2]

@@ -156,20 +156,26 @@ class TestFleetDiffBuilder:
             single.fit(Xi)
 
             fleet_det = detectors[i]
-            # CV-fold statistics: statistically equivalent, not bit-identical
-            # (mask-based folds change minibatch composition — see
-            # parallel/anomaly.py module docstring).
+            # CV-fold statistics are EXACT: the fleet program materializes
+            # each fold with the single path's own geometry and RNG (see
+            # parallel/anomaly.py module docstring) — only float scheduling
+            # noise remains.
             np.testing.assert_allclose(
                 fleet_det.feature_thresholds_,
                 single.feature_thresholds_,
-                rtol=0.35,
+                rtol=1e-4,
+                atol=1e-6,
             )
             assert fleet_det.aggregate_threshold_ == pytest.approx(
-                single.aggregate_threshold_, rel=0.35
+                single.aggregate_threshold_, rel=1e-4
             )
             for name, stats in single.cv_metadata_["scores"].items():
-                assert fleet_det.cv_metadata_["scores"][name]["mean"] == pytest.approx(
-                    stats["mean"], rel=0.35, abs=0.05
+                fleet_scores = fleet_det.cv_metadata_["scores"][name]
+                np.testing.assert_allclose(
+                    fleet_scores["folds"], stats["folds"], rtol=1e-3, atol=1e-5
+                )
+                assert fleet_scores["mean"] == pytest.approx(
+                    stats["mean"], rel=1e-3, abs=1e-5
                 )
             # The FINAL model is bit-identical: anomaly frames must agree.
             fa = fleet_det.anomaly(Xi)
@@ -229,7 +235,11 @@ class TestFleetDiffBuilder:
         np.testing.assert_allclose(
             detectors[0].feature_thresholds_,
             single.feature_thresholds_,
-            rtol=0.35,
+            rtol=1e-3,
+            atol=1e-5,
+        )
+        assert detectors[0].aggregate_threshold_ == pytest.approx(
+            single.aggregate_threshold_, rel=1e-3
         )
         # final model bit-identical (windowed path included)
         fa = detectors[0].anomaly(X)
@@ -239,4 +249,33 @@ class TestFleetDiffBuilder:
             sa[("total-anomaly-score", "")].to_numpy(),
             rtol=1e-3,
             atol=1e-4,
+        )
+
+
+def test_fleet_build_ragged_lengths_exact(sine_tags):
+    """Machines of DIFFERENT lengths in one bucket: each length-group runs
+    its own exact program, so every machine (not just the longest) matches
+    its single-machine build."""
+    Xs = [sine_tags[:400], sine_tags[:280], sine_tags[:400] * 1.1]
+    spec = analyze_definition(from_definition(DETECTOR_DEF))
+    detectors = FleetDiffBuilder(spec).build(Xs)
+
+    for Xi, fleet_det in zip(Xs, detectors):
+        single = from_definition(DETECTOR_DEF)
+        single.cross_validate(Xi)
+        single.fit(Xi)
+        np.testing.assert_allclose(
+            fleet_det.feature_thresholds_,
+            single.feature_thresholds_,
+            rtol=1e-4,
+            atol=1e-6,
+        )
+        assert fleet_det.aggregate_threshold_ == pytest.approx(
+            single.aggregate_threshold_, rel=1e-4
+        )
+        np.testing.assert_allclose(
+            fleet_det.anomaly(Xi)[("total-anomaly-score", "")].to_numpy(),
+            single.anomaly(Xi)[("total-anomaly-score", "")].to_numpy(),
+            rtol=1e-4,
+            atol=1e-5,
         )

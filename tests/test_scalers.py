@@ -107,3 +107,29 @@ def test_not_invertible_names_class():
     imp = scalers.SimpleImputer().fit(np.ones((3, 2), dtype=np.float32))
     out = imp.inverse_transform(np.ones((3, 2), dtype=np.float32))
     assert out.shape == (3, 2)  # imputer inverse is identity, not an error
+
+
+def test_ignored_sklearn_kwargs_warn():
+    """Unsupported sklearn-compat kwargs must warn, never silently change
+    behaviour (VERDICT weak #6)."""
+    import warnings
+
+    from gordo_tpu.ops.scalers import (
+        PCA,
+        MinMaxScaler,
+        QuantileTransformer,
+        SimpleImputer,
+    )
+
+    for cls, kw in [
+        (QuantileTransformer, {"subsample": 1000}),
+        (PCA, {"whiten": True}),
+        (SimpleImputer, {"add_indicator": True}),
+        (MinMaxScaler, {"clip": True}),
+    ]:
+        with pytest.warns(UserWarning, match="ignoring unsupported"):
+            cls(**kw)
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        MinMaxScaler()  # no extra kwargs -> no warning
