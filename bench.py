@@ -195,18 +195,18 @@ def init_devices(attempts: int = 5, backoff_s: float = 2.0):
             return devices
         except Exception as exc:  # backend init failed — clear cache, retry
             last_exc = exc
-            delay = backoff_s * (2**attempt)
             log(
-                f"backend init attempt {attempt + 1}/{attempts} failed: "
-                f"{exc!r}; retrying in {delay:.0f}s"
+                f"backend init attempt {attempt + 1}/{attempts} failed: {exc!r}"
             )
+            if attempt == attempts - 1:
+                break  # no retry follows; don't burn the deadline sleeping
             try:
                 import jax.extend.backend
 
                 jax.extend.backend.clear_backends()
             except Exception as clear_exc:
                 log(f"clear_backends failed: {clear_exc!r}")
-            time.sleep(delay)
+            time.sleep(backoff_s * (2**attempt))
     raise RuntimeError(
         f"jax backend init failed after {attempts} attempts: {last_exc!r}"
     )

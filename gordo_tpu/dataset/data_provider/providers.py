@@ -7,13 +7,12 @@ Reference equivalents (``gordo_components/dataset/data_provider/``):
 - ``InfluxDataProvider`` — reads tag series from InfluxDB measurements.
   Import-gated: constructing it without the ``influxdb`` client installed
   raises with instructions, mirroring how the reference fails.
-- ``DataLakeProvider`` + NCS/IROC readers — Azure Data Lake gen1 access.
-  The cloud SDK is not available in this environment, so the provider is
-  import-gated the same way; the on-disk per-tag file layout it dispatches
-  to is covered by :class:`FileSystemTagProvider`, which reads the same
-  per-asset/per-tag file conventions from any mounted filesystem (the
-  TPU-era replacement: tag archives live on mounted/NFS storage close to
-  the pod, not behind a Python SDK).
+- ``DataLakeProvider`` + NCS/IROC readers — data-lake access with the
+  walk/dispatch/yearly-file logic implemented against the injectable
+  ``lake.TagFileSystem`` interface: ``lake.ADLSGen1FileSystem`` (gated on
+  the Azure SDK) in production, ``lake.LocalFileSystem`` for mounted/NFS
+  tag archives and tests.  :class:`FileSystemTagProvider` remains the
+  simpler flat-layout alternative.
 """
 
 from __future__ import annotations
@@ -179,8 +178,12 @@ class IrocBundleProvider(GordoBaseDataProvider):
         return bool(self._bundle_files())
 
     @staticmethod
-    def _read_bundle(path: str) -> pd.DataFrame:
+    def _read_bundle(path) -> pd.DataFrame:
+        """``path`` may be a filesystem path or a seekable file-like (the
+        lake reader hands in downloaded bytes)."""
         head = pd.read_csv(path, nrows=0)
+        if hasattr(path, "seek"):
+            path.seek(0)
         cols = [c.strip().lower() for c in head.columns]
         if "tag" in cols and "value" in cols:
             df = pd.read_csv(path)
@@ -304,38 +307,152 @@ class InfluxDataProvider(GordoBaseDataProvider):
 
 
 class DataLakeProvider(GordoBaseDataProvider):
-    """Azure Data Lake gen1 provider (reference: ``DataLakeProvider`` +
-    ``azure_utils``/``ncs_reader``/``iroc_reader``).
+    """Data-lake provider dispatching per-tag reads to sub-readers
+    (reference: ``DataLakeProvider`` + ``azure_utils``/``ncs_reader``/
+    ``iroc_reader``).
 
-    The Azure SDK and the lake itself are unreachable from a TPU pod in this
-    environment; the class import-gates on the SDK and documents
-    :class:`FileSystemTagProvider` as the mounted-storage equivalent for the
-    same per-asset tag-file layouts.
+    The filesystem is injectable (``lake.TagFileSystem``): production wires
+    ``lake.ADLSGen1FileSystem`` (import-gated on the Azure SDK, same auth
+    modes as the reference), tests and mounted archives use
+    ``lake.LocalFileSystem`` — exactly the reference's own test strategy of
+    mocking the adls filesystem object (SURVEY.md §5).
+
+    Dispatch: each tag goes to the first sub-reader whose
+    ``can_handle_tag`` accepts it — :class:`lake.NcsReader` (per-asset
+    per-tag yearly files, year-window pruned) then
+    :class:`lake.IrocLakeReader` (bundle CSVs).  Reads fan out over a
+    thread pool; store round-trips, not CPU, dominate lake access.
     """
 
     @capture_args
-    def __init__(self, interactive: bool = False,
-                 storename: str = "dataplatformdlsprod",
-                 dl_service_auth_str: Optional[str] = None, **kwargs):
-        try:
-            import azure.datalake.store  # noqa: F401
-        except ImportError as exc:
-            raise ImportError(
-                "DataLakeProvider requires the 'azure-datalake-store' SDK, "
-                "which is not installed in this environment. For on-disk tag "
-                "archives use gordo_tpu.dataset.data_provider.providers."
-                "FileSystemTagProvider instead."
-            ) from exc
+    def __init__(
+        self,
+        filesystem=None,
+        base_dir: str = "/raw/plant",
+        iroc_base_dir: Optional[str] = None,
+        interactive: bool = False,
+        storename: str = "dataplatformdlsprod",
+        dl_service_auth_str: Optional[str] = None,
+        max_workers: int = 8,
+        **kwargs,
+    ):
+        self.base_dir = base_dir
+        self.iroc_base_dir = iroc_base_dir or base_dir
         self.interactive = interactive
         self.storename = storename
         self.dl_service_auth_str = dl_service_auth_str
+        self.max_workers = max_workers
         self.kwargs = kwargs
+        # config-driven (YAML) use passes a string spec: "local:<root>"
+        # mounts an on-disk archive; a TagFileSystem instance is injected
+        # directly by tests/library callers.  The spec is kept so a pickled
+        # provider re-wires the SAME filesystem, never silently retargeting
+        # the ADLS default.
+        self._fs_spec: Optional[str] = None
+        self._had_injected_fs = False
+        if isinstance(filesystem, str):
+            self._fs_spec = filesystem
+            filesystem = self._fs_from_spec(filesystem)
+        elif filesystem is not None:
+            self._had_injected_fs = True
+        self._fs = filesystem
+        self._readers = None
 
-    def can_handle_tag(self, tag) -> bool:  # pragma: no cover - gated
-        tag = normalize_sensor_tags([tag])[0]
-        return tag.asset is not None
+    @staticmethod
+    def _fs_from_spec(spec: str):
+        if spec.startswith("local:"):
+            from gordo_tpu.dataset.data_provider.lake import LocalFileSystem
 
-    def load_series(self, from_ts, to_ts, tag_list, dry_run=False):  # pragma: no cover
-        raise NotImplementedError(
-            "Azure Data Lake access is unavailable in this environment"
+            return LocalFileSystem(spec[len("local:"):] or "/")
+        raise ValueError(
+            f"Unknown filesystem spec {spec!r}; expected 'local:<root>' "
+            "or a TagFileSystem instance"
         )
+
+    # -- lazily wired filesystem + sub-readers ------------------------------
+    @property
+    def filesystem(self):
+        if self._fs is None:
+            if self._fs_spec is not None:
+                self._fs = self._fs_from_spec(self._fs_spec)
+            elif self._had_injected_fs:
+                raise RuntimeError(
+                    "This DataLakeProvider was built around an injected "
+                    "filesystem object that did not survive pickling; "
+                    "re-inject one (or construct with a 'local:<root>' spec, "
+                    "which round-trips)"
+                )
+            else:
+                from gordo_tpu.dataset.data_provider.lake import (
+                    ADLSGen1FileSystem,
+                )
+
+                # import-gated: raises with the LocalFileSystem alternative
+                # when the Azure SDK is absent (not part of the TPU image)
+                self._fs = ADLSGen1FileSystem(
+                    store_name=self.storename,
+                    interactive=self.interactive,
+                    dl_service_auth_str=self.dl_service_auth_str,
+                )
+        return self._fs
+
+    @property
+    def readers(self):
+        if self._readers is None:
+            from gordo_tpu.dataset.data_provider.lake import (
+                IrocLakeReader,
+                NcsReader,
+            )
+
+            self._readers = [
+                NcsReader(self.filesystem, self.base_dir),
+                IrocLakeReader(self.filesystem, self.iroc_base_dir),
+            ]
+        return self._readers
+
+    def _reader_for(self, tag: SensorTag):
+        for reader in self.readers:
+            if reader.can_handle_tag(tag):
+                return reader
+        raise ValueError(
+            f"No lake reader can handle tag {tag.name!r} "
+            f"(asset {tag.asset!r}) under {self.base_dir!r}"
+        )
+
+    def can_handle_tag(self, tag) -> bool:
+        tag = normalize_sensor_tags([tag])[0]
+        if tag.asset is None:
+            return False
+        return any(reader.can_handle_tag(tag) for reader in self.readers)
+
+    def load_series(
+        self,
+        from_ts: pd.Timestamp,
+        to_ts: pd.Timestamp,
+        tag_list: List,
+        dry_run: bool = False,
+    ) -> Iterable[pd.Series]:
+        from gordo_tpu.dataset.data_provider.lake import read_tags_concurrently
+
+        tags = normalize_sensor_tags(list(tag_list))
+        missing = [t.name for t in tags if t.asset is None]
+        if missing:
+            raise ValueError(
+                f"DataLakeProvider needs an asset for every tag; missing for "
+                f"{missing}"
+            )
+        if dry_run:
+            for tag in tags:  # existence probe only, no reads
+                self._reader_for(tag)
+            return
+        yield from read_tags_concurrently(
+            self._reader_for, tags, from_ts, to_ts, self.max_workers
+        )
+
+    def __getstate__(self):
+        # the filesystem handle (SDK session / open fds) never rides in
+        # metadata round-trips; it re-wires lazily on the other side
+        state = dict(self.__dict__)
+        state["_fs"] = None
+        state["_readers"] = None
+        return state

@@ -424,8 +424,9 @@ def _exact_fleet_program(
         folds_digest,
         mesh,
     )
-    cached = _EXACT_PROGRAMS.get(key)
+    cached = _EXACT_PROGRAMS.pop(key, None)
     if cached is not None:
+        _EXACT_PROGRAMS[key] = cached  # LRU touch: re-insert as newest
         return cached
     if len(_EXACT_PROGRAMS) >= 128:  # bound growth across many-length fleets
         _EXACT_PROGRAMS.pop(next(iter(_EXACT_PROGRAMS)))

@@ -1,0 +1,286 @@
+"""DataLakeProvider / NCS / IROC lake-reader tests over checked-in fixtures
+(tests/data/lake) — the reference's own strategy of mocking the adls
+filesystem object (SURVEY.md §5 data-provider bullet); here the mock is a
+LocalFileSystem plus a call-recording wrapper to assert pruning behavior."""
+
+import os
+
+import pandas as pd
+import pytest
+
+from gordo_tpu.dataset.data_provider.lake import (
+    IrocLakeReader,
+    LocalFileSystem,
+    NcsReader,
+)
+from gordo_tpu.dataset.data_provider.providers import DataLakeProvider
+from gordo_tpu.dataset.sensor_tag import SensorTag
+
+LAKE = os.path.join(os.path.dirname(__file__), "data", "lake")
+
+
+class RecordingFS(LocalFileSystem):
+    """LocalFileSystem that records every open() — the SDK mock."""
+
+    def __init__(self, root):
+        super().__init__(root)
+        self.opened = []
+
+    def open(self, path, mode="rb"):
+        self.opened.append(path)
+        return super().open(path, mode)
+
+
+@pytest.fixture()
+def fs():
+    return RecordingFS(LAKE)
+
+
+TAG1 = SensorTag("TAG-1", "asset-a")
+TAG2 = SensorTag("TAG-2", "asset-a")
+IROC1 = SensorTag("IROC-T1", "iroc-x")
+
+
+class TestNcsReader:
+    def test_reads_and_windows(self, fs):
+        reader = NcsReader(fs, "")
+        s = reader.read_tag(
+            TAG1,
+            pd.Timestamp("2017-02-01", tz="UTC"),
+            pd.Timestamp("2017-03-01", tz="UTC"),
+        )
+        assert len(s) > 0
+        assert s.index.min() >= pd.Timestamp("2017-02-01", tz="UTC")
+        assert s.index.max() < pd.Timestamp("2017-03-01", tz="UTC")
+        assert s.name == "TAG-1"
+
+    def test_year_pruning_skips_out_of_window_files(self, fs):
+        reader = NcsReader(fs, "")
+        files = reader.files_in_window(
+            TAG1,
+            pd.Timestamp("2017-06-01", tz="UTC"),
+            pd.Timestamp("2018-02-01", tz="UTC"),
+        )
+        years = sorted(os.path.basename(f) for f in files)
+        assert years == ["TAG-1_2017.csv", "TAG-1_2018.csv"]
+        # and reads only open the pruned set
+        reader.read_tag(
+            TAG1,
+            pd.Timestamp("2017-06-01", tz="UTC"),
+            pd.Timestamp("2018-02-01", tz="UTC"),
+        )
+        assert all("2016" not in p for p in fs.opened)
+
+    def test_parquet_and_headerless_csv(self, fs):
+        reader = NcsReader(fs, "")
+        s = reader.read_tag(
+            TAG2,
+            pd.Timestamp("2017-01-01", tz="UTC"),
+            pd.Timestamp("2018-07-01", tz="UTC"),
+        )
+        # spans the parquet 2017 part and the headerless csv 2018 part
+        assert s.index.min().year == 2017
+        assert s.index.max().year == 2018
+        assert s.dtype == float
+
+    def test_window_with_no_files_yields_empty_series(self, fs):
+        reader = NcsReader(fs, "")
+        s = reader.read_tag(
+            TAG1,
+            pd.Timestamp("2030-01-01", tz="UTC"),
+            pd.Timestamp("2030-02-01", tz="UTC"),
+        )
+        assert len(s) == 0  # data gap, not a missing tag
+
+    def test_missing_tag_raises(self, fs):
+        reader = NcsReader(fs, "")
+        with pytest.raises(FileNotFoundError, match="NOPE"):
+            reader.read_tag(
+                SensorTag("NOPE", "asset-a"),
+                pd.Timestamp("2017-01-01", tz="UTC"),
+                pd.Timestamp("2017-02-01", tz="UTC"),
+            )
+
+    def test_can_handle_tag(self, fs):
+        reader = NcsReader(fs, "")
+        assert reader.can_handle_tag(TAG1)
+        assert not reader.can_handle_tag(SensorTag("TAG-1", None))
+        assert not reader.can_handle_tag(SensorTag("NOPE", "asset-a"))
+
+
+class TestIrocLakeReader:
+    def test_reads_bundle_tag(self, fs):
+        reader = IrocLakeReader(fs, "")
+        s = reader.read_tag(
+            IROC1,
+            pd.Timestamp("2017-03-01", tz="UTC"),
+            pd.Timestamp("2017-03-10", tz="UTC"),
+        )
+        assert len(s) > 0
+        assert s.name == "IROC-T1"
+        assert s.index.max() < pd.Timestamp("2017-03-10", tz="UTC")
+
+    def test_unknown_tag_raises(self, fs):
+        reader = IrocLakeReader(fs, "")
+        with pytest.raises(KeyError):
+            reader.read_tag(
+                SensorTag("IROC-NOPE", "iroc-x"),
+                pd.Timestamp("2017-03-01", tz="UTC"),
+                pd.Timestamp("2017-03-10", tz="UTC"),
+            )
+
+
+class TestDataLakeProvider:
+    def test_dispatches_ncs_and_iroc(self, fs):
+        provider = DataLakeProvider(filesystem=fs, base_dir="")
+        series = list(
+            provider.load_series(
+                pd.Timestamp("2017-03-01", tz="UTC"),
+                pd.Timestamp("2017-03-20", tz="UTC"),
+                [TAG1, IROC1],
+            )
+        )
+        assert [s.name for s in series] == ["TAG-1", "IROC-T1"]
+        assert all(len(s) > 0 for s in series)
+
+    def test_can_handle_and_assetless_rejection(self, fs):
+        provider = DataLakeProvider(filesystem=fs, base_dir="")
+        assert provider.can_handle_tag(TAG1)
+        assert provider.can_handle_tag(IROC1)
+        assert not provider.can_handle_tag(SensorTag("TAG-1", None))
+        with pytest.raises(ValueError, match="asset"):
+            list(
+                provider.load_series(
+                    pd.Timestamp("2017-03-01", tz="UTC"),
+                    pd.Timestamp("2017-03-20", tz="UTC"),
+                    [SensorTag("TAG-1", None)],
+                )
+            )
+
+    def test_dry_run_probes_without_reading(self, fs):
+        provider = DataLakeProvider(filesystem=fs, base_dir="")
+        list(
+            provider.load_series(
+                pd.Timestamp("2017-03-01", tz="UTC"),
+                pd.Timestamp("2017-03-20", tz="UTC"),
+                [TAG1],
+                dry_run=True,
+            ) or []
+        )
+        assert fs.opened == []  # existence checks only
+
+    def test_unhandled_tag_errors_with_context(self, fs):
+        provider = DataLakeProvider(filesystem=fs, base_dir="")
+        with pytest.raises(ValueError, match="No lake reader"):
+            list(
+                provider.load_series(
+                    pd.Timestamp("2017-03-01", tz="UTC"),
+                    pd.Timestamp("2017-03-20", tz="UTC"),
+                    [SensorTag("GHOST", "no-such-asset")],
+                )
+            )
+
+    def test_roundtrips_through_params(self, fs):
+        provider = DataLakeProvider(filesystem=fs, base_dir="", max_workers=2)
+        params = provider.get_params()
+        assert params["base_dir"] == ""
+        import pickle
+
+        clone = pickle.loads(pickle.dumps(provider))
+        assert clone._fs is None  # handles never ride in pickles
+
+    def test_adls_filesystem_import_gated(self):
+        provider = DataLakeProvider(base_dir="")
+        with pytest.raises(ImportError, match="azure-datalake-store"):
+            provider.filesystem
+
+    def test_dataset_integration(self, fs):
+        """The dataset layer consumes the lake provider end-to-end."""
+        from gordo_tpu.dataset.datasets import TimeSeriesDataset
+
+        ds = TimeSeriesDataset(
+            train_start_date="2017-02-01T00:00:00Z",
+            train_end_date="2017-04-01T00:00:00Z",
+            tag_list=[TAG1, TAG2],
+            data_provider=DataLakeProvider(filesystem=fs, base_dir=""),
+            resolution="1D",
+        )
+        X, y = ds.get_data()
+        assert X.shape[0] > 0 and X.shape[1] == 2
+
+
+def test_filesystem_string_spec_config_driven():
+    """YAML configs wire mounted archives via 'local:<root>' (a
+    TagFileSystem instance can't ride in a config dict)."""
+    provider = DataLakeProvider(filesystem=f"local:{LAKE}", base_dir="")
+    series = list(
+        provider.load_series(
+            pd.Timestamp("2017-03-01", tz="UTC"),
+            pd.Timestamp("2017-03-20", tz="UTC"),
+            [TAG1],
+        )
+    )
+    assert len(series[0]) > 0
+    # round-trips through the self-describing config
+    clone = DataLakeProvider.from_dict(provider.to_dict())
+    assert isinstance(clone, DataLakeProvider)
+    with pytest.raises(ValueError, match="filesystem spec"):
+        DataLakeProvider(filesystem="s3://nope")
+
+
+def test_flat_layout_does_not_blend_prefix_tags(fs):
+    """PUMP_A must not swallow PUMP_A_SPEED_2017.csv (underscore-extended
+    tag names are common); matching is exact-name + strict suffix."""
+    reader = NcsReader(fs, "")
+    a = reader.read_tag(
+        SensorTag("PUMP_A", "asset-flat"),
+        pd.Timestamp("2017-01-01", tz="UTC"),
+        pd.Timestamp("2017-04-01", tz="UTC"),
+    )
+    speed = reader.read_tag(
+        SensorTag("PUMP_A_SPEED", "asset-flat"),
+        pd.Timestamp("2017-01-01", tz="UTC"),
+        pd.Timestamp("2017-04-01", tz="UTC"),
+    )
+    # the two tags were generated around means 1.0 and 100.0: any blending
+    # would drag PUMP_A's mean far from 1
+    assert abs(a.mean() - 1.0) < 2.0
+    assert abs(speed.mean() - 100.0) < 2.0
+    assert len(a) == len(speed)
+
+
+def test_local_spec_provider_survives_pickle():
+    import pickle
+
+    provider = DataLakeProvider(filesystem=f"local:{LAKE}", base_dir="")
+    clone = pickle.loads(pickle.dumps(provider))
+    series = list(
+        clone.load_series(
+            pd.Timestamp("2017-03-01", tz="UTC"),
+            pd.Timestamp("2017-03-20", tz="UTC"),
+            [TAG1],
+        )
+    )
+    assert len(series[0]) > 0  # re-wired to the SAME local archive
+
+
+def test_injected_fs_pickle_raises_not_retargets(fs):
+    import pickle
+
+    provider = DataLakeProvider(filesystem=fs, base_dir="")
+    clone = pickle.loads(pickle.dumps(provider))
+    clone._fs = None  # simulate a filesystem that could not ride the pickle
+    clone._had_injected_fs = True
+    with pytest.raises(RuntimeError, match="did not survive pickling"):
+        clone.filesystem
+
+
+def test_iroc_bundles_fetched_once_per_asset(fs):
+    reader = IrocLakeReader(fs, "")
+    for tag in ("IROC-T1", "IROC-T2", "IROC-T1"):
+        reader.read_tag(
+            SensorTag(tag, "iroc-x"),
+            pd.Timestamp("2017-03-01", tz="UTC"),
+            pd.Timestamp("2017-03-10", tz="UTC"),
+        )
+    assert len(fs.opened) == 1  # one bundle file, downloaded exactly once
