@@ -171,11 +171,17 @@ def build_project_cmd(machine_config, project_name, output_dir,
 @click.option("--host", default="0.0.0.0", show_default=True)
 @click.option("--port", default=5555, show_default=True)
 @click.option("--project", envvar="PROJECT_NAME", default="project")
-def run_server_cmd(model_dir, host, port, project):
+@click.option("--rescan-interval", default=30.0, show_default=True,
+              help="Seconds between artifact-dir rescans picking up newly "
+                   "built machines (0 disables).")
+def run_server_cmd(model_dir, host, port, project, rescan_interval):
     """Serve model(s) over the /gordo/v0/<project>/<machine>/ routes."""
     from gordo_tpu.serve.server import run_server
 
-    run_server(model_dir, host=host, port=port, project=project)
+    run_server(
+        model_dir, host=host, port=port, project=project,
+        rescan_interval=rescan_interval,
+    )
 
 
 @gordo.command("run-watchman")
@@ -190,8 +196,14 @@ def run_server_cmd(model_dir, host, port, project):
 @click.option("--host", default="0.0.0.0", show_default=True)
 @click.option("--port", default=5556, show_default=True)
 @click.option("--poll-interval", default=30.0, show_default=True)
+@click.option("--discover/--no-discover", default=True, show_default=True,
+              help="Also discover machines from each target's project "
+                   "index (new machines appear without reconfig).")
+@click.option("--kube-namespace", default=None,
+              help="Discover ml-server Services in this k8s namespace "
+                   "(requires the kubernetes client package).")
 def run_watchman_cmd(project, machines, machine_config, targets, host, port,
-                     poll_interval):
+                     poll_interval, discover, kube_namespace):
     """Run the fleet-status aggregation service."""
     from gordo_tpu.watchman.server import run_watchman
     from gordo_tpu.workflow.config import NormalizedConfig, load_machine_config
@@ -201,11 +213,21 @@ def run_watchman_cmd(project, machines, machine_config, targets, host, port,
     elif machine_config:
         config = NormalizedConfig(load_machine_config(machine_config), project)
         machine_names = [m.name for m in config.machines]
+    elif discover:
+        machine_names = []  # discovered from the targets' project indexes
     else:
-        raise click.ClickException("Provide --machines or --machine-config")
+        raise click.ClickException(
+            "Provide --machines or --machine-config (or enable --discover)"
+        )
+    target_discovery = None
+    if kube_namespace:
+        from gordo_tpu.watchman.kube import KubeTargetDiscovery
+
+        target_discovery = KubeTargetDiscovery(kube_namespace, project=project)
     run_watchman(
         project, machine_names, list(targets),
         host=host, port=port, poll_interval=poll_interval,
+        discover=discover, target_discovery=target_discovery,
     )
 
 

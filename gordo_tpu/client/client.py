@@ -63,7 +63,16 @@ def _frame_from_payload(
             break
     if n is None:
         raise ValueError(f"Response has no recognised outputs: {sorted(data)}")
-    idx = index[-n:] if len(index) >= n else pd.RangeIndex(n)
+    # Server-returned time info wins over the locally-reattached index
+    # (reference parity: responses carry per-row start/end when the request
+    # rode with timestamps).
+    data = dict(data)
+    start = data.pop("start", None)
+    end = data.pop("end", None)
+    if start is not None and len(start) == n:
+        idx = pd.DatetimeIndex(pd.to_datetime(start, utc=True), name="start")
+    else:
+        idx = index[-n:] if len(index) >= n else pd.RangeIndex(n)
 
     # Known response keys dispatch on NAME, never shape: a 1-D per-tag
     # constant is indistinguishable from a per-row series whenever a chunk's
@@ -100,6 +109,8 @@ def _frame_from_payload(
             for j, tag in enumerate(tag_names(arr.shape[0])):
                 columns[(key, tag)] = np.full(n, arr[j])
     frame = pd.DataFrame(columns, index=idx)
+    if end is not None and len(end) == n:
+        frame[("end", "")] = pd.to_datetime(end, utc=True)
     frame.columns = pd.MultiIndex.from_tuples(frame.columns)
     return frame
 
@@ -295,19 +306,27 @@ class Client:
 
         async def score_round(idx: int):
             payload_X = {}
+            payload_index: Dict[str, List[str]] = {}
             chunk_index: Dict[str, pd.Index] = {}
             for name, X in data.items():
                 if idx < n_chunks[name]:
                     chunk = X.iloc[idx * self.batch_size : (idx + 1) * self.batch_size]
                     payload_X[name] = chunk.to_numpy(np.float32).tolist()
                     chunk_index[name] = chunk.index
+                    if isinstance(chunk.index, pd.DatetimeIndex):
+                        payload_index[name] = [
+                            t.isoformat() for t in chunk.index
+                        ]
             if not payload_X:
                 return
             url = f"{self.base_url}{API_PREFIX}/{self.project}/_bulk/anomaly/prediction"
+            payload: Dict[str, Any] = {"X": payload_X}
+            if payload_index:
+                payload["index"] = payload_index
             try:
                 async with sem:
                     body = await post_json(
-                        session, url, {"X": payload_X},
+                        session, url, payload,
                         retries=self.n_retries, timeout=self.timeout,
                     )
             except Exception as exc:
@@ -385,6 +404,8 @@ class Client:
 
         async def score_chunk(chunk: pd.DataFrame):
             payload = {"X": chunk.to_numpy(dtype=np.float32).tolist()}
+            if isinstance(chunk.index, pd.DatetimeIndex):
+                payload["index"] = [t.isoformat() for t in chunk.index]
             url = f"{self._machine_url(machine)}/{route}"
             async with sem:
                 try:

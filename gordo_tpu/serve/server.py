@@ -25,6 +25,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
+import pandas as pd
 from aiohttp import web
 
 import gordo_tpu
@@ -47,6 +48,12 @@ class ModelEntry:
         self.model = serializer.load(directory)
         self.metadata = serializer.load_metadata(directory)
         self.scorer = CompiledScorer(self.model)
+        try:
+            self.mtime = os.path.getmtime(
+                os.path.join(directory, serializer.MODEL_FILE)
+            )
+        except OSError:
+            self.mtime = 0.0
 
     @property
     def tags(self) -> List[str]:
@@ -62,9 +69,15 @@ class ModelCollection:
     ``build_project`` writes).
     """
 
-    def __init__(self, entries: Dict[str, ModelEntry], project: str = "project"):
+    def __init__(
+        self,
+        entries: Dict[str, ModelEntry],
+        project: str = "project",
+        source_dir: Optional[str] = None,
+    ):
         self.entries = entries
         self.project = project
+        self.source_dir = source_dir
         self._fleet_scorer = None
 
     @property
@@ -81,10 +94,12 @@ class ModelCollection:
     @classmethod
     def from_directory(cls, path: str, project: str = "project") -> "ModelCollection":
         entries: Dict[str, ModelEntry] = {}
+        source_dir: Optional[str] = None
         if os.path.exists(os.path.join(path, serializer.MODEL_FILE)):
             name = os.path.basename(os.path.normpath(path))
             entries[name] = ModelEntry(name, path)
         else:
+            source_dir = path
             for child in sorted(os.listdir(path)):
                 sub = os.path.join(path, child)
                 if os.path.exists(os.path.join(sub, serializer.MODEL_FILE)):
@@ -94,10 +109,52 @@ class ModelCollection:
                         logger.exception("Failed to load artifact %s", sub)
         if not entries:
             raise FileNotFoundError(f"No model artifacts under {path!r}")
-        return cls(entries, project=project)
+        return cls(entries, project=project, source_dir=source_dir)
 
     def get(self, name: str) -> Optional[ModelEntry]:
         return self.entries.get(name)
+
+    def rescan(self) -> Dict[str, List[str]]:
+        """Pick up artifacts dumped/rebuilt/removed after startup.
+
+        The reference got this "for free" from its pod-per-model design (a
+        new machine = a new pod); one process serving a whole project must
+        instead watch its artifact dir.  New dirs load, changed model files
+        (mtime) reload, vanished dirs drop.  The entries dict is replaced
+        atomically so in-flight requests keep a consistent view.
+        """
+        if self.source_dir is None or not os.path.isdir(self.source_dir):
+            return {"added": [], "reloaded": [], "removed": []}
+        added, reloaded, removed = [], [], []
+        new_entries: Dict[str, ModelEntry] = {}
+        for child in sorted(os.listdir(self.source_dir)):
+            sub = os.path.join(self.source_dir, child)
+            model_file = os.path.join(sub, serializer.MODEL_FILE)
+            if not os.path.exists(model_file):
+                continue
+            current = self.entries.get(child)
+            try:
+                mtime = os.path.getmtime(model_file)
+                if current is None:
+                    new_entries[child] = ModelEntry(child, sub)
+                    added.append(child)
+                elif mtime > current.mtime:
+                    new_entries[child] = ModelEntry(child, sub)
+                    reloaded.append(child)
+                else:
+                    new_entries[child] = current
+            except Exception:
+                logger.exception("Failed to (re)load artifact %s", sub)
+                if current is not None:  # keep serving the old model
+                    new_entries[child] = current
+        removed = sorted(set(self.entries) - set(new_entries))
+        if added or reloaded or removed:
+            logger.info(
+                "Collection rescan: +%s ~%s -%s", added, reloaded, removed
+            )
+            self.entries = new_entries
+            self._fleet_scorer = None  # stacked params must restack
+        return {"added": added, "reloaded": reloaded, "removed": removed}
 
 
 # ---------------------------------------------------------------------------
@@ -134,6 +191,40 @@ def _jsonable(out: Dict[str, Any]) -> Dict[str, Any]:
     return {
         k: (v.tolist() if isinstance(v, np.ndarray) else v)
         for k, v in out.items()
+    }
+
+
+def parse_index(payload: Any, n_rows: int) -> Optional[pd.DatetimeIndex]:
+    """Optional per-row timestamps riding with X (reference server-views
+    behavior: requests carrying time info get time info back)."""
+    idx = payload.get("index") if isinstance(payload, dict) else None
+    if idx is None:
+        return None
+    if not isinstance(idx, list) or len(idx) != n_rows:
+        got = len(idx) if isinstance(idx, list) else type(idx).__name__
+        raise ValueError(
+            f"index must list one timestamp per X row ({n_rows}), got {got}"
+        )
+    try:
+        return pd.DatetimeIndex(pd.to_datetime(idx, utc=True))
+    except Exception as exc:
+        raise ValueError(f"index is not parseable as timestamps: {exc}")
+
+
+def time_columns(index: pd.DatetimeIndex, n_out: int) -> Dict[str, List[str]]:
+    """Per-output-row ``start``/``end`` (reference ``make_base_dataframe``
+    columns): start = the input row's timestamp (offset rows consumed at the
+    front), end = start + the index's typical step."""
+    start = index[len(index) - n_out:]
+    delta = (
+        pd.Series(index[1:] - index[:-1]).median()
+        if len(index) >= 2
+        else pd.Timedelta(0)
+    )
+    end = start + delta
+    return {
+        "start": [t.isoformat() for t in start],
+        "end": [t.isoformat() for t in end],
     }
 
 
@@ -174,6 +265,7 @@ async def prediction(request: web.Request) -> web.Response:
         payload = await request.json()
         X = parse_X(payload, entry.tags)
         _validate_width(X, entry)
+        index = parse_index(payload, X.shape[0])
     except ValueError as exc:
         return web.json_response({"error": str(exc)}, status=400)
     loop = asyncio.get_running_loop()
@@ -182,9 +274,12 @@ async def prediction(request: web.Request) -> web.Response:
     except Exception as exc:
         logger.exception("Prediction failed for %s", entry.name)
         return web.json_response({"error": str(exc)}, status=500)
+    data: Dict[str, Any] = {"model-output": out.tolist()}
+    if index is not None:
+        data.update(time_columns(index, out.shape[0]))
     return web.json_response(
         {
-            "data": {"model-output": out.tolist()},
+            "data": data,
             "time-seconds": round(time.perf_counter() - t0, 6),
         }
     )
@@ -204,6 +299,7 @@ async def anomaly_prediction(request: web.Request) -> web.Response:
         payload = await request.json()
         X = parse_X(payload, entry.tags)
         _validate_width(X, entry)
+        index = parse_index(payload, X.shape[0])
         y = (
             parse_X({"X": payload["y"]}, entry.tags)
             if isinstance(payload, dict) and payload.get("y") is not None
@@ -219,9 +315,12 @@ async def anomaly_prediction(request: web.Request) -> web.Response:
     except Exception as exc:
         logger.exception("Anomaly scoring failed for %s", entry.name)
         return web.json_response({"error": str(exc)}, status=500)
+    data = _jsonable(out)
+    if index is not None:
+        data.update(time_columns(index, len(data["model-output"])))
     return web.json_response(
         {
-            "data": _jsonable(out),
+            "data": data,
             "time-seconds": round(time.perf_counter() - t0, 6),
         }
     )
@@ -243,7 +342,9 @@ async def bulk_anomaly_prediction(request: web.Request) -> web.Response:
         return web.json_response({"error": str(exc)}, status=400)
     # per-machine validation: one bad machine reports in ITS result slot and
     # must not 400 the rest of the fleet
+    indices = payload.get("index") or {}
     X_by_name: Dict[str, np.ndarray] = {}
+    index_by_name: Dict[str, pd.DatetimeIndex] = {}
     machine_errors: Dict[str, Dict[str, str]] = {}
     for name, rows in payload["X"].items():
         entry = collection.get(name)
@@ -252,6 +353,10 @@ async def bulk_anomaly_prediction(request: web.Request) -> web.Response:
                 raise ValueError(f"Unknown machine {name!r}")
             X = parse_X({"X": rows}, entry.tags)
             _validate_width(X, entry)
+            if isinstance(indices, dict) and name in indices:
+                index = parse_index({"index": indices[name]}, X.shape[0])
+                if index is not None:
+                    index_by_name[name] = index
             X_by_name[name] = X
         except ValueError as exc:
             machine_errors[name] = {"error": str(exc)}
@@ -272,6 +377,11 @@ async def bulk_anomaly_prediction(request: web.Request) -> web.Response:
         logger.exception("Bulk anomaly scoring failed")
         return web.json_response({"error": str(exc)}, status=500)
     data = {name: _jsonable(res) for name, res in out.items()}
+    for name, res in data.items():
+        if name in index_by_name and "model-output" in res:
+            res.update(
+                time_columns(index_by_name[name], len(res["model-output"]))
+            )
     data.update(machine_errors)
     return web.json_response(
         {
@@ -318,9 +428,44 @@ def _json_dumps(obj) -> str:
 # app factory
 # ---------------------------------------------------------------------------
 
-def build_app(collection: ModelCollection) -> web.Application:
+def build_app(
+    collection: ModelCollection, rescan_interval: float = 0.0
+) -> web.Application:
+    """``rescan_interval > 0`` starts a background artifact-dir rescan so
+    machines built after startup begin serving without a restart."""
     app = web.Application(client_max_size=256 * 1024 * 1024)
     app[COLLECTION_KEY] = collection
+
+    if rescan_interval > 0 and collection.source_dir is not None:
+
+        async def _rescan_loop(app: web.Application):
+            loop = asyncio.get_running_loop()
+            while True:
+                await asyncio.sleep(rescan_interval)
+                try:
+                    # artifact loads unpickle params — keep the accept loop
+                    # responsive by rescanning in the executor
+                    await loop.run_in_executor(None, collection.rescan)
+                except Exception:
+                    logger.exception("Artifact rescan failed")
+
+        async def _start(app: web.Application):
+            app["_rescan_task"] = asyncio.get_running_loop().create_task(
+                _rescan_loop(app)
+            )
+
+        async def _stop(app: web.Application):
+            task = app.get("_rescan_task")
+            if task is not None:
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+
+        app.on_startup.append(_start)
+        app.on_cleanup.append(_stop)
+
     p = f"{API_PREFIX}/{{project}}"
     app.router.add_get(f"{p}/", project_index)
     # registered before the {machine} routes so "_bulk" never resolves as a
@@ -339,6 +484,7 @@ def run_server(
     host: str = "0.0.0.0",
     port: int = 5555,
     project: str = "project",
+    rescan_interval: float = 30.0,
 ) -> None:
     """Blocking entrypoint (reference: ``gordo run-server``)."""
     collection = ModelCollection.from_directory(model_dir, project=project)
@@ -349,4 +495,8 @@ def run_server(
         host,
         port,
     )
-    web.run_app(build_app(collection), host=host, port=port)
+    web.run_app(
+        build_app(collection, rescan_interval=rescan_interval),
+        host=host,
+        port=port,
+    )

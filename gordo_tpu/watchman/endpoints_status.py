@@ -92,6 +92,43 @@ async def _check_one(
     )
 
 
+async def discover_machines(
+    project: str,
+    base_urls: Sequence[str],
+    timeout: float = 5.0,
+    session: Optional[aiohttp.ClientSession] = None,
+) -> List[str]:
+    """Machines each target server reports in its project index.
+
+    The reference discovered endpoints from kubernetes namespace events;
+    here one server hosts many machines, so the server's own
+    ``GET /gordo/v0/<project>/`` index is the discovery source — machines
+    built/loaded after watchman start appear on the next poll.
+    """
+    own_session = session is None
+    session = session or aiohttp.ClientSession()
+    names: List[str] = []
+    try:
+        for base in base_urls:
+            try:
+                async with session.get(
+                    f"{base}{API_PREFIX}/{project}/",
+                    timeout=aiohttp.ClientTimeout(total=timeout),
+                ) as resp:
+                    if resp.status != 200:
+                        continue
+                    body = await resp.json()
+            except (aiohttp.ClientError, asyncio.TimeoutError, ValueError):
+                continue
+            for name in body.get("machines") or []:
+                if name not in names:
+                    names.append(str(name))
+    finally:
+        if own_session:
+            await session.close()
+    return names
+
+
 async def poll_endpoints(
     project: str,
     machines: Sequence[str],

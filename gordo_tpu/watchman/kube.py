@@ -1,0 +1,79 @@
+"""Kubernetes target discovery for watchman.
+
+Reference equivalent: ``gordo_components/watchman`` watched kubernetes
+namespace events to discover per-machine ml-server pods.  The TPU-era
+topology is one server Deployment per project (many machines each), so
+discovery here finds *server Services* by label and hands their URLs to
+:class:`~gordo_tpu.watchman.server.Watchman` as targets; machine-level
+discovery then rides each server's own project index
+(``endpoints_status.discover_machines``).
+
+Import-gated on the ``kubernetes`` client package (not in the TPU image);
+tests fake the module in ``sys.modules`` — the reference mocked the k8s
+client the same way (SURVEY.md §5 watchman bullet).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class KubeTargetDiscovery:
+    """Resolve ml-server base URLs from Services in a namespace.
+
+    Services are selected by ``label_selector`` (default: the project
+    label the workflow generator stamps on server Services) and mapped to
+    ``http://<service-name>.<namespace>:<port>`` cluster-DNS URLs.
+    """
+
+    def __init__(
+        self,
+        namespace: str,
+        project: Optional[str] = None,
+        label_selector: Optional[str] = None,
+        in_cluster: bool = True,
+        scheme: str = "http",
+    ):
+        try:
+            import kubernetes  # noqa: F401
+        except ImportError as exc:
+            raise ImportError(
+                "KubeTargetDiscovery requires the 'kubernetes' client "
+                "package, which is not installed in this environment. Pass "
+                "explicit --targets to run-watchman instead."
+            ) from exc
+        from kubernetes import client, config
+
+        if in_cluster:
+            config.load_incluster_config()
+        else:
+            config.load_kube_config()
+        self.namespace = namespace
+        self.project = project
+        self.label_selector = label_selector or (
+            f"app.kubernetes.io/part-of=gordo,gordo/project={project}"
+            if project
+            else "app.kubernetes.io/part-of=gordo"
+        )
+        self.scheme = scheme
+        self._core = client.CoreV1Api()
+
+    def targets(self) -> List[str]:
+        """Current server base URLs (one per matching Service)."""
+        urls: List[str] = []
+        services = self._core.list_namespaced_service(
+            self.namespace, label_selector=self.label_selector
+        )
+        for svc in services.items:
+            name = svc.metadata.name
+            ports = svc.spec.ports or []
+            port = ports[0].port if ports else 80
+            urls.append(f"{self.scheme}://{name}.{self.namespace}:{port}")
+        logger.debug(
+            "k8s discovery (%s, %r): %d targets",
+            self.namespace, self.label_selector, len(urls),
+        )
+        return urls

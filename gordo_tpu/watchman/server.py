@@ -12,12 +12,16 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence
 
 from aiohttp import web
 
 import gordo_tpu
-from gordo_tpu.watchman.endpoints_status import EndpointStatus, poll_endpoints
+from gordo_tpu.watchman.endpoints_status import (
+    EndpointStatus,
+    discover_machines,
+    poll_endpoints,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -36,6 +40,8 @@ class Watchman:
         poll_interval: float = 30.0,
         request_timeout: float = 5.0,
         namespace: Optional[str] = None,
+        discover: bool = True,
+        target_discovery: Optional[Any] = None,
     ):
         self.project = project
         self.machines = list(machines)
@@ -43,15 +49,46 @@ class Watchman:
         self.poll_interval = poll_interval
         self.request_timeout = request_timeout
         self.namespace = namespace
+        #: also ask each target's project index for its machine list, so
+        #: machines appearing after startup are polled without reconfig
+        #: (reference parity: the k8s-event endpoint discovery)
+        self.discover = discover
+        #: optional ``watchman.kube.KubeTargetDiscovery``-shaped object
+        #: contributing target base urls (``.targets() -> [url]``)
+        self.target_discovery = target_discovery
         self.started_at = time.time()
         self.statuses: Dict[str, EndpointStatus] = {}
         self._task: Optional[asyncio.Task] = None
 
+    async def _current_targets(self) -> List[str]:
+        targets = list(self.target_base_urls)
+        if self.target_discovery is not None:
+            try:
+                loop = asyncio.get_running_loop()
+                discovered = await loop.run_in_executor(
+                    None, self.target_discovery.targets
+                )
+                for url in discovered:
+                    if url not in targets:
+                        targets.append(url)
+            except Exception:
+                logger.exception("Target discovery failed")
+        return targets
+
     async def refresh(self) -> List[EndpointStatus]:
+        targets = await self._current_targets()
+        machines = list(self.machines)
+        if self.discover:
+            for name in await discover_machines(
+                self.project, targets, timeout=self.request_timeout
+            ):
+                if name not in machines:
+                    machines.append(name)
+                    self.machines.append(name)
         statuses = await poll_endpoints(
             self.project,
-            self.machines,
-            self.target_base_urls,
+            machines,
+            targets,
             timeout=self.request_timeout,
         )
         for status in statuses:
@@ -131,10 +168,13 @@ def run_watchman(
     host: str = "0.0.0.0",
     port: int = 5556,
     poll_interval: float = 30.0,
+    discover: bool = True,
+    target_discovery: Optional[Any] = None,
 ) -> None:
     """Blocking entrypoint (reference: ``gordo run-watchman``)."""
     watchman = Watchman(
-        project, machines, target_base_urls, poll_interval=poll_interval
+        project, machines, target_base_urls, poll_interval=poll_interval,
+        discover=discover, target_discovery=target_discovery,
     )
     logger.info(
         "Watchman for project %r: %d machines, %d targets, every %.0fs",

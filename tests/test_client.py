@@ -218,3 +218,24 @@ def test_frame_from_payload_thresholds_when_rows_equal_tags():
     assert frame[("tag-anomaly-thresholds", "b")].tolist() == [0.7, 0.7]
     assert frame[("total-anomaly-score", "")].tolist() == [1.0, 2.0]
     assert frame[("anomaly-confidence", "")].tolist() == [0.1, 0.2]
+
+
+def test_client_roundtrip_returns_server_time_columns(model_dir):
+    """The frames a client assembles carry the SERVER's start index and an
+    ('end','') column — clients no longer reattach time locally."""
+
+    def run(port):
+        return Client("cliproj", port=port, batch_size=50).predict(
+            "2017-12-25T06:00:00Z", "2017-12-26T06:00:00Z",
+            machine_names=["client-machine-a"],
+        )
+
+    results = _serve_and(model_dir, run)
+    assert results[0].ok
+    frame = results[0].predictions
+    assert isinstance(frame.index, pd.DatetimeIndex)
+    assert frame.index.name == "start"
+    assert ("end", "") in frame.columns
+    # end - start is the dataset resolution (10min for RandomDataset builds)
+    deltas = (frame[("end", "")] - frame.index).unique()
+    assert len(deltas) == 1

@@ -1,0 +1,196 @@
+"""Influx provider/forwarder tests over a mocked ``influxdb`` client.
+
+The reference covered these with a dockerized InfluxDB (SURVEY.md §5);
+no docker in this image, so the client module is faked in ``sys.modules``
+— exercising query construction, URI parsing, batching, and retry logic
+without the real package.
+"""
+
+import sys
+import types
+from unittest import mock
+
+import numpy as np
+import pandas as pd
+import pytest
+
+
+class FakeDataFrameClient:
+    """Records constructor kwargs, queries, and written points."""
+
+    instances: list = []
+
+    def __init__(self, **kwargs):
+        self.kwargs = kwargs
+        self.queries = []
+        self.written = []
+        self.dropped = []
+        self.created = []
+        self.fail_writes = 0  # fail this many write_points calls
+        FakeDataFrameClient.instances.append(self)
+
+    def query(self, q):
+        self.queries.append(q)
+        idx = pd.date_range("2020-01-01", periods=4, freq="10min", tz="UTC")
+        return {"sensors": pd.DataFrame({"Value": [1.0, 2.0, 3.0, 4.0]}, index=idx)}
+
+    def write_points(self, frame, measurement=None, tags=None, batch_size=None):
+        if self.fail_writes > 0:
+            self.fail_writes -= 1
+            raise ConnectionError("influx write failed")
+        self.written.append(
+            {"frame": frame, "measurement": measurement, "tags": tags,
+             "batch_size": batch_size}
+        )
+
+    def drop_database(self, name):
+        self.dropped.append(name)
+
+    def create_database(self, name):
+        self.created.append(name)
+
+
+@pytest.fixture()
+def fake_influx(monkeypatch):
+    module = types.ModuleType("influxdb")
+    module.DataFrameClient = FakeDataFrameClient
+    FakeDataFrameClient.instances = []
+    monkeypatch.setitem(sys.modules, "influxdb", module)
+    return module
+
+
+class TestInfluxDataProvider:
+    def test_uri_parsing(self, fake_influx):
+        from gordo_tpu.dataset.data_provider.providers import InfluxDataProvider
+
+        InfluxDataProvider(uri="influxhost:8087/user/pass/sensordb")
+        client = FakeDataFrameClient.instances[-1]
+        assert client.kwargs == {
+            "host": "influxhost",
+            "port": 8087,
+            "username": "user",
+            "password": "pass",
+            "database": "sensordb",
+        }
+
+    def test_uri_default_port_and_extra_kwargs(self, fake_influx):
+        from gordo_tpu.dataset.data_provider.providers import InfluxDataProvider
+
+        InfluxDataProvider(uri="h/u/p/db", ssl=True)
+        assert FakeDataFrameClient.instances[-1].kwargs["port"] == 8086
+        assert FakeDataFrameClient.instances[-1].kwargs["ssl"] is True
+
+    def test_query_construction_and_series(self, fake_influx):
+        from gordo_tpu.dataset.data_provider.providers import InfluxDataProvider
+
+        provider = InfluxDataProvider(
+            measurement="sensors", value_name="Value", uri="h:1/u/p/db"
+        )
+        series = list(
+            provider.load_series(
+                pd.Timestamp("2020-01-01", tz="UTC"),
+                pd.Timestamp("2020-01-02", tz="UTC"),
+                ["tag-a", "tag-b"],
+            )
+        )
+        client = FakeDataFrameClient.instances[-1]
+        assert len(client.queries) == 2
+        q = client.queries[0]
+        assert '"Value"' in q and '"sensors"' in q
+        assert "2020-01-01" in q and "2020-01-02" in q
+        assert "\"tag\" = 'tag-a'" in q
+        assert [s.name for s in series] == ["tag-a", "tag-b"]
+        assert len(series[0]) == 4
+
+    def test_pickles_without_client(self, fake_influx):
+        import pickle
+
+        from gordo_tpu.dataset.data_provider.providers import InfluxDataProvider
+
+        provider = InfluxDataProvider(uri="h:1/u/p/db")
+        clone = pickle.loads(pickle.dumps(provider))
+        assert clone._client is None
+
+    def test_import_gated_without_package(self):
+        from gordo_tpu.dataset.data_provider.providers import InfluxDataProvider
+
+        with mock.patch.dict(sys.modules, {"influxdb": None}):
+            with pytest.raises(ImportError, match="influxdb"):
+                InfluxDataProvider(uri="h:1/u/p/db")
+
+
+def _frame():
+    idx = pd.date_range("2020-01-01", periods=3, freq="10min", tz="UTC")
+    frame = pd.DataFrame(
+        {
+            ("model-output", "t1"): [1.0, 2.0, 3.0],
+            ("model-output", "t2"): [1.0, 2.0, 3.0],
+            ("total-anomaly-score", ""): [0.1, 0.2, 0.3],
+        },
+        index=idx,
+    )
+    frame.columns = pd.MultiIndex.from_tuples(frame.columns)
+    return frame
+
+
+class TestForwardPredictionsIntoInflux:
+    def _make(self, fake_influx, **kwargs):
+        from gordo_tpu.client.forwarders import ForwardPredictionsIntoInflux
+
+        return ForwardPredictionsIntoInflux(
+            destination_influx_uri="h:8086/user:pa:ss/preddb", **kwargs
+        )
+
+    def test_uri_parsing_allows_colon_in_password(self, fake_influx):
+        self._make(fake_influx)
+        client = FakeDataFrameClient.instances[-1]
+        assert client.kwargs["username"] == "user"
+        assert client.kwargs["password"] == "pa:ss"
+        assert client.kwargs["database"] == "preddb"
+        assert client.kwargs["port"] == 8086
+
+    def test_bad_uri_rejected(self, fake_influx):
+        from gordo_tpu.client.forwarders import ForwardPredictionsIntoInflux
+
+        with pytest.raises(ValueError, match="destination_influx_uri"):
+            ForwardPredictionsIntoInflux(destination_influx_uri="nonsense")
+
+    def test_recreate_drops_and_creates(self, fake_influx):
+        self._make(fake_influx, destination_influx_recreate=True)
+        client = FakeDataFrameClient.instances[-1]
+        assert client.dropped == ["preddb"] and client.created == ["preddb"]
+
+    def test_forward_writes_one_measurement_per_top_level(self, fake_influx):
+        fwd = self._make(fake_influx)
+        fwd.forward(_frame(), "machine-a")
+        client = FakeDataFrameClient.instances[-1]
+        measurements = {w["measurement"] for w in client.written}
+        assert measurements == {"model-output", "total-anomaly-score"}
+        for w in client.written:
+            assert w["tags"] == {"machine": "machine-a"}
+            assert w["batch_size"] == 10_000
+        total = next(
+            w for w in client.written
+            if w["measurement"] == "total-anomaly-score"
+        )
+        # empty second-level label becomes the measurement name
+        assert list(total["frame"].columns) == ["total-anomaly-score"]
+
+    def test_retry_then_success(self, fake_influx):
+        fwd = self._make(fake_influx)
+        client = FakeDataFrameClient.instances[-1]
+        client.fail_writes = 2
+        fwd.forward(_frame(), "machine-a")
+        assert len(client.written) == 2  # both measurements landed
+
+    def test_retries_exhausted_raises(self, fake_influx):
+        fwd = self._make(fake_influx, n_retries=2)
+        client = FakeDataFrameClient.instances[-1]
+        client.fail_writes = 99
+        with pytest.raises(ConnectionError):
+            fwd.forward(_frame(), "machine-a")
+
+    def test_api_key_header(self, fake_influx):
+        self._make(fake_influx, destination_influx_api_key="secret-key")
+        client = FakeDataFrameClient.instances[-1]
+        assert client.kwargs["headers"] == {"Authorization": "secret-key"}

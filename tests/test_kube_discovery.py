@@ -1,0 +1,97 @@
+"""KubeTargetDiscovery tests over a faked ``kubernetes`` client module —
+the reference's watchman tests mocked the k8s client the same way
+(SURVEY.md §5)."""
+
+import sys
+import types
+from unittest import mock
+
+import pytest
+
+
+def _fake_kubernetes(services):
+    """Build a fake `kubernetes` package exposing the surface kube.py uses."""
+    module = types.ModuleType("kubernetes")
+
+    class FakeCoreV1Api:
+        last_call = {}
+
+        def list_namespaced_service(self, namespace, label_selector=None):
+            FakeCoreV1Api.last_call = {
+                "namespace": namespace,
+                "label_selector": label_selector,
+            }
+            items = []
+            for name, port in services:
+                svc = types.SimpleNamespace(
+                    metadata=types.SimpleNamespace(name=name),
+                    spec=types.SimpleNamespace(
+                        ports=[types.SimpleNamespace(port=port)] if port else []
+                    ),
+                )
+                items.append(svc)
+            return types.SimpleNamespace(items=items)
+
+    client = types.ModuleType("kubernetes.client")
+    client.CoreV1Api = FakeCoreV1Api
+    config = types.ModuleType("kubernetes.config")
+    config.load_incluster_config = lambda: None
+    config.load_kube_config = lambda: None
+    module.client = client
+    module.config = config
+    return module, FakeCoreV1Api
+
+
+def test_targets_from_services(monkeypatch):
+    module, api = _fake_kubernetes([("gordo-server-0", 5555), ("gordo-server-1", 80)])
+    monkeypatch.setitem(sys.modules, "kubernetes", module)
+    monkeypatch.setitem(sys.modules, "kubernetes.client", module.client)
+    monkeypatch.setitem(sys.modules, "kubernetes.config", module.config)
+
+    from gordo_tpu.watchman.kube import KubeTargetDiscovery
+
+    disc = KubeTargetDiscovery("prod-ns", project="proj-x", in_cluster=False)
+    assert disc.targets() == [
+        "http://gordo-server-0.prod-ns:5555",
+        "http://gordo-server-1.prod-ns:80",
+    ]
+    assert api.last_call["namespace"] == "prod-ns"
+    assert "gordo/project=proj-x" in api.last_call["label_selector"]
+
+
+def test_portless_service_defaults_to_80(monkeypatch):
+    module, _ = _fake_kubernetes([("bare-svc", None)])
+    monkeypatch.setitem(sys.modules, "kubernetes", module)
+    monkeypatch.setitem(sys.modules, "kubernetes.client", module.client)
+    monkeypatch.setitem(sys.modules, "kubernetes.config", module.config)
+
+    from gordo_tpu.watchman.kube import KubeTargetDiscovery
+
+    disc = KubeTargetDiscovery("ns", in_cluster=False)
+    assert disc.targets() == ["http://bare-svc.ns:80"]
+
+
+def test_import_gated_without_package():
+    from gordo_tpu.watchman.kube import KubeTargetDiscovery
+
+    with mock.patch.dict(sys.modules, {"kubernetes": None}):
+        with pytest.raises(ImportError, match="kubernetes"):
+            KubeTargetDiscovery("ns")
+
+
+def test_watchman_merges_discovered_targets(monkeypatch):
+    """A target_discovery object's URLs join the static target list."""
+    import asyncio
+
+    from gordo_tpu.watchman.server import Watchman
+
+    class StubDiscovery:
+        def targets(self):
+            return ["http://svc-a.ns:5555", "http://static:1"]
+
+    watchman = Watchman(
+        "p", [], ["http://static:1"],
+        target_discovery=StubDiscovery(), discover=False,
+    )
+    targets = asyncio.run(watchman._current_targets())
+    assert targets == ["http://static:1", "http://svc-a.ns:5555"]

@@ -281,3 +281,76 @@ def test_non_numeric_payload_is_400(model_dir):
         return bad.status, nulls.status
 
     assert _call(model_dir, fn) == (400, 200)
+
+
+class TestTimeIndexParity:
+    """Requests carrying per-row timestamps get start/end back (reference
+    server-views behavior: time info in → time info out)."""
+
+    INDEX = [f"2020-01-01T{h:02d}:00:00Z" for h in range(10)]
+    ROWS = [[0.1, 0.5, 0.9]] * 10
+
+    def test_anomaly_returns_start_end(self, model_dir):
+        async def fn(client):
+            resp = await client.post(
+                "/gordo/v0/testproj/machine-a/anomaly/prediction",
+                json={"X": self.ROWS, "index": self.INDEX},
+            )
+            return resp.status, await resp.json()
+
+        status, body = _call(model_dir, fn)
+        assert status == 200
+        data = body["data"]
+        assert len(data["start"]) == len(data["model-output"])
+        assert data["start"][0].startswith("2020-01-01T00:00:00")
+        # end = start + the index's 1h step
+        assert data["end"][0].startswith("2020-01-01T01:00:00")
+
+    def test_prediction_returns_start_end(self, model_dir):
+        async def fn(client):
+            resp = await client.post(
+                "/gordo/v0/testproj/machine-a/prediction",
+                json={"X": self.ROWS, "index": self.INDEX},
+            )
+            return await resp.json()
+
+        data = _call(model_dir, fn)["data"]
+        assert len(data["start"]) == 10 and len(data["end"]) == 10
+
+    def test_without_index_no_time_columns(self, model_dir):
+        async def fn(client):
+            resp = await client.post(
+                "/gordo/v0/testproj/machine-a/anomaly/prediction",
+                json={"X": self.ROWS},
+            )
+            return await resp.json()
+
+        data = _call(model_dir, fn)["data"]
+        assert "start" not in data and "end" not in data
+
+    def test_bad_index_length_is_400(self, model_dir):
+        async def fn(client):
+            resp = await client.post(
+                "/gordo/v0/testproj/machine-a/anomaly/prediction",
+                json={"X": self.ROWS, "index": self.INDEX[:3]},
+            )
+            return resp.status, await resp.json()
+
+        status, body = _call(model_dir, fn)
+        assert status == 400
+        assert "index" in body["error"]
+
+    def test_bulk_returns_per_machine_time(self, model_dir):
+        async def fn(client):
+            resp = await client.post(
+                "/gordo/v0/testproj/_bulk/anomaly/prediction",
+                json={
+                    "X": {"machine-a": self.ROWS},
+                    "index": {"machine-a": self.INDEX},
+                },
+            )
+            return await resp.json()
+
+        data = _call(model_dir, fn)["data"]["machine-a"]
+        assert len(data["start"]) == len(data["model-output"])
+        assert data["start"][0].startswith("2020-01-01T00:00:00")

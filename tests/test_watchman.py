@@ -144,3 +144,95 @@ def test_client_discovers_via_watchman(model_dir):
 
     names = asyncio.run(main())
     assert names == ["wm-machine"]  # ghost skipped as unhealthy
+
+
+def _build_extra_machine(model_dir, name):
+    """Dump one more machine artifact into the project dir after startup."""
+    project = {
+        "machines": [{"name": name, "dataset": {
+            "type": "RandomDataset",
+            "tags": ["w-1", "w-2"],
+            "train_start_date": "2017-12-25T06:00:00Z",
+            "train_end_date": "2017-12-26T06:00:00Z",
+        }}],
+        "globals": PROJECT["globals"],
+    }
+    result = build_project(
+        NormalizedConfig(project, "wmproj").machines, model_dir
+    )
+    assert not result.failed
+
+
+def test_watchman_discovers_machines_added_mid_run(model_dir, tmp_path):
+    """VERDICT weak #7: a machine appearing AFTER watchman start must be
+    discovered (server project-index discovery) and served (collection
+    rescan) without restarting either service."""
+    import shutil
+
+    live_dir = str(tmp_path / "live")
+    shutil.copytree(model_dir, live_dir)
+
+    async def main():
+        collection = ModelCollection.from_directory(live_dir, project="wmproj")
+        runner = web.AppRunner(build_app(collection))
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = runner.addresses[0][1]
+
+        watchman = Watchman(
+            "wmproj",
+            machines=[],  # discovery-only: no static list at all
+            target_base_urls=[f"http://127.0.0.1:{port}"],
+            poll_interval=3600,
+        )
+        try:
+            await watchman.refresh()
+            first = sorted(watchman.statuses)
+
+            # a new machine is built into the artifact dir mid-run
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(
+                None, _build_extra_machine, live_dir, "wm-late-machine"
+            )
+            changes = await loop.run_in_executor(None, collection.rescan)
+            await watchman.refresh()
+            second = sorted(watchman.statuses)
+            healthy = {
+                m: s.healthy for m, s in watchman.statuses.items()
+            }
+            return first, changes, second, healthy
+        finally:
+            await runner.cleanup()
+
+    first, changes, second, healthy = asyncio.run(main())
+    assert first == ["wm-machine"]  # discovered with zero config
+    assert changes["added"] == ["wm-late-machine"]
+    assert second == ["wm-late-machine", "wm-machine"]
+    assert healthy["wm-late-machine"] is True
+
+
+def test_collection_rescan_reloads_rebuilt_and_drops_removed(model_dir, tmp_path):
+    import os
+    import shutil
+    import time as time_mod
+
+    live_dir = str(tmp_path / "live2")
+    shutil.copytree(model_dir, live_dir)
+    collection = ModelCollection.from_directory(live_dir, project="wmproj")
+    old_model = collection.get("wm-machine").model
+
+    # rebuild in place: newer mtime on the model file must reload the entry
+    model_file = os.path.join(live_dir, "wm-machine", "model.pkl")
+    os.utime(model_file, (time_mod.time() + 5, time_mod.time() + 5))
+    changes = collection.rescan()
+    assert changes["reloaded"] == ["wm-machine"]
+    assert collection.get("wm-machine").model is not old_model
+
+    # removal drops the entry
+    shutil.rmtree(os.path.join(live_dir, "wm-machine"))
+    _build_extra_machine(live_dir, "wm-survivor")
+    changes = collection.rescan()
+    assert changes["removed"] == ["wm-machine"]
+    assert collection.get("wm-machine") is None
+    assert collection.get("wm-survivor") is not None
