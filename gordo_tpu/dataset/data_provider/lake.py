@@ -211,9 +211,12 @@ class NcsReader:
             return cached
         tag_dir = posixpath.join(self._asset_dir(tag), tag.name)
         if self.fs.isdir(tag_dir):
+            # strict-match only — no ls() fallback: a stray README/checksum
+            # in a tag dir must never be parsed as sensor data (the whole
+            # point of _is_tag_file's exact-name rule above)
             names = [
                 n for n in self.fs.ls(tag_dir) if self._is_tag_file(n, tag.name)
-            ] or self.fs.ls(tag_dir)
+            ]
             files = [posixpath.join(tag_dir, n) for n in sorted(names)]
         else:
             asset_dir = self._asset_dir(tag)

@@ -282,13 +282,25 @@ class InfluxDataProvider(GordoBaseDataProvider):
     def can_handle_tag(self, tag) -> bool:
         return True
 
+    @staticmethod
+    def _esc_ident(name: str) -> str:
+        """Escape an InfluxQL double-quoted identifier."""
+        return name.replace("\\", "\\\\").replace('"', '\\"')
+
+    @staticmethod
+    def _esc_str(value: str) -> str:
+        """Escape an InfluxQL single-quoted string literal — a tag name
+        containing ``'`` must not break (or rewrite) the query."""
+        return value.replace("\\", "\\\\").replace("'", "\\'")
+
     def load_series(self, from_ts, to_ts, tag_list, dry_run=False):
         for tag in normalize_sensor_tags(list(tag_list)):
             query = (
-                f'SELECT "{self.value_name}" FROM "{self.measurement}" '
+                f'SELECT "{self._esc_ident(self.value_name)}" '
+                f'FROM "{self._esc_ident(self.measurement)}" '
                 f"WHERE time >= '{from_ts.isoformat()}' "
                 f"AND time < '{to_ts.isoformat()}' "
-                f"AND \"tag\" = '{tag.name}'"
+                f"AND \"tag\" = '{self._esc_str(tag.name)}'"
             )
             result = self._client.query(query)
             frame = result.get(self.measurement, pd.DataFrame())

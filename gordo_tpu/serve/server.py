@@ -21,6 +21,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
@@ -49,16 +50,24 @@ class ModelEntry:
         self.metadata = serializer.load_metadata(directory)
         self.scorer = CompiledScorer(self.model)
         try:
-            self.mtime = os.path.getmtime(
-                os.path.join(directory, serializer.MODEL_FILE)
-            )
+            st = os.stat(os.path.join(directory, serializer.MODEL_FILE))
+            self.mtime = st.st_mtime
+            self.size = st.st_size
         except OSError:
             self.mtime = 0.0
+            self.size = -1
 
     @property
     def tags(self) -> List[str]:
         tag_list = self.metadata.get("dataset", {}).get("tag_list") or []
         return [t["name"] if isinstance(t, dict) else str(t) for t in tag_list]
+
+    @property
+    def resolution(self) -> Optional[str]:
+        """The artifact's training resample resolution (pandas offset), used
+        as the row-duration fallback when a request's index is too short to
+        derive steps from."""
+        return self.metadata.get("dataset", {}).get("resolution")
 
 
 class ModelCollection:
@@ -79,17 +88,22 @@ class ModelCollection:
         self.project = project
         self.source_dir = source_dir
         self._fleet_scorer = None
+        # guards the (entries, _fleet_scorer) pair: the background rescan
+        # swaps both from an executor thread while bulk requests lazily
+        # build the scorer from other executor threads
+        self._lock = threading.Lock()
 
     @property
     def fleet_scorer(self):
         """Stacked multi-machine scorer (built lazily on first bulk call)."""
-        if self._fleet_scorer is None:
-            from gordo_tpu.serve.fleet_scorer import FleetScorer
+        with self._lock:
+            if self._fleet_scorer is None:
+                from gordo_tpu.serve.fleet_scorer import FleetScorer
 
-            self._fleet_scorer = FleetScorer.from_models(
-                {name: e.model for name, e in self.entries.items()}
-            )
-        return self._fleet_scorer
+                self._fleet_scorer = FleetScorer.from_models(
+                    {name: e.model for name, e in self.entries.items()}
+                )
+            return self._fleet_scorer
 
     @classmethod
     def from_directory(cls, path: str, project: str = "project") -> "ModelCollection":
@@ -134,11 +148,18 @@ class ModelCollection:
                 continue
             current = self.entries.get(child)
             try:
-                mtime = os.path.getmtime(model_file)
+                st = os.stat(model_file)
                 if current is None:
                     new_entries[child] = ModelEntry(child, sub)
                     added.append(child)
-                elif mtime > current.mtime:
+                elif (st.st_mtime, st.st_size) != (
+                    current.mtime, current.size,
+                ):
+                    # (mtime, size) inequality, not mtime>: a rebuild can
+                    # land with an equal-or-older mtime (cache copies, clock
+                    # skew) and must still reload.  Known blind spot: an
+                    # mtime-preserving copy (cp -p) of a same-size artifact
+                    # is indistinguishable without hashing content.
                     new_entries[child] = ModelEntry(child, sub)
                     reloaded.append(child)
                 else:
@@ -152,8 +173,9 @@ class ModelCollection:
             logger.info(
                 "Collection rescan: +%s ~%s -%s", added, reloaded, removed
             )
-            self.entries = new_entries
-            self._fleet_scorer = None  # stacked params must restack
+            with self._lock:  # swap entries + scorer reset atomically
+                self.entries = new_entries
+                self._fleet_scorer = None  # stacked params must restack
         return {"added": added, "reloaded": reloaded, "removed": removed}
 
 
@@ -211,17 +233,33 @@ def parse_index(payload: Any, n_rows: int) -> Optional[pd.DatetimeIndex]:
         raise ValueError(f"index is not parseable as timestamps: {exc}")
 
 
-def time_columns(index: pd.DatetimeIndex, n_out: int) -> Dict[str, List[str]]:
+def time_columns(
+    index: pd.DatetimeIndex, n_out: int, resolution: Optional[str] = None
+) -> Dict[str, List[str]]:
     """Per-output-row ``start``/``end`` (reference ``make_base_dataframe``
     columns): start = the input row's timestamp (offset rows consumed at the
-    front), end = start + the index's typical step."""
+    front), end = the NEXT row's timestamp — per-row diffs, so irregular
+    indices get their true row spans (a median step would mislabel every row
+    around a gap).  The last row extends by its preceding step; 1-row
+    requests (no step to derive) fall back to the artifact's training
+    ``resolution``, then to zero."""
     start = index[len(index) - n_out:]
-    delta = (
-        pd.Series(index[1:] - index[:-1]).median()
-        if len(index) >= 2
-        else pd.Timedelta(0)
-    )
-    end = start + delta
+    if len(index) >= 2:
+        deltas = index[1:] - index[:-1]
+        end_all = index[1:].append(
+            pd.DatetimeIndex([index[-1] + deltas[-1]])
+        )
+        end = end_all[len(index) - n_out:]
+    else:
+        res_delta = pd.Timedelta(0)
+        if resolution:
+            try:
+                res_delta = pd.Timedelta(
+                    pd.tseries.frequencies.to_offset(resolution)
+                )
+            except (ValueError, TypeError):
+                pass
+        end = start + res_delta
     return {
         "start": [t.isoformat() for t in start],
         "end": [t.isoformat() for t in end],
@@ -276,7 +314,7 @@ async def prediction(request: web.Request) -> web.Response:
         return web.json_response({"error": str(exc)}, status=500)
     data: Dict[str, Any] = {"model-output": out.tolist()}
     if index is not None:
-        data.update(time_columns(index, out.shape[0]))
+        data.update(time_columns(index, out.shape[0], entry.resolution))
     return web.json_response(
         {
             "data": data,
@@ -317,7 +355,9 @@ async def anomaly_prediction(request: web.Request) -> web.Response:
         return web.json_response({"error": str(exc)}, status=500)
     data = _jsonable(out)
     if index is not None:
-        data.update(time_columns(index, len(data["model-output"])))
+        data.update(
+            time_columns(index, len(data["model-output"]), entry.resolution)
+        )
     return web.json_response(
         {
             "data": data,
@@ -379,8 +419,13 @@ async def bulk_anomaly_prediction(request: web.Request) -> web.Response:
     data = {name: _jsonable(res) for name, res in out.items()}
     for name, res in data.items():
         if name in index_by_name and "model-output" in res:
+            entry = collection.get(name)
             res.update(
-                time_columns(index_by_name[name], len(res["model-output"]))
+                time_columns(
+                    index_by_name[name],
+                    len(res["model-output"]),
+                    entry.resolution if entry is not None else None,
+                )
             )
     data.update(machine_errors)
     return web.json_response(

@@ -105,9 +105,26 @@ async def discover_machines(
     ``GET /gordo/v0/<project>/`` index is the discovery source — machines
     built/loaded after watchman start appear on the next poll.
     """
+    names, _ = await discover_machines_ex(
+        project, base_urls, timeout=timeout, session=session
+    )
+    return names
+
+
+async def discover_machines_ex(
+    project: str,
+    base_urls: Sequence[str],
+    timeout: float = 5.0,
+    session: Optional[aiohttp.ClientSession] = None,
+) -> "tuple[List[str], int]":
+    """Like :func:`discover_machines` but also reports how many targets
+    answered their index at all — callers evicting machines absent from
+    discovery must distinguish "every index omits this machine" from "no
+    index was reachable this cycle"."""
     own_session = session is None
     session = session or aiohttp.ClientSession()
     names: List[str] = []
+    n_responding = 0
     try:
         for base in base_urls:
             try:
@@ -120,13 +137,14 @@ async def discover_machines(
                     body = await resp.json()
             except (aiohttp.ClientError, asyncio.TimeoutError, ValueError):
                 continue
+            n_responding += 1
             for name in body.get("machines") or []:
                 if name not in names:
                     names.append(str(name))
     finally:
         if own_session:
             await session.close()
-    return names
+    return names, n_responding
 
 
 async def poll_endpoints(

@@ -19,7 +19,7 @@ from aiohttp import web
 import gordo_tpu
 from gordo_tpu.watchman.endpoints_status import (
     EndpointStatus,
-    discover_machines,
+    discover_machines_ex,
     poll_endpoints,
 )
 
@@ -42,9 +42,19 @@ class Watchman:
         namespace: Optional[str] = None,
         discover: bool = True,
         target_discovery: Optional[Any] = None,
+        evict_after: int = 3,
     ):
         self.project = project
         self.machines = list(machines)
+        #: statically configured machines are never evicted — only machines
+        #: that ARRIVED via discovery can LEAVE via discovery
+        self._configured = set(self.machines)
+        #: machines evict after this many consecutive polls in which EVERY
+        #: target index responded and none listed the machine
+        #: (reference parity: a deleted deployment disappears from watchman
+        #: once its pod is gone, instead of being reported unhealthy forever)
+        self.evict_after = evict_after
+        self._discovery_misses: Dict[str, int] = {}
         self.target_base_urls = list(target_base_urls)
         self.poll_interval = poll_interval
         self.request_timeout = request_timeout
@@ -77,14 +87,36 @@ class Watchman:
 
     async def refresh(self) -> List[EndpointStatus]:
         targets = await self._current_targets()
-        machines = list(self.machines)
         if self.discover:
-            for name in await discover_machines(
+            discovered, n_responding = await discover_machines_ex(
                 self.project, targets, timeout=self.request_timeout
-            ):
-                if name not in machines:
-                    machines.append(name)
+            )
+            for name in discovered:
+                if name not in self.machines:
                     self.machines.append(name)
+            if n_responding == len(targets) and targets:
+                # EVERY target's index responded and omitted these machines;
+                # count a miss.  A partial or total outage counts nothing —
+                # a machine hosted only on the one server that is down must
+                # surface as unhealthy, not silently evict because the
+                # other servers' indexes (which never listed it) answered.
+                present = set(discovered)
+                for name in list(self.machines):
+                    if name in self._configured or name in present:
+                        self._discovery_misses.pop(name, None)
+                        continue
+                    misses = self._discovery_misses.get(name, 0) + 1
+                    if misses >= self.evict_after:
+                        logger.info(
+                            "Evicting machine %r: absent from every "
+                            "responding index for %d polls", name, misses,
+                        )
+                        self.machines.remove(name)
+                        self.statuses.pop(name, None)
+                        self._discovery_misses.pop(name, None)
+                    else:
+                        self._discovery_misses[name] = misses
+        machines = list(self.machines)
         statuses = await poll_endpoints(
             self.project,
             machines,

@@ -162,3 +162,33 @@ def test_unthresholded_require_thresholds_goes_to_fallback(models):
     assert scorer.n_stacked == 0 and "nothresh" in scorer.fallbacks
     out = scorer.score_all({"nothresh": X[:10]})
     assert "error" in out["nothresh"]  # per-machine error, not an exception
+
+
+def test_repeated_calls_with_fresh_data_stay_exact(models):
+    """Round-4 perf fix regression guard: the reused pinned stacking buffer
+    and host-cached thresholds must not leak one call's data into the next
+    — every call matches the per-machine scorer bit-for-bit."""
+    scorer = FleetScorer.from_models(models[0])
+    rng = np.random.default_rng(11)
+    names = sorted(models[0])
+    for call in range(3):
+        X_by = {
+            name: rng.standard_normal((32 + call, 3)).astype(np.float32)
+            for name in names
+        }
+        bulk = scorer.score_all(X_by)
+        for name in names:
+            single = CompiledScorer(models[0][name]).anomaly_arrays(X_by[name])
+            np.testing.assert_allclose(
+                bulk[name]["total-anomaly-score"],
+                single["total-anomaly-score"],
+                rtol=1e-5, atol=1e-6, err_msg=f"call {call}, {name}",
+            )
+            # thresholds come from the host cache and are caller-owned copies
+            thr = bulk[name]["tag-anomaly-thresholds"]
+            assert isinstance(thr, np.ndarray)
+            thr[:] = -1.0  # mutating a response must not poison the cache
+    fresh = scorer.score_all(
+        {names[0]: rng.standard_normal((32, 3)).astype(np.float32)}
+    )
+    assert (fresh[names[0]]["tag-anomaly-thresholds"] >= 0).all()

@@ -284,3 +284,39 @@ def test_iroc_bundles_fetched_once_per_asset(fs):
             pd.Timestamp("2017-03-10", tz="UTC"),
         )
     assert len(fs.opened) == 1  # one bundle file, downloaded exactly once
+
+
+def test_stray_files_in_tag_dir_are_never_parsed(fs, tmp_path):
+    """VERDICT r3 weak #6: a README/checksum dropped into a tag dir must be
+    ignored, not parsed as sensor data via an ls() fallback."""
+    import shutil
+
+    root = tmp_path / "lake"
+    tag_dir = root / "asset-a" / "TAG-1"
+    tag_dir.mkdir(parents=True)
+    shutil.copy(
+        os.path.join(LAKE, "asset-a", "TAG-1", "TAG-1_2017.csv"),
+        tag_dir / "TAG-1_2017.csv",
+    )
+    (tag_dir / "README.md").write_text("# not sensor data\n")
+    (tag_dir / "TAG-1_2017.csv.sha256").write_text("deadbeef\n")
+    rec = RecordingFS(str(root))
+    reader = NcsReader(rec, "")
+    series = reader.read_tag(
+        TAG1,
+        pd.Timestamp("2017-01-01", tz="UTC"),
+        pd.Timestamp("2018-01-01", tz="UTC"),
+    )
+    assert len(series) > 0
+    assert all("README" not in p and "sha256" not in p for p in rec.opened)
+
+    # a tag dir holding ONLY strays = missing tag, not parsed garbage
+    tag2_dir = root / "asset-a" / "TAG-2"
+    tag2_dir.mkdir()
+    (tag2_dir / "README.md").write_text("# stray\n")
+    with pytest.raises(FileNotFoundError, match="TAG-2"):
+        reader.read_tag(
+            TAG2,
+            pd.Timestamp("2017-01-01", tz="UTC"),
+            pd.Timestamp("2018-01-01", tz="UTC"),
+        )

@@ -236,3 +236,60 @@ def test_collection_rescan_reloads_rebuilt_and_drops_removed(model_dir, tmp_path
     assert changes["removed"] == ["wm-machine"]
     assert collection.get("wm-machine") is None
     assert collection.get("wm-survivor") is not None
+
+
+def test_watchman_evicts_machines_gone_from_every_index(model_dir, tmp_path):
+    """VERDICT r3 missing #6: a machine REMOVED from the project must stop
+    being polled/reported after ``evict_after`` responding polls — but a
+    cycle where no index was reachable must not count toward eviction, and
+    statically configured machines are never evicted."""
+    import shutil
+
+    live_dir = str(tmp_path / "evict")
+    shutil.copytree(model_dir, live_dir)
+    _build_extra_machine(live_dir, "wm-doomed")
+
+    async def main():
+        collection = ModelCollection.from_directory(live_dir, project="wmproj")
+        runner = web.AppRunner(build_app(collection))
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = runner.addresses[0][1]
+        url = f"http://127.0.0.1:{port}"
+
+        watchman = Watchman(
+            "wmproj",
+            machines=["wm-machine"],  # static: survives everything
+            target_base_urls=[url],
+            poll_interval=3600,
+            evict_after=2,
+        )
+        try:
+            await watchman.refresh()
+            assert sorted(watchman.machines) == ["wm-doomed", "wm-machine"]
+
+            # the machine's artifact is deleted and the server rescans
+            shutil.rmtree(f"{live_dir}/wm-doomed")
+            collection.rescan()
+
+            # an unreachable cycle: no index responded -> no miss counted
+            watchman.target_base_urls = ["http://127.0.0.1:1"]
+            await watchman.refresh()
+            assert "wm-doomed" in watchman.machines
+
+            watchman.target_base_urls = [url]
+            await watchman.refresh()  # miss 1
+            assert "wm-doomed" in watchman.machines
+            await watchman.refresh()  # miss 2 -> evicted
+            assert "wm-doomed" not in watchman.machines
+            assert "wm-doomed" not in watchman.statuses
+            assert "wm-machine" in watchman.machines  # static survives
+            body = watchman.to_json()
+            assert all(
+                e["target-name"] != "wm-doomed" for e in body["endpoints"]
+            )
+        finally:
+            await runner.cleanup()
+
+    asyncio.run(main())
