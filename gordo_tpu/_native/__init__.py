@@ -1,0 +1,11 @@
+"""Native (C) runtime pieces, loaded via ctypes.
+
+The reference is pure Python (SURVEY.md §3: "Native-code inventory:
+EMPTY"); this package exists because at TPU serving rates the HTTP JSON
+codec — not the model — bounds throughput.  Components compile on first
+use with the in-image ``cc`` and cache next to the source; every consumer
+has a pure-Python fallback, so a missing/broken toolchain degrades to the
+stdlib path instead of failing.
+"""
+
+from gordo_tpu._native.build import load_fastjson  # noqa: F401

@@ -1,0 +1,80 @@
+"""Compile-on-first-use loader for the C pieces (no pybind11 in-image;
+ctypes over a plain shared object keeps the toolchain requirement to
+``cc`` alone)."""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+import tempfile
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_fastjson: Optional[ctypes.CDLL] = None
+_fastjson_failed = False
+
+
+def _shared_object_path(source: str, tag: str) -> str:
+    """Cache path keyed by source hash — editing the .c file rebuilds."""
+    digest = hashlib.sha256(source.encode()).hexdigest()[:16]
+    return os.path.join(_HERE, f"{tag}-{digest}.so")
+
+
+def _compile(src_path: str, out_path: str) -> None:
+    """cc -O2 -shared -fPIC, atomically installed (parallel importers race)."""
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
+    os.close(fd)
+    try:
+        subprocess.run(
+            ["cc", "-O2", "-shared", "-fPIC", src_path, "-o", tmp, "-lm"],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        os.replace(tmp, out_path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def load_fastjson() -> Optional[ctypes.CDLL]:
+    """The fastjson library, or None when native is unavailable (the codec
+    then falls back to stdlib json — slower, same output contract)."""
+    global _fastjson, _fastjson_failed
+    if _fastjson is not None or _fastjson_failed:
+        return _fastjson
+    src_path = os.path.join(_HERE, "fastjson.c")
+    try:
+        with open(src_path) as f:
+            source = f.read()
+        so_path = _shared_object_path(source, "fastjson")
+        if not os.path.exists(so_path):
+            _compile(src_path, so_path)
+            for stale in os.listdir(_HERE):  # drop superseded builds
+                if (
+                    stale.startswith("fastjson-")
+                    and stale.endswith(".so")
+                    and os.path.join(_HERE, stale) != so_path
+                ):
+                    try:
+                        os.unlink(os.path.join(_HERE, stale))
+                    except OSError:
+                        pass
+        lib = ctypes.CDLL(so_path)
+        for name, arg0 in (
+            ("fj_encode_f32", ctypes.POINTER(ctypes.c_float)),
+            ("fj_encode_f64", ctypes.POINTER(ctypes.c_double)),
+        ):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_long
+            fn.argtypes = [arg0, ctypes.c_long, ctypes.c_long, ctypes.c_char_p]
+        _fastjson = lib
+    except Exception:
+        logger.exception("fastjson native build failed; stdlib json fallback")
+        _fastjson_failed = True
+    return _fastjson

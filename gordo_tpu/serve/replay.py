@@ -1,0 +1,171 @@
+"""Replayed-stream HTTP serving benchmark (BASELINE measurement config 5).
+
+Reference equivalent: none shipped — SURVEY.md §7 prescribes "server under
+replayed sensor stream" as the serving measurement.  Here: a real aiohttp
+server on a TCP port, a client replaying a multi-machine sensor stream
+against it, end-to-end sensor-samples/s out the far side — request
+parsing, executor handoff, device dispatch, and the response codec all
+included (the in-process scorer numbers in ``bench.py`` deliberately
+exclude those, which is why both are reported).
+
+Request bodies are pre-serialized outside the timed loop: the subject
+under test is the server, not the load generator.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import aiohttp
+import numpy as np
+from aiohttp import web
+
+from gordo_tpu.serve import codec
+from gordo_tpu.serve.server import API_PREFIX, ModelCollection, build_app
+
+
+def _make_stream(
+    collection: ModelCollection,
+    names: Sequence[str],
+    rows: int,
+    n_rounds: int,
+    seed: int = 0,
+) -> Dict[str, List[np.ndarray]]:
+    """Per-machine, per-round synthetic sensor chunks (distinct per round —
+    a replay of identical bytes would let caches lie)."""
+    rng = np.random.default_rng(seed)
+    return {
+        name: [
+            rng.standard_normal(
+                (rows, len(collection.get(name).tags))
+            ).astype(np.float32)
+            for _ in range(n_rounds)
+        ]
+        for name in names
+    }
+
+
+async def _replay(
+    collection: ModelCollection,
+    mode: str,
+    wire: str,
+    n_rounds: int,
+    rows: int,
+    parallelism: int,
+    machines: Optional[Sequence[str]],
+    timeout_s: float,
+) -> Dict[str, Any]:
+    runner = web.AppRunner(build_app(collection))
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = runner.addresses[0][1]
+    base = f"http://127.0.0.1:{port}{API_PREFIX}/{collection.project}"
+    names = list(machines) if machines else sorted(collection.entries)
+    # n_rounds + 1: round 0 is warm-up only — re-timing its byte-identical
+    # bodies would hand caches a free third of the measurement
+    stream = _make_stream(collection, names, rows, n_rounds + 1)
+    n_samples_round = sum(arrs[0].size for arrs in stream.values())
+
+    if wire == "msgpack":
+        content_type = codec.MSGPACK_CONTENT_TYPE
+        headers = {
+            "Content-Type": content_type,
+            "Accept": content_type,
+        }
+        enc = codec.packb
+    else:
+        content_type = "application/json"
+        headers = {"Content-Type": content_type}
+        enc = lambda obj: json.dumps(  # noqa: E731
+            {
+                k: ({m: a.tolist() for m, a in v.items()}
+                    if isinstance(v, dict) else v.tolist())
+                for k, v in obj.items()
+            }
+        ).encode()
+
+    # pre-serialized request bodies, one per (round, request)
+    if mode == "bulk":
+        bodies = [
+            [(f"{base}/_bulk/anomaly/prediction",
+              enc({"X": {m: stream[m][r] for m in names}}))]
+            for r in range(n_rounds + 1)
+        ]
+    else:
+        bodies = [
+            [(f"{base}/{m}/anomaly/prediction", enc({"X": stream[m][r]}))
+             for m in names]
+            for r in range(n_rounds + 1)
+        ]
+
+    errors: List[str] = []
+    client_timeout = aiohttp.ClientTimeout(total=timeout_s)
+    async with aiohttp.ClientSession(timeout=client_timeout) as session:
+        sem = asyncio.Semaphore(parallelism)
+
+        async def post(url: str, body: bytes) -> int:
+            async with sem:
+                async with session.post(
+                    url, data=body, headers=headers
+                ) as resp:
+                    raw = await resp.read()
+                    if resp.status != 200:
+                        errors.append(
+                            f"{resp.status}: {raw[:200]!r}"
+                        )
+                    return len(raw)
+
+        # warm-up round: jit compiles, scorer stacking, codec caches
+        await asyncio.gather(*(post(u, b) for u, b in bodies[0]))
+        if errors:
+            raise RuntimeError(f"Replay warm-up failed: {errors[:3]}")
+
+        t0 = time.perf_counter()
+        response_bytes = 0
+        for round_bodies in bodies[1:]:
+            sizes = await asyncio.gather(
+                *(post(u, b) for u, b in round_bodies)
+            )
+            response_bytes += sum(sizes)
+        dt = time.perf_counter() - t0
+    await runner.cleanup()
+    if errors:
+        raise RuntimeError(f"Replay had {len(errors)} errors: {errors[:3]}")
+    return {
+        "mode": mode,
+        "wire": wire,
+        "n_machines": len(names),
+        "rows_per_request": rows,
+        "n_rounds": n_rounds,
+        "seconds": dt,
+        "samples_per_sec": n_rounds * n_samples_round / dt,
+        "response_mb_per_sec": response_bytes / dt / 1e6,
+    }
+
+
+def replay_bench(
+    collection: ModelCollection,
+    mode: str = "bulk",
+    wire: str = "json",
+    n_rounds: int = 5,
+    rows: int = 2048,
+    parallelism: int = 8,
+    machines: Optional[Sequence[str]] = None,
+    timeout_s: float = 600.0,
+) -> Dict[str, Any]:
+    """Measure end-to-end HTTP anomaly-scoring throughput.
+
+    ``mode``: ``"bulk"`` (one ``_bulk`` request per round carrying every
+    machine's chunk) or ``"single"`` (one request per machine per round,
+    ``parallelism`` in flight).  ``wire``: ``"json"`` or ``"msgpack"``.
+    """
+    return asyncio.run(
+        _replay(
+            collection, mode, wire, n_rounds, rows, parallelism, machines,
+            timeout_s,
+        )
+    )

@@ -184,13 +184,26 @@ def test_predict_bulk_matches_per_machine(model_dir):
         normal = Client("cliproj", port=port, batch_size=60).predict(
             "2017-12-27T06:00:00Z", "2017-12-27T18:00:00Z"
         )
+        # default bulk wire format (msgpack) and the JSON fallback must
+        # both match the per-machine path
         bulk = Client("cliproj", port=port, batch_size=60, use_bulk=True).predict(
             "2017-12-27T06:00:00Z", "2017-12-27T18:00:00Z"
         )
-        return normal, bulk
+        bulk_json = Client(
+            "cliproj", port=port, batch_size=60, use_bulk=True,
+            use_msgpack=False,
+        ).predict("2017-12-27T06:00:00Z", "2017-12-27T18:00:00Z")
+        return normal, bulk, bulk_json
 
-    normal, bulk = _serve_and(model_dir, run)
+    normal, bulk, bulk_json = _serve_and(model_dir, run)
     assert [r.name for r in normal] == [r.name for r in bulk]
+    for a, b in zip(normal, bulk_json):
+        assert b.ok, b.error_messages
+        np.testing.assert_allclose(
+            a.predictions[("total-anomaly-score", "")].to_numpy(),
+            b.predictions[("total-anomaly-score", "")].to_numpy(),
+            rtol=1e-4, atol=1e-5,
+        )
     for a, b in zip(normal, bulk):
         assert b.ok, b.error_messages
         assert len(a.predictions) == len(b.predictions)

@@ -1,0 +1,125 @@
+"""Serving codec tests: the native fastjson kernel and the msgpack wire
+format must reproduce the stdlib-JSON response contract exactly (same
+schema, value-identical floats after parsing)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from gordo_tpu.serve import codec
+
+
+def test_native_fastjson_is_available():
+    """cc is in the image, so the native path must actually build — a
+    silent fallback to stdlib json would quietly lose the serving rate."""
+    from gordo_tpu._native import load_fastjson
+
+    assert load_fastjson() is not None
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+def test_array_roundtrip_exact(dtype):
+    rng = np.random.default_rng(0)
+    a = (
+        rng.standard_normal((200, 7))
+        * np.power(10.0, rng.integers(-30, 30, (200, 7)))
+    ).astype(dtype)
+    dec = np.asarray(json.loads(codec.dumps_bytes(a)), dtype)
+    assert np.array_equal(dec, a)
+
+
+def test_float32_edge_values_roundtrip():
+    edge = np.array(
+        [
+            0.0, -0.0, 1.0, -1.0, 0.1, 1e-45, -1e-45,  # subnormal min
+            3.4028235e38, -3.4028235e38,               # max finite
+            1.1754944e-38,                             # min normal
+            123456789.0, 1e9, 9.999999e8, 99999999.5,
+            1e-4, 1e-5, 2.0 ** -126,
+        ],
+        np.float32,
+    )
+    dec = np.asarray(json.loads(codec.dumps_bytes(edge)), np.float32)
+    assert np.array_equal(dec, edge)
+    # negative-zero sign survives
+    assert np.signbit(dec[1])
+
+
+def test_random_bit_patterns_roundtrip():
+    rng = np.random.default_rng(7)
+    bits = rng.integers(0, 2 ** 32, 200_000, dtype=np.uint64).astype(np.uint32)
+    a = bits.view(np.float32)
+    a = a[np.isfinite(a)]
+    dec = np.asarray(json.loads(codec.dumps_bytes(a)), np.float32)
+    assert np.array_equal(dec, a)
+
+
+def test_special_values_match_stdlib_text():
+    s = codec.dumps_bytes(np.array([np.nan, np.inf, -np.inf], np.float32))
+    assert s == b"[NaN,Infinity,-Infinity]"
+    assert s == json.dumps([np.nan, np.inf, -np.inf]).replace(" ", "").encode()
+
+
+def test_nested_response_shape():
+    rng = np.random.default_rng(1)
+    obj = {
+        "data": {
+            "model-output": rng.standard_normal((5, 3)).astype(np.float32),
+            "total-anomaly-threshold": 1.25,
+            "start": ["2020-01-01T00:00:00+00:00"],
+            "errors": None,
+            "n": np.int64(7),
+        },
+        "time-seconds": 0.125,
+    }
+    dec = json.loads(codec.dumps_bytes(obj))
+    assert dec["data"]["total-anomaly-threshold"] == 1.25
+    assert dec["data"]["start"] == ["2020-01-01T00:00:00+00:00"]
+    assert dec["data"]["errors"] is None
+    assert dec["data"]["n"] == 7
+    assert len(dec["data"]["model-output"]) == 5
+
+
+def test_empty_and_1d_arrays():
+    assert json.loads(codec.dumps_bytes(np.zeros(0, np.float32))) == []
+    assert json.loads(codec.dumps_bytes(np.zeros((0, 4), np.float32))) == []
+    assert json.loads(codec.dumps_bytes(np.zeros((3, 0), np.float32))) == [
+        [], [], [],
+    ]
+    assert json.loads(
+        codec.dumps_bytes(np.arange(3, dtype=np.float32))
+    ) == [0.0, 1.0, 2.0]
+
+
+def test_non_contiguous_and_int_arrays():
+    a = np.arange(24, dtype=np.float32).reshape(4, 6)[:, ::2]
+    assert json.loads(codec.dumps_bytes(a)) == a.tolist()
+    ints = np.arange(5)  # int64: stdlib fallback path
+    assert json.loads(codec.dumps_bytes(ints)) == [0, 1, 2, 3, 4]
+
+
+def test_msgpack_roundtrip():
+    rng = np.random.default_rng(2)
+    obj = {
+        "data": {
+            "m-1": {
+                "model-output": rng.standard_normal((10, 3)).astype(np.float32),
+                "total-anomaly-score": rng.standard_normal(10),
+                "total-anomaly-threshold": 0.5,
+            },
+            "m-2": {"error": "boom"},
+        },
+        "time-seconds": 0.5,
+    }
+    dec = codec.unpackb(codec.packb(obj))
+    assert np.array_equal(
+        dec["data"]["m-1"]["model-output"], obj["data"]["m-1"]["model-output"]
+    )
+    assert dec["data"]["m-1"]["model-output"].dtype == np.float32
+    assert np.array_equal(
+        dec["data"]["m-1"]["total-anomaly-score"],
+        obj["data"]["m-1"]["total-anomaly-score"],
+    )
+    assert dec["data"]["m-2"] == {"error": "boom"}
+    assert dec["time-seconds"] == 0.5

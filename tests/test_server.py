@@ -424,3 +424,69 @@ def test_rescan_reloads_equal_or_older_mtime(model_dir, tmp_path):
     changes = collection.rescan()
     assert changes["reloaded"] == [name]
     assert collection.get(name).model is not old_model
+
+
+def test_msgpack_content_negotiation(model_dir):
+    """Bulk fast path: a msgpack request body with Accept: x-msgpack gets a
+    msgpack response whose arrays match the JSON route's values."""
+    import numpy as np
+
+    from gordo_tpu.serve import codec
+
+    X = np.asarray(X_ROWS, np.float32)
+
+    async def fn(client):
+        json_resp = await client.post(
+            "/gordo/v0/testproj/machine-a/anomaly/prediction",
+            json={"X": X.tolist()},
+        )
+        json_body = await json_resp.json()
+        mp_resp = await client.post(
+            "/gordo/v0/testproj/_bulk/anomaly/prediction",
+            data=codec.packb({"X": {"machine-a": X}}),
+            headers={
+                "Content-Type": codec.MSGPACK_CONTENT_TYPE,
+                "Accept": codec.MSGPACK_CONTENT_TYPE,
+            },
+        )
+        assert mp_resp.status == 200, await mp_resp.text()
+        assert mp_resp.content_type == codec.MSGPACK_CONTENT_TYPE
+        mp_body = codec.unpackb(await mp_resp.read())
+        return json_body, mp_body
+
+    json_body, mp_body = _call(model_dir, fn)
+    mp = mp_body["data"]["machine-a"]
+    assert isinstance(mp["model-output"], np.ndarray)
+    np.testing.assert_allclose(
+        mp["total-anomaly-score"],
+        np.asarray(json_body["data"]["total-anomaly-score"]),
+        rtol=1e-6, atol=1e-7,
+    )
+    # single-machine route also negotiates msgpack responses
+    async def fn2(client):
+        resp = await client.post(
+            "/gordo/v0/testproj/machine-a/anomaly/prediction",
+            json={"X": X.tolist()},
+            headers={"Accept": codec.MSGPACK_CONTENT_TYPE},
+        )
+        assert resp.content_type == codec.MSGPACK_CONTENT_TYPE
+        return codec.unpackb(await resp.read())
+
+    single = _call(model_dir, fn2)
+    assert isinstance(single["data"]["model-output"], np.ndarray)
+
+
+def test_replay_bench_smoke(model_dir):
+    """The replayed-stream HTTP benchmark harness drives a real server and
+    reports coherent numbers for every mode/wire combination."""
+    from gordo_tpu.serve.replay import replay_bench
+
+    collection = ModelCollection.from_directory(model_dir, project="testproj")
+    for mode in ("single", "bulk"):
+        for wire in ("json", "msgpack"):
+            out = replay_bench(
+                collection, mode=mode, wire=wire, n_rounds=2, rows=64,
+                parallelism=4,
+            )
+            assert out["samples_per_sec"] > 0, out
+            assert out["n_machines"] == 2
