@@ -13,15 +13,22 @@ gets its own artifact directory, metadata JSON, and config-hash cache entry
 already-built machines, and a machine whose config the fleet engine can't
 express falls back to the single-machine builder transparently.
 
-Data loading stays host-side and overlaps across machines via a thread
-pool (the reference's per-pod I/O becomes concurrent per-tag reads feeding
-one process).
+Data loading stays host-side, streaming, and memory-bounded: machines are
+bucketed by CONFIG alone (model signature + tag widths — no data needed),
+then built chunk by chunk with the loader pool prefetching exactly ONE
+chunk ahead while the device trains the current one.  Peak host memory is
+two chunks of arrays (2 x ``max_bucket_size`` machines), not the whole
+project — the reference held one machine per pod; a 10k-machine
+load-everything pass here would be tens of GB.  Arrays free as soon as a
+machine's artifact is dumped; ``ProjectBuildResult.peak_loaded`` records
+the high-water mark so tests can hold the bound.
 """
 
 from __future__ import annotations
 
 import logging
 import os
+import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
@@ -57,6 +64,9 @@ class ProjectBuildResult:
         self.single_built: List[str] = []
         self.failed: Dict[str, str] = {}
         self.seconds: float = 0.0
+        #: high-water mark of machines whose (X, y) arrays were resident at
+        #: once — the streaming pipeline bounds this at two chunks
+        self.peak_loaded: int = 0
 
     def summary(self) -> Dict[str, Any]:
         return {
@@ -66,13 +76,42 @@ class ProjectBuildResult:
             "single_built": len(self.single_built),
             "failed": dict(self.failed),
             "build_seconds": self.seconds,
+            "peak_loaded_machines": self.peak_loaded,
         }
+
+
+class _LoadTracker:
+    """Counts machines with live arrays; records the high-water mark."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.current = 0
+        self.peak = 0
+
+    def acquire(self) -> None:
+        with self._lock:
+            self.current += 1
+            self.peak = max(self.peak, self.current)
+
+    def release(self, n: int = 1) -> None:
+        with self._lock:
+            self.current -= n
 
 
 def _as_machine(m: Union[Machine, Dict[str, Any]]) -> Machine:
     if isinstance(m, Machine):
         return m
     return Machine.from_config(m)
+
+
+def _config_widths(dataset_cfg: Dict[str, Any]) -> Optional[Tuple[int, int]]:
+    """(n_features, n_outputs) derivable from the dataset CONFIG alone, or
+    None — the streaming pipeline buckets machines before any data loads."""
+    tags = dataset_cfg.get("tag_list") or dataset_cfg.get("tags")
+    if not tags:
+        return None
+    targets = dataset_cfg.get("target_tag_list") or tags
+    return len(tags), len(targets)
 
 
 def build_project(
@@ -86,12 +125,17 @@ def build_project(
 ) -> ProjectBuildResult:
     """Build every machine; fleet-bucket the homogeneous ones.
 
+    Streaming and memory-bounded: at most TWO chunks of machines
+    (2 x ``max_bucket_size``) have arrays resident — the one training on
+    device and the one the loader pool is prefetching behind it.
+
     Returns a :class:`ProjectBuildResult` with one artifact dir per machine
     (identical layout to ``provide_saved_model``).
     """
     t_start = time.time()
     machines = [_as_machine(m) for m in machines]
     result = ProjectBuildResult()
+    tracker = _LoadTracker()
 
     # 1. Config-hash cache check (reference: provide_saved_model).
     to_build: List[Machine] = []
@@ -108,82 +152,136 @@ def build_project(
                 continue
         to_build.append(m)
 
-    # 2. Load data concurrently (host-side, I/O-bound).
-    def _load(m: Machine):
-        t0 = time.time()
-        dataset = GordoBaseDataset.from_dict(dict(m.dataset))
-        X, y = dataset.get_data()
-        return (
-            np.asarray(X, np.float32),
-            np.asarray(y, np.float32),
-            dataset.get_metadata(),
-            time.time() - t0,
-        )
-
-    loaded: Dict[str, Tuple] = {}
-    if to_build:
-        with ThreadPoolExecutor(max_workers=data_workers) as pool:
-            futures = {m.name: pool.submit(_load, m) for m in to_build}
-        for m in to_build:
-            try:
-                loaded[m.name] = futures[m.name].result()
-            except Exception as exc:  # data failures shouldn't sink the fleet
-                logger.exception("Data load failed for %s", m.name)
-                result.failed[m.name] = f"data: {exc}"
-    to_build = [m for m in to_build if m.name in loaded]
-
-    # 3. Bucket by (fleet signature, feature shapes); misfits go single.
+    # 2. Bucket by (fleet signature, config tag widths); misfits go single.
+    #    Config-only — no machine's data has loaded yet.
     buckets: Dict[Tuple, List[Machine]] = {}
     singles: List[Machine] = []
     specs: Dict[Tuple, Any] = {}
     for m in to_build:
-        X, y, _, _ = loaded[m.name]
         cv_mode = m.evaluation.get("cv_mode", "full_build")
+        widths = _config_widths(m.dataset)
         spec = None
-        if cv_mode == "full_build":
+        if cv_mode == "full_build" and widths is not None:
             try:
                 spec = analyze_definition(serializer.from_definition(dict(m.model)))
             except Exception:
                 spec = None
         if spec is None:
+            if widths is None and cv_mode == "full_build":
+                # this machine may be paying for its config: without an
+                # explicit tag_list the stream can't bucket it pre-load,
+                # so it loses the stacked-XLA path — say so
+                logger.warning(
+                    "Machine %s has no tag_list/tags in its dataset config; "
+                    "building single (fleet bucketing needs config-derivable "
+                    "widths)", m.name,
+                )
             singles.append(m)
             continue
-        key = (spec.signature, X.shape[1], y.shape[1], str(m.evaluation.get("cv")))
+        key = (spec.signature, widths, str(m.evaluation.get("cv")))
         buckets.setdefault(key, []).append(m)
         specs[key] = spec
 
-    # 4. Fleet-build each bucket in chunks.
+    # 3. Chunk plan across all buckets, then stream: load chunk k+1 in the
+    #    pool while chunk k trains; free arrays as artifacts dump.
+    chunks: List[Tuple[Tuple, List[Machine]]] = []
     for key, bucket in buckets.items():
-        spec = specs[key]
-        cv = bucket[0].evaluation.get("cv")
         for start in range(0, len(bucket), max_bucket_size):
-            chunk = bucket[start : start + max_bucket_size]
+            chunks.append((key, bucket[start : start + max_bucket_size]))
+
+    def _load(m: Machine):
+        t0 = time.time()
+        dataset = GordoBaseDataset.from_dict(dict(m.dataset))
+        X, y = dataset.get_data()
+        entry = (
+            np.asarray(X, np.float32),
+            np.asarray(y, np.float32),
+            dataset.get_metadata(),
+            time.time() - t0,
+        )
+        tracker.acquire()  # arrays are live from here until freed
+        return entry
+
+    def _submit(pool, chunk: List[Machine]):
+        return {m.name: pool.submit(_load, m) for m in chunk}
+
+    def _collect(chunk: List[Machine], futures) -> Dict[str, Tuple]:
+        loaded: Dict[str, Tuple] = {}
+        for m in chunk:
+            try:
+                loaded[m.name] = futures[m.name].result()
+            except Exception as exc:  # data failure must not sink the fleet
+                logger.exception("Data load failed for %s", m.name)
+                result.failed[m.name] = f"data: {exc}"
+        return loaded
+
+    def _free(loaded: Dict[str, Tuple], names: Sequence[str]) -> None:
+        n = 0
+        for name in list(names):
+            if loaded.pop(name, None) is not None:
+                n += 1
+        if n:
+            tracker.release(n)
+
+    with ThreadPoolExecutor(max_workers=data_workers) as pool:
+        next_futures = _submit(pool, chunks[0][1]) if chunks else None
+        for i, (key, chunk) in enumerate(chunks):
+            loaded = _collect(chunk, next_futures)
+            # prefetch the NEXT chunk now — it loads while this one trains
+            next_futures = (
+                _submit(pool, chunks[i + 1][1]) if i + 1 < len(chunks) else None
+            )
+            spec = specs[key]
+            widths = key[1]
+            # config said these widths; data disagreeing (exotic provider)
+            # reroutes the machine through the single builder
+            ok_chunk = []
+            for m in chunk:
+                if m.name not in loaded:
+                    continue
+                X, y = loaded[m.name][0], loaded[m.name][1]
+                if (X.shape[1], y.shape[1]) != widths:
+                    logger.warning(
+                        "Machine %s loaded widths %s != config %s; "
+                        "building single", m.name, (X.shape[1], y.shape[1]),
+                        widths,
+                    )
+                    singles.append(m)
+                    _free(loaded, [m.name])
+                else:
+                    ok_chunk.append(m)
+            if not ok_chunk:
+                continue
+            cv = ok_chunk[0].evaluation.get("cv")
             t0 = time.time()
             try:
                 builder = FleetDiffBuilder(spec, cv=cv, mesh=mesh)
-                with profiling.trace(f"fleet_bucket/{len(chunk)}"):
+                with profiling.trace(f"fleet_bucket/{len(ok_chunk)}"):
                     detectors = builder.build(
-                        [loaded[m.name][0] for m in chunk],
-                        [loaded[m.name][1] for m in chunk],
+                        [loaded[m.name][0] for m in ok_chunk],
+                        [loaded[m.name][1] for m in ok_chunk],
                     )
-            except Exception as exc:
+            except Exception:
                 logger.exception("Fleet bucket failed; falling back to singles")
-                singles.extend(chunk)
+                singles.extend(ok_chunk)
+                _free(loaded, [m.name for m in ok_chunk])
                 continue
             fleet_seconds = time.time() - t0
-            for m, det in zip(chunk, detectors):
+            for m, det in zip(ok_chunk, detectors):
                 _dump_machine(
                     m,
                     det,
                     loaded[m.name],
-                    fleet_seconds / len(chunk),
+                    fleet_seconds / len(ok_chunk),
                     output_dir,
                     model_register_dir,
                     result,
                     fleet=True,
                 )
+                _free(loaded, [m.name])  # artifact on disk: arrays drop
 
-    # 5. Single-machine fallback (non-fleetable configs).
+    # 4. Single-machine fallback (non-fleetable configs) — one at a time,
+    #    each build loading and freeing its own data.
     for m in singles:
         try:
             model, metadata = build_model(
@@ -200,6 +298,7 @@ def build_project(
         result.single_built.append(m.name)
 
     result.seconds = time.time() - t_start
+    result.peak_loaded = tracker.peak
     return result
 
 
