@@ -5,7 +5,7 @@ PYTHON ?= python
 IMAGE  ?= gordo-tpu
 TAG    ?= latest
 
-.PHONY: test test-fast lint bench install image docs clean
+.PHONY: test test-fast test-slow lint bench install image docs clean
 
 install:
 	$(PYTHON) -m pip install -e . --no-build-isolation --no-deps
@@ -13,8 +13,16 @@ install:
 test:
 	$(PYTHON) -m pytest tests/ -q
 
+# marker-gated fast lane (CI's per-push gate; target < 2 min)
 test-fast:
-	$(PYTHON) -m pytest tests/ -q -x -k "not fleet_build and not client and not watchman"
+	$(PYTHON) -m pytest tests/ -q -m "not slow"
+
+test-slow:
+	$(PYTHON) -m pytest tests/ -q -m slow
+
+# stdlib AST linter (no flake8 in this image; CI also runs flake8)
+lint:
+	$(PYTHON) scripts/lint.py
 
 bench:
 	$(PYTHON) bench.py

@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import ctypes
 import json
-from typing import Any, Callable, List, Optional
+from typing import Any, List, Optional
 
 import numpy as np
 

@@ -21,7 +21,7 @@ that don't match run through their own (slower, host-side) ``.anomaly`` /
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp

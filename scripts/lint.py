@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Self-contained stdlib linter — the ``make lint`` backend.
+
+This image ships no flake8/ruff/pyflakes and has no network, so the local
+lint gate is built on ``ast``: syntax errors, unused imports, wildcard
+imports, duplicate function/class definitions in a scope, mutable default
+arguments, and ``except:`` bare clauses.  CI additionally runs flake8
+(installable on GitHub runners — see .github/workflows/ci.yml); this
+script is the everywhere-runnable subset.
+
+Usage: python scripts/lint.py PATH [PATH ...]   (exit 1 on findings)
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Iterator, List, Tuple
+
+Finding = Tuple[str, int, str]
+
+
+def iter_py_files(paths: List[str]) -> Iterator[str]:
+    for path in paths:
+        if os.path.isfile(path) and path.endswith(".py"):
+            yield path
+        elif os.path.isdir(path):
+            for root, dirs, files in os.walk(path):
+                dirs[:] = [
+                    d for d in dirs
+                    if d not in ("__pycache__", ".git", ".pytest_cache")
+                ]
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+
+
+class _ImportTracker(ast.NodeVisitor):
+    """Collect imported names and every name usage in a module."""
+
+    def __init__(self):
+        self.imports: List[Tuple[str, int]] = []  # (bound name, lineno)
+        self.used: set = set()
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            name = alias.asname or alias.name.split(".")[0]
+            self.imports.append((name, node.lineno))
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module == "__future__":
+            return  # compiler directives, used by definition
+        for alias in node.names:
+            if alias.name == "*":
+                continue  # flagged separately
+            name = alias.asname or alias.name
+            self.imports.append((name, node.lineno))
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if isinstance(node.ctx, ast.Load):
+            self.used.add(node.id)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        self.generic_visit(node)
+
+
+def lint_file(path: str) -> List[Finding]:
+    findings: List[Finding] = []
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as exc:
+        return [(path, exc.lineno or 0, f"syntax error: {exc.msg}")]
+
+    # module docstring-level "# noqa" opt-outs per line
+    noqa_lines = {
+        i + 1
+        for i, line in enumerate(source.splitlines())
+        if "# noqa" in line
+    }
+
+    tracker = _ImportTracker()
+    tracker.visit(tree)
+    # names echoed in __all__ or re-exported via strings count as used
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            tracker.used.add(node.value)
+    is_package_init = os.path.basename(path) == "__init__.py"
+    if not is_package_init:  # __init__ re-export surface is exempt
+        for name, lineno in tracker.imports:
+            if name not in tracker.used and lineno not in noqa_lines:
+                findings.append((path, lineno, f"unused import: {name}"))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and any(
+            a.name == "*" for a in node.names
+        ):
+            findings.append((path, node.lineno, "wildcard import"))
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            if node.lineno not in noqa_lines:
+                findings.append((path, node.lineno, "bare except:"))
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for default in node.args.defaults + node.args.kw_defaults:
+                if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                    findings.append(
+                        (path, node.lineno,
+                         f"mutable default argument in {node.name}()")
+                    )
+        if isinstance(node, (ast.Module, ast.ClassDef)):
+            seen = {}
+            body = node.body
+            for child in body:
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+                ):
+                    if child.name in seen and not any(
+                        isinstance(d, ast.Name)
+                        and d.id in ("property", "overload")
+                        or isinstance(d, ast.Attribute)
+                        for d in child.decorator_list
+                    ):
+                        findings.append(
+                            (path, child.lineno,
+                             f"duplicate definition of {child.name} "
+                             f"(first at line {seen[child.name]})")
+                        )
+                    seen.setdefault(child.name, child.lineno)
+    return findings
+
+
+def main(argv: List[str]) -> int:
+    paths = argv or ["gordo_tpu", "tests", "bench.py", "__graft_entry__.py"]
+    all_findings: List[Finding] = []
+    n_files = 0
+    for path in iter_py_files(paths):
+        n_files += 1
+        all_findings.extend(lint_file(path))
+    for path, lineno, msg in all_findings:
+        print(f"{path}:{lineno}: {msg}")
+    print(
+        f"lint: {n_files} files, {len(all_findings)} finding(s)",
+        file=sys.stderr,
+    )
+    return 1 if all_findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

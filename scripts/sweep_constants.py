@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""Hardware sweeps for the device-side tuning constants (docs/perf.md).
+
+Three independent sweeps, one per constant, each sized to finish well
+inside a 10-minute window (TPU-tunnel processes must not be timeout-killed
+— a killed client can wedge the relay):
+
+- ``minbucket``: fused-scorer latency vs padded row-bucket size
+  (→ ``serve/scorer.py::MIN_BUCKET``)
+- ``bucket``: fleet-build rate vs ``max_bucket_size``
+  (→ ``builder/fleet_build.py::DEFAULT_MAX_BUCKET``)
+- ``smooth``: stacked smoothing-window scoring vs the windows-tensor size
+  (→ ``serve/fleet_scorer.py::SMOOTH_ELEMENT_BOUND``)
+
+Usage: python scripts/sweep_constants.py {minbucket|bucket|smooth}
+"""
+
+from __future__ import annotations
+
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+
+def build_one(n_tags: int = 10, window: int = 0):
+    from gordo_tpu.builder.build_model import build_model
+    from gordo_tpu.workflow.config import Machine
+
+    mc = {
+        "gordo_tpu.anomaly.diff.DiffBasedAnomalyDetector": {
+            **({"window": window} if window else {}),
+            "base_estimator": {
+                "gordo_tpu.pipeline.Pipeline": {
+                    "steps": [
+                        "gordo_tpu.ops.scalers.MinMaxScaler",
+                        {
+                            "gordo_tpu.models.estimator.AutoEncoder": {
+                                "kind": "feedforward_hourglass",
+                                "epochs": 10,
+                                "batch_size": 64,
+                            }
+                        },
+                    ]
+                }
+            },
+        }
+    }
+    m = Machine.from_config(
+        {
+            "name": "sweep-m",
+            "dataset": {
+                "type": "RandomDataset",
+                "tag_list": [f"t-{j}" for j in range(n_tags)],
+            },
+            "model": mc,
+        }
+    )
+    model, _ = build_model(m.name, m.model, m.dataset, {}, m.evaluation)
+    return model
+
+
+def sweep_minbucket() -> None:
+    """Latency vs padded bucket rows: if flat up to 256+, MIN_BUCKET can
+    rise to cut jit-cache entries; if it climbs, small buckets pay off."""
+    from gordo_tpu.serve.scorer import CompiledScorer
+
+    sc = CompiledScorer(build_one())
+    rng = np.random.default_rng(0)
+    for rows in (32, 64, 128, 256, 512, 1024, 2048):
+        X = rng.standard_normal((rows, 10)).astype(np.float32)
+        sc.anomaly_arrays(X)  # compile this bucket
+        t0 = time.perf_counter()
+        for _ in range(30):
+            sc.anomaly_arrays(X)
+        dt = (time.perf_counter() - t0) / 30
+        print(
+            f"rows={rows:5d}: {dt * 1000:6.2f} ms/call "
+            f"({rows * 10 / dt / 1e3:,.0f}k samples/s)",
+            flush=True,
+        )
+
+
+def sweep_bucket(n_machines: int = 512) -> None:
+    from gordo_tpu.builder.fleet_build import build_project
+    from gordo_tpu.workflow.config import Machine
+
+    machines = [
+        Machine.from_config(
+            {
+                "name": f"swp-{i:04d}",
+                "dataset": {
+                    "type": "RandomDataset",
+                    "tag_list": [f"t-{i}-{j}" for j in range(10)],
+                },
+            }
+        )
+        for i in range(n_machines)
+    ]
+    for bucket in (128, 256, 512):
+        rates = []
+        for _run in range(2):
+            out = tempfile.mkdtemp()
+            t0 = time.perf_counter()
+            res = build_project(machines, out, max_bucket_size=bucket)
+            dt = time.perf_counter() - t0
+            shutil.rmtree(out, ignore_errors=True)
+            assert not res.failed, list(res.failed.items())[:2]
+            rates.append(len(res.artifacts) / dt * 3600)
+        print(
+            f"max_bucket={bucket:5d}: warm {rates[-1]:,.0f} models/h "
+            f"(cold {rates[0]:,.0f})",
+            flush=True,
+        )
+
+
+def sweep_smooth() -> None:
+    """Probe the smoothing windows-tensor guard: disable it and drive
+    stacked scoring at sizes spanning the current 2^27-element bound."""
+    import gordo_tpu.serve.fleet_scorer as fs_mod
+    from gordo_tpu.serve.fleet_scorer import FleetScorer
+
+    model = build_one(window=144)
+    rng = np.random.default_rng(0)
+    fs_mod.SMOOTH_ELEMENT_BOUND = 2 ** 40  # hardware probe: guard off
+    for m_count, rows in ((32, 2048), (64, 2048), (64, 4096)):
+        elems = m_count * rows * 144 * 10
+        fleet = FleetScorer.from_models(
+            {f"m-{i}": model for i in range(m_count)}
+        )
+        X_by = {
+            f"m-{i}": rng.standard_normal((rows, 10)).astype(np.float32)
+            for i in range(m_count)
+        }
+        try:
+            fleet.score_all(X_by)  # compile
+            t0 = time.perf_counter()
+            for _ in range(3):
+                fleet.score_all(X_by)
+            dt = (time.perf_counter() - t0) / 3
+            print(
+                f"M={m_count} rows={rows} window=144 "
+                f"elems=2^{np.log2(elems):.1f}: OK {dt * 1000:,.0f} ms/call "
+                f"({m_count * rows * 10 / dt / 1e6:.2f}M samples/s)",
+                flush=True,
+            )
+        except Exception as exc:
+            print(
+                f"M={m_count} rows={rows} elems=2^{np.log2(elems):.1f}: "
+                f"FAILED {type(exc).__name__}: {str(exc)[:160]}",
+                flush=True,
+            )
+
+
+if __name__ == "__main__":
+    sweeps = {
+        "minbucket": sweep_minbucket,
+        "bucket": sweep_bucket,
+        "smooth": sweep_smooth,
+    }
+    which = sys.argv[1] if len(sys.argv) > 1 else ""
+    if which not in sweeps:
+        print(f"usage: {sys.argv[0]} {{{'|'.join(sweeps)}}}", file=sys.stderr)
+        sys.exit(2)
+    sweeps[which]()

@@ -12,6 +12,9 @@ from gordo_tpu.pipeline import Pipeline
 from gordo_tpu.serializer import from_definition, into_definition
 from gordo_tpu.train.cv import KFold, TimeSeriesSplit, build_splitter, cross_validate
 
+# heavy integration module: excluded from the fast CI lane
+pytestmark = pytest.mark.slow
+
 
 # -- splitters ----------------------------------------------------------------
 def test_timeseries_split_expanding():

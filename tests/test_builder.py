@@ -10,6 +10,9 @@ from gordo_tpu import serializer
 from gordo_tpu.builder import build_model, calculate_model_key, provide_saved_model
 from gordo_tpu.utils import disk_registry
 
+# heavy integration module: excluded from the fast CI lane
+pytestmark = pytest.mark.slow
+
 DATA_CONFIG = {
     "type": "RandomDataset",
     "train_start_date": "2020-01-01T00:00:00Z",

@@ -9,6 +9,9 @@ from gordo_tpu.registry import lookup_factory
 from gordo_tpu.train.checkpoint import fit_checkpointed, load_checkpoint
 from gordo_tpu.train.fit import TrainConfig, fit
 
+# heavy integration module: excluded from the fast CI lane
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture()
 def module(sine_tags):

@@ -8,6 +8,10 @@ import yaml
 from click.testing import CliRunner
 
 from gordo_tpu.cli.cli import gordo
+import pytest
+
+# heavy integration module: excluded from the fast CI lane
+pytestmark = pytest.mark.slow
 
 DATA_CONFIG = {
     "type": "RandomDataset",

@@ -18,6 +18,9 @@ from gordo_tpu.client.client import _frame_from_payload
 from gordo_tpu.serve import ModelCollection, build_app
 from gordo_tpu.workflow import NormalizedConfig
 
+# heavy integration module: excluded from the fast CI lane
+pytestmark = pytest.mark.slow
+
 PROJECT = {
     "machines": [
         {"name": "client-machine-a", "dataset": {

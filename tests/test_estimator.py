@@ -13,6 +13,9 @@ from gordo_tpu.ops.metrics import (
 )
 
 
+# heavy integration module: excluded from the fast CI lane
+pytestmark = pytest.mark.slow
+
 def test_metrics_against_sklearn():
     import sklearn.metrics as skm
 

@@ -3,7 +3,6 @@ layer counts/dims vs config, registry lookups)."""
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 import pytest
 
 from gordo_tpu.models.factories import (

@@ -12,8 +12,7 @@ import pytest
 
 import jax
 
-from gordo_tpu.anomaly.diff import DiffBasedAnomalyDetector
-from gordo_tpu.models.estimator import AutoEncoder, LSTMAutoEncoder
+from gordo_tpu.models.estimator import AutoEncoder
 from gordo_tpu.ops.scalers import MinMaxScaler
 from gordo_tpu.parallel import (
     FleetDiffBuilder,
@@ -28,6 +27,9 @@ from gordo_tpu.pipeline import Pipeline
 from gordo_tpu.registry import lookup_factory
 from gordo_tpu.serializer import from_definition
 from gordo_tpu.train.fit import TrainConfig, fit as single_fit
+
+# heavy integration module: excluded from the fast CI lane
+pytestmark = pytest.mark.slow
 
 
 CFG = TrainConfig(epochs=3, batch_size=64, learning_rate=1e-3)

@@ -13,6 +13,9 @@ from gordo_tpu.serve.scorer import CompiledScorer
 from gordo_tpu.workflow import NormalizedConfig
 from gordo_tpu import serializer
 
+# heavy integration module: excluded from the fast CI lane
+pytestmark = pytest.mark.slow
+
 PROJECT = {
     "machines": [
         {"name": f"fs-machine-{i}", "dataset": {

@@ -10,7 +10,6 @@ import sys
 import types
 from unittest import mock
 
-import numpy as np
 import pandas as pd
 import pytest
 

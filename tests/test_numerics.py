@@ -10,6 +10,9 @@ import gordo_tpu.models.factories  # noqa: F401
 from gordo_tpu.registry import lookup_factory
 from gordo_tpu.train.fit import TrainConfig, fit
 
+# heavy integration module: excluded from the fast CI lane
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture()
 def module(sine_tags):

@@ -1,6 +1,11 @@
 """Watchman tests — polling against a real in-process ML server (the
 reference mocked kubernetes; we have no k8s layer to mock, the server
-list is explicit config)."""
+list is explicit config).
+
+Deliberately UNMARKED slow (~17s): the fast CI lane keeps the watchman
+discovery/eviction surface because it has no other smoke coverage there;
+the heavier integration modules (fleet, client, cli, ...) carry the
+``slow`` marker instead."""
 
 import asyncio
 
