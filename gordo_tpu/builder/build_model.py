@@ -36,21 +36,24 @@ def calculate_model_key(
     model_config: dict,
     data_config: dict,
     metadata: Optional[dict] = None,
+    extra: Optional[dict] = None,
 ) -> str:
     """Deterministic cache key: md5 over (version, name, configs, metadata)
     (reference: ``_calculate_model_key``).  Any config or framework-version
-    change produces a new key → rebuild."""
-    payload = json.dumps(
-        {
-            "gordo_tpu_version": gordo_tpu.__version__,
-            "name": name,
-            "model_config": model_config,
-            "data_config": data_config,
-            "user_metadata": metadata or {},
-        },
-        sort_keys=True,
-        default=str,
-    )
+    change produces a new key → rebuild.  ``extra`` carries build-time
+    options that change the trained result without living in the configs
+    (e.g. ``align_lengths``); omitted/empty keeps the historical hash so
+    existing caches stay valid."""
+    payload_dict = {
+        "gordo_tpu_version": gordo_tpu.__version__,
+        "name": name,
+        "model_config": model_config,
+        "data_config": data_config,
+        "user_metadata": metadata or {},
+    }
+    if extra:
+        payload_dict["build_options"] = extra
+    payload = json.dumps(payload_dict, sort_keys=True, default=str)
     return hashlib.md5(payload.encode()).hexdigest()
 
 
@@ -157,6 +160,38 @@ def assemble_metadata(
     }
 
 
+def lookup_cached_artifact(
+    model_register_dir: str, cache_key: str, name: str
+) -> Optional[str]:
+    """Registry lookup that verifies the artifact still IS what the key
+    says: per-machine artifact dirs get overwritten on config-changed
+    rebuilds, so a stale registry entry can point at a dir now holding a
+    DIFFERENT build.  Artifacts stamp their own ``cache_key`` in metadata
+    at dump time; a mismatch is treated as a miss.  (Artifacts from before
+    this stamp carry no key and are accepted as-is.)"""
+    cached = disk_registry.get_value(model_register_dir, cache_key)
+    if not cached:
+        return None
+    if not os.path.exists(os.path.join(cached, serializer.MODEL_FILE)):
+        logger.warning(
+            "Registry entry for %s points at missing artifact %s; rebuilding",
+            name, cached,
+        )
+        return None
+    try:
+        stored = serializer.load_metadata(cached).get("cache_key")
+    except Exception:
+        stored = None
+    if stored is not None and stored != cache_key:
+        logger.warning(
+            "Artifact %s was overwritten by a different build (stored key "
+            "%s != %s); treating as cache miss", cached, stored, cache_key,
+        )
+        return None
+    logger.info("Cache hit for %s (key %s): %s", name, cache_key, cached)
+    return cached
+
+
 def provide_saved_model(
     name: str,
     model_config: dict,
@@ -172,19 +207,14 @@ def provide_saved_model(
     cache_key = calculate_model_key(name, model_config, data_config, metadata)
 
     if model_register_dir and not replace_cache:
-        cached = disk_registry.get_value(model_register_dir, cache_key)
-        if cached and os.path.exists(os.path.join(cached, serializer.MODEL_FILE)):
-            logger.info("Cache hit for %s (key %s): %s", name, cache_key, cached)
+        cached = lookup_cached_artifact(model_register_dir, cache_key, name)
+        if cached is not None:
             return cached
-        if cached:
-            logger.warning(
-                "Registry entry for %s points at missing artifact %s; rebuilding",
-                name, cached,
-            )
 
     model, build_metadata = build_model(
         name, model_config, data_config, metadata, evaluation_config
     )
+    build_metadata["cache_key"] = cache_key
     dest = os.path.join(output_dir, name) if os.path.basename(
         os.path.normpath(output_dir)
     ) != name else output_dir

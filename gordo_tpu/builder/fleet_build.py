@@ -41,6 +41,7 @@ from gordo_tpu.builder.build_model import (
     assemble_metadata,
     build_model,
     calculate_model_key,
+    lookup_cached_artifact,
 )
 from gordo_tpu.dataset.base import GordoBaseDataset
 from gordo_tpu.parallel.anomaly import FleetDiffBuilder, analyze_definition
@@ -104,6 +105,28 @@ def _as_machine(m: Union[Machine, Dict[str, Any]]) -> Machine:
     return Machine.from_config(m)
 
 
+def _demote_to_single(
+    m: Machine,
+    singles: List[Machine],
+    machine_keys: Dict[str, str],
+    key_extra: Optional[Dict[str, Any]],
+    demoted: set,
+) -> None:
+    """Route a fleet-intended machine to the single builder.  The single
+    path trains on FULL untruncated data, so if an aligned build keyed this
+    machine with the alignment component, the key must drop it — otherwise
+    a later aligned run would cache-hit an artifact that never truncated.
+    ``demoted`` marks the machine so the singles pass re-checks the cache
+    under the rewritten key (a deterministic demotion — e.g. a provider
+    whose widths never match config — would otherwise retrain every run)."""
+    if key_extra:
+        machine_keys[m.name] = calculate_model_key(
+            m.name, m.model, m.dataset, m.metadata, extra=None
+        )
+        demoted.add(m.name)
+    singles.append(m)
+
+
 def _config_widths(dataset_cfg: Dict[str, Any]) -> Optional[Tuple[int, int]]:
     """(n_features, n_outputs) derivable from the dataset CONFIG alone, or
     None — the streaming pipeline buckets machines before any data loads."""
@@ -122,6 +145,7 @@ def build_project(
     replace_cache: bool = False,
     max_bucket_size: int = DEFAULT_MAX_BUCKET,
     data_workers: int = 8,
+    align_lengths: Optional[int] = None,
 ) -> ProjectBuildResult:
     """Build every machine; fleet-bucket the homogeneous ones.
 
@@ -129,58 +153,103 @@ def build_project(
     (2 x ``max_bucket_size``) have arrays resident — the one training on
     device and the one the loader pool is prefetching behind it.
 
+    ``align_lengths``: truncate each fleet-bucketed machine's train rows
+    DOWN to a multiple of this (dropping the oldest rows) before training.
+    Exact CV parity holds per distinct row count, so a ragged project —
+    the normal case once row filtering bites — pays one full XLA compile
+    per distinct length (~14s each measured); alignment collapses
+    ~``align_lengths`` lengths into one.  The cost is explicit and
+    bounded: up to ``align_lengths - 1`` of the OLDEST rows per machine.
+    Off (None) by default — results then match the single-machine build
+    of the unmodified data exactly.
+
     Returns a :class:`ProjectBuildResult` with one artifact dir per machine
     (identical layout to ``provide_saved_model``).
     """
     t_start = time.time()
+    if align_lengths is not None and align_lengths < 2:
+        raise ValueError(
+            f"align_lengths must be >= 2 (got {align_lengths}); it is a "
+            "row-count multiple, and 0/1/negative would change cache "
+            "identity without changing any training data"
+        )
     machines = [_as_machine(m) for m in machines]
     result = ProjectBuildResult()
     tracker = _LoadTracker()
+    # alignment changes what data trains, so it must be part of the cache
+    # identity — otherwise an aligned build silently reuses full-parity
+    # artifacts (and vice versa).  Only FLEET-built machines truncate;
+    # config-determined singles train on full data and therefore key
+    # WITHOUT the alignment component.
+    key_extra = {"align_lengths": align_lengths} if align_lengths else None
 
-    # 1. Config-hash cache check (reference: provide_saved_model).
-    to_build: List[Machine] = []
-    for m in machines:
-        key = calculate_model_key(m.name, m.model, m.dataset, m.metadata)
-        if model_register_dir and not replace_cache:
-            cached = disk_registry.get_value(model_register_dir, key)
-            if cached and os.path.exists(
-                os.path.join(cached, serializer.MODEL_FILE)
-            ):
-                logger.info("Cache hit for %s: %s", m.name, cached)
-                result.artifacts[m.name] = cached
-                result.cached.append(m.name)
-                continue
-        to_build.append(m)
-
-    # 2. Bucket by (fleet signature, config tag widths); misfits go single.
-    #    Config-only — no machine's data has loaded yet.
-    buckets: Dict[Tuple, List[Machine]] = {}
-    singles: List[Machine] = []
-    specs: Dict[Tuple, Any] = {}
-    for m in to_build:
+    # 1. Fleetability from CONFIG alone (no data loaded yet) + the
+    #    config-hash cache check (reference: provide_saved_model) with the
+    #    key matching what each machine's path will actually train on.
+    #    When no alignment is in play the key can't depend on fleetability,
+    #    so the (near-free) registry lookup runs FIRST and cache-hit
+    #    machines skip model analysis entirely — a fully-cached project
+    #    re-run must not instantiate 10k pipelines.
+    def _analyze(m: Machine):
         cv_mode = m.evaluation.get("cv_mode", "full_build")
         widths = _config_widths(m.dataset)
         spec = None
         if cv_mode == "full_build" and widths is not None:
             try:
-                spec = analyze_definition(serializer.from_definition(dict(m.model)))
+                spec = analyze_definition(
+                    serializer.from_definition(dict(m.model))
+                )
             except Exception:
                 spec = None
+        if spec is None and widths is None and cv_mode == "full_build":
+            # this machine may be paying for its config: without an
+            # explicit tag_list the stream can't bucket it pre-load, so it
+            # loses the stacked-XLA path — say so
+            logger.warning(
+                "Machine %s has no tag_list/tags in its dataset config; "
+                "building single (fleet bucketing needs config-derivable "
+                "widths)", m.name,
+            )
+        return spec, widths
+
+    def _lookup(key: str, m: Machine) -> bool:
+        if model_register_dir and not replace_cache:
+            cached = lookup_cached_artifact(model_register_dir, key, m.name)
+            if cached is not None:
+                result.artifacts[m.name] = cached
+                result.cached.append(m.name)
+                return True
+        return False
+
+    buckets: Dict[Tuple, List[Machine]] = {}
+    singles: List[Machine] = []
+    specs: Dict[Tuple, Any] = {}
+    machine_keys: Dict[str, str] = {}
+    demoted: set = set()
+    for m in machines:
+        if key_extra is None:
+            key = calculate_model_key(m.name, m.model, m.dataset, m.metadata)
+            machine_keys[m.name] = key
+            if _lookup(key, m):
+                continue
+            spec, widths = _analyze(m)
+        else:
+            # alignment: fleet-intended machines key WITH the alignment
+            # component, so fleetability must be known before the lookup
+            spec, widths = _analyze(m)
+            key = calculate_model_key(
+                m.name, m.model, m.dataset, m.metadata,
+                extra=key_extra if spec is not None else None,
+            )
+            machine_keys[m.name] = key
+            if _lookup(key, m):
+                continue
         if spec is None:
-            if widths is None and cv_mode == "full_build":
-                # this machine may be paying for its config: without an
-                # explicit tag_list the stream can't bucket it pre-load,
-                # so it loses the stacked-XLA path — say so
-                logger.warning(
-                    "Machine %s has no tag_list/tags in its dataset config; "
-                    "building single (fleet bucketing needs config-derivable "
-                    "widths)", m.name,
-                )
             singles.append(m)
             continue
-        key = (spec.signature, widths, str(m.evaluation.get("cv")))
-        buckets.setdefault(key, []).append(m)
-        specs[key] = spec
+        bkey = (spec.signature, widths, str(m.evaluation.get("cv")))
+        buckets.setdefault(bkey, []).append(m)
+        specs[bkey] = spec
 
     # 3. Chunk plan across all buckets, then stream: load chunk k+1 in the
     #    pool while chunk k trains; free arrays as artifacts dump.
@@ -193,12 +262,14 @@ def build_project(
         t0 = time.time()
         dataset = GordoBaseDataset.from_dict(dict(m.dataset))
         X, y = dataset.get_data()
-        entry = (
-            np.asarray(X, np.float32),
-            np.asarray(y, np.float32),
-            dataset.get_metadata(),
-            time.time() - t0,
-        )
+        X = np.asarray(X, np.float32)
+        y = np.asarray(y, np.float32)
+        if align_lengths and align_lengths > 1 and len(X) >= align_lengths:
+            keep = (len(X) // align_lengths) * align_lengths
+            # newest rows win: industrial sensor history is trained most-
+            # recent-first relevant, so the truncation drops the head
+            X, y = X[len(X) - keep:], y[len(y) - keep:]
+        entry = (X, y, dataset.get_metadata(), time.time() - t0)
         tracker.acquire()  # arrays are live from here until freed
         return entry
 
@@ -246,7 +317,9 @@ def build_project(
                         "building single", m.name, (X.shape[1], y.shape[1]),
                         widths,
                     )
-                    singles.append(m)
+                    _demote_to_single(
+                        m, singles, machine_keys, key_extra, demoted
+                    )
                     _free(loaded, [m.name])
                 else:
                     ok_chunk.append(m)
@@ -263,7 +336,10 @@ def build_project(
                     )
             except Exception:
                 logger.exception("Fleet bucket failed; falling back to singles")
-                singles.extend(ok_chunk)
+                for m in ok_chunk:
+                    _demote_to_single(
+                        m, singles, machine_keys, key_extra, demoted
+                    )
                 _free(loaded, [m.name for m in ok_chunk])
                 continue
             fleet_seconds = time.time() - t0
@@ -277,12 +353,27 @@ def build_project(
                     model_register_dir,
                     result,
                     fleet=True,
+                    align_lengths=align_lengths,
+                    cache_key=machine_keys[m.name],
                 )
                 _free(loaded, [m.name])  # artifact on disk: arrays drop
 
     # 4. Single-machine fallback (non-fleetable configs) — one at a time,
     #    each build loading and freeing its own data.
+    if singles and align_lengths:
+        logger.warning(
+            "align_lengths=%d does not apply to the %d machine(s) building "
+            "through the single-machine path (%s%s): they train on their "
+            "full untruncated data",
+            align_lengths, len(singles),
+            ", ".join(m.name for m in singles[:5]),
+            "..." if len(singles) > 5 else "",
+        )
     for m in singles:
+        # a runtime-demoted machine's key was rewritten to the unaligned
+        # form; a prior run's single artifact may already satisfy it
+        if m.name in demoted and _lookup(machine_keys[m.name], m):
+            continue
         try:
             model, metadata = build_model(
                 m.name, m.model, m.dataset, m.metadata, m.evaluation
@@ -291,9 +382,10 @@ def build_project(
             logger.exception("Single build failed for %s", m.name)
             result.failed[m.name] = f"build: {exc}"
             continue
+        metadata["cache_key"] = machine_keys[m.name]
         dest = os.path.join(output_dir, m.name)
         serializer.dump(model, dest, metadata=metadata)
-        _register(m, dest, model_register_dir)
+        _register(dest, model_register_dir, machine_keys[m.name])
         result.artifacts[m.name] = dest
         result.single_built.append(m.name)
 
@@ -311,8 +403,10 @@ def _dump_machine(
     model_register_dir: Optional[str],
     result: ProjectBuildResult,
     fleet: bool,
+    align_lengths: Optional[int] = None,
+    cache_key: Optional[str] = None,
 ) -> None:
-    _, _, dataset_meta, query_seconds = loaded_entry
+    X, _, dataset_meta, query_seconds = loaded_entry
     metadata = assemble_metadata(
         name=m.name,
         model=detector,
@@ -326,14 +420,27 @@ def _dump_machine(
         cv_meta=getattr(detector, "cv_metadata_", {}),
     )
     metadata["model"]["fleet_built"] = fleet
+    if align_lengths:
+        # a truncated artifact must be distinguishable from a full-parity
+        # one: record the alignment and the row count actually trained on
+        metadata["model"]["align_lengths"] = int(align_lengths)
+        metadata["model"]["rows_trained"] = int(X.shape[0])
+    # the artifact stamps its own cache identity so a later lookup can
+    # detect that this dir was overwritten by a different build
+    if cache_key is not None:
+        metadata["cache_key"] = cache_key
     dest = os.path.join(output_dir, m.name)
     serializer.dump(detector, dest, metadata=metadata)
-    _register(m, dest, model_register_dir)
+    _register(dest, model_register_dir, cache_key)
     result.artifacts[m.name] = dest
     result.fleet_built.append(m.name)
 
 
-def _register(m: Machine, dest: str, model_register_dir: Optional[str]) -> None:
-    if model_register_dir:
-        key = calculate_model_key(m.name, m.model, m.dataset, m.metadata)
+def _register(
+    dest: str, model_register_dir: Optional[str], key: Optional[str]
+) -> None:
+    """Registry write under the key computed ONCE in step 1 — the stamp in
+    metadata, the registry entry, and the next run's lookup must all agree
+    or the overwrite-detection breaks."""
+    if model_register_dir and key:
         disk_registry.write_key(model_register_dir, key, os.path.abspath(dest))

@@ -128,10 +128,16 @@ def build(output_dir, name, model_config, data_config, metadata,
               help="Max machines per stacked XLA program.")
 @click.option("--data-parallel", default=1, show_default=True,
               help="Mesh 'data' axis size (chips per model shard).")
+@click.option("--align-lengths", default=None,
+              type=click.IntRange(min=2),
+              help="Truncate each machine's train rows down to a multiple "
+                   "of this (oldest rows drop): ragged projects compile one "
+                   "XLA program per DISTINCT row count, so alignment trades "
+                   "up to N-1 old rows for ~N-fold fewer compiles.")
 @click.option("--replace-cache", is_flag=True)
 def build_project_cmd(machine_config, project_name, output_dir,
                       model_register_dir, max_bucket_size, data_parallel,
-                      replace_cache):
+                      align_lengths, replace_cache):
     """Build EVERY machine in the project config — homogeneous machines
     train as single mesh-sharded fleet programs (the TPU-native
     replacement for the reference's one-pod-per-machine Argo DAG)."""
@@ -155,6 +161,7 @@ def build_project_cmd(machine_config, project_name, output_dir,
         mesh=mesh,
         replace_cache=replace_cache,
         max_bucket_size=max_bucket_size,
+        align_lengths=align_lengths,
     )
     click.echo(json.dumps(result.summary()))
     if result.failed:

@@ -83,10 +83,23 @@ def lint_file(path: str) -> List[Finding]:
 
     tracker = _ImportTracker()
     tracker.visit(tree)
-    # names echoed in __all__ or re-exported via strings count as used
+    # names listed in __all__ count as used (re-export surface); other
+    # string literals do NOT — a dict key or log message that happens to
+    # match an import name must not suppress an unused-import finding
     for node in ast.walk(tree):
-        if isinstance(node, ast.Constant) and isinstance(node.value, str):
-            tracker.used.add(node.value)
+        if (
+            isinstance(node, ast.Assign)
+            and any(
+                isinstance(t, ast.Name) and t.id == "__all__"
+                for t in node.targets
+            )
+            and isinstance(node.value, (ast.List, ast.Tuple))
+        ):
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Constant) and isinstance(
+                    elt.value, str
+                ):
+                    tracker.used.add(elt.value)
     is_package_init = os.path.basename(path) == "__init__.py"
     if not is_package_init:  # __init__ re-export surface is exempt
         for name, lineno in tracker.imports:
@@ -132,6 +145,10 @@ def lint_file(path: str) -> List[Finding]:
 
 def main(argv: List[str]) -> int:
     paths = argv or ["gordo_tpu", "tests", "bench.py", "__graft_entry__.py"]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"lint: path(s) do not exist: {missing}", file=sys.stderr)
+        return 2
     all_findings: List[Finding] = []
     n_files = 0
     for path in iter_py_files(paths):
@@ -143,6 +160,10 @@ def main(argv: List[str]) -> int:
         f"lint: {n_files} files, {len(all_findings)} finding(s)",
         file=sys.stderr,
     )
+    if n_files == 0:
+        print("lint: no files found — refusing to pass vacuously",
+              file=sys.stderr)
+        return 2
     return 1 if all_findings else 0
 
 

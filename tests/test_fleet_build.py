@@ -198,3 +198,99 @@ def test_2k_machine_build_stays_memory_bounded(tmp_path):
     assert not result.failed
     assert len(result.artifacts) == 2000
     assert result.peak_loaded <= 256
+
+
+def test_align_lengths_collapses_ragged_row_counts(tmp_path, monkeypatch):
+    """Ragged train windows compile one XLA program per DISTINCT row count
+    (~14s each, measured); ``align_lengths`` truncates to a shared multiple
+    (newest rows kept) so one program serves the whole bucket."""
+    from gordo_tpu.builder import fleet_build as fb
+    from gordo_tpu.workflow.config import Machine
+
+    def machine(i, hours):
+        day = 25 + (6 + hours) // 24
+        hh = (6 + hours) % 24
+        return Machine.from_config({
+            "name": f"rag-{i}",
+            "dataset": {
+                "type": "RandomDataset",
+                "tag_list": ["a", "b", "c"],
+                "train_start_date": "2017-12-25T06:00:00Z",
+                "train_end_date": f"2017-12-{day}T{hh:02d}:10:00Z",
+            },
+        })
+
+    # 3 machines with 3 distinct row counts (10min resolution)
+    machines = [machine(i, h) for i, h in enumerate((20, 21, 22))]
+
+    seen_lengths = []
+    orig_build = fb.FleetDiffBuilder.build
+
+    def recording_build(self, Xs, ys):
+        seen_lengths.append(sorted({x.shape[0] for x in Xs}))
+        return orig_build(self, Xs, ys)
+
+    monkeypatch.setattr(fb.FleetDiffBuilder, "build", recording_build)
+
+    result = build_project(
+        machines, str(tmp_path / "aligned"), align_lengths=60,
+    )
+    assert not result.failed
+    assert len(result.fleet_built) == 3
+    # all three truncated down to the shared multiple of 60 -> ONE length
+    assert seen_lengths and all(len(s) == 1 for s in seen_lengths)
+    assert seen_lengths[0][0] % 60 == 0
+
+    seen_lengths.clear()
+    result = build_project(machines, str(tmp_path / "ragged"))
+    assert not result.failed
+    # without alignment the ragged lengths all survive (exact parity mode)
+    assert sorted(x for s in seen_lengths for x in s) == [122, 128, 134]
+
+
+def test_align_lengths_changes_cache_identity(tmp_path):
+    """An artifact built with alignment must not satisfy an exact-parity
+    build's cache lookup (and vice versa) — alignment changes what data
+    trained, so it is part of the cache key."""
+    from gordo_tpu.workflow.config import Machine
+
+    machines = [Machine.from_config({
+        "name": "ck-0",
+        "dataset": {
+            "type": "RandomDataset",
+            "tag_list": ["a", "b", "c"],
+            "train_start_date": "2017-12-25T06:00:00Z",
+            "train_end_date": "2017-12-26T03:10:00Z",
+        },
+    })]
+    out, reg = str(tmp_path / "m"), str(tmp_path / "r")
+    first = build_project(
+        machines, out, model_register_dir=reg, align_lengths=60,
+    )
+    assert first.fleet_built == ["ck-0"]
+    meta = serializer.load_metadata(first.artifacts["ck-0"])
+    assert meta["model"]["align_lengths"] == 60
+    assert meta["model"]["rows_trained"] % 60 == 0
+
+    # same register dir, no alignment: MISS (rebuild), not a stale hit
+    second = build_project(machines, out, model_register_dir=reg)
+    assert second.fleet_built == ["ck-0"] and not second.cached
+    meta2 = serializer.load_metadata(second.artifacts["ck-0"])
+    assert "align_lengths" not in meta2["model"]
+
+    # aligned again: the aligned registry entry points at the dir the
+    # unaligned rerun overwrote; the artifact's cache_key stamp exposes
+    # that -> miss and rebuild, never a silent wrong-artifact hit
+    third = build_project(
+        machines, out, model_register_dir=reg, align_lengths=60,
+    )
+    assert third.fleet_built == ["ck-0"] and not third.cached
+    assert serializer.load_metadata(
+        third.artifacts["ck-0"]
+    )["model"]["align_lengths"] == 60
+
+    # an identical aligned rerun is now a genuine hit
+    fourth = build_project(
+        machines, out, model_register_dir=reg, align_lengths=60,
+    )
+    assert fourth.cached == ["ck-0"]
