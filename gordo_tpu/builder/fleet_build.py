@@ -234,16 +234,26 @@ def build_project(
                 continue
             spec, widths = _analyze(m)
         else:
-            # alignment: fleet-intended machines key WITH the alignment
-            # component, so fleetability must be known before the lookup
-            spec, widths = _analyze(m)
+            # alignment: try the aligned key FIRST — fleetability is a
+            # deterministic function of the configs already hashed into
+            # the key, so an aligned-key hit can only be a fleet-aligned
+            # artifact, and cache-hit machines skip model analysis here
+            # too.  Only on miss do we analyze and, for non-fleetable
+            # machines, retry under the unaligned key they build with.
             key = calculate_model_key(
-                m.name, m.model, m.dataset, m.metadata,
-                extra=key_extra if spec is not None else None,
+                m.name, m.model, m.dataset, m.metadata, extra=key_extra
             )
             machine_keys[m.name] = key
             if _lookup(key, m):
                 continue
+            spec, widths = _analyze(m)
+            if spec is None:
+                key = calculate_model_key(
+                    m.name, m.model, m.dataset, m.metadata
+                )
+                machine_keys[m.name] = key
+                if _lookup(key, m):
+                    continue
         if spec is None:
             singles.append(m)
             continue
@@ -264,7 +274,7 @@ def build_project(
         X, y = dataset.get_data()
         X = np.asarray(X, np.float32)
         y = np.asarray(y, np.float32)
-        if align_lengths and align_lengths > 1 and len(X) >= align_lengths:
+        if align_lengths and len(X) >= align_lengths:  # validated >= 2
             keep = (len(X) // align_lengths) * align_lengths
             # newest rows win: industrial sensor history is trained most-
             # recent-first relevant, so the truncation drops the head

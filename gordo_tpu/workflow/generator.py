@@ -67,8 +67,17 @@ def build_plan(
     config: NormalizedConfig,
     max_bucket_size: int = 512,
     mesh: Optional[Dict[str, int]] = None,
+    align_lengths: Optional[int] = None,
 ) -> Dict[str, Any]:
-    """Bucketed fleet build plan for the project."""
+    """Bucketed fleet build plan for the project.
+
+    ``align_lengths`` must match the value the build will run with: it is
+    part of fleet-built machines' cache identity, so plan keys computed
+    without it would never match the registry entries an aligned
+    ``build_project`` writes.  (Like the bucketing itself, the keys are
+    the fleet-path prediction: a machine the builder demotes to the
+    single path at run time keys without the alignment component there.)"""
+    key_extra = {"align_lengths": align_lengths} if align_lengths else None
     buckets: Dict[str, List[Machine]] = {}
     for machine in config.machines:
         buckets.setdefault(_fleet_signature(machine), []).append(machine)
@@ -85,19 +94,23 @@ def build_plan(
                     "model_config": chunk[0].model,
                     "cache_keys": {
                         m.name: calculate_model_key(
-                            m.name, m.model, m.dataset, m.metadata
+                            m.name, m.model, m.dataset, m.metadata,
+                            extra=key_extra,
                         )
                         for m in chunk
                     },
                 }
             )
-    return {
+    plan = {
         "project-name": config.project_name,
         "mesh": mesh or {"models": -1, "data": 1},  # -1: all available chips
         "n_machines": len(config.machines),
         "n_buckets": len(plan_buckets),
         "buckets": plan_buckets,
     }
+    if align_lengths:
+        plan["align_lengths"] = int(align_lengths)
+    return plan
 
 
 # ---------------------------------------------------------------------------

@@ -116,6 +116,71 @@ def sweep_bucket(n_machines: int = 512) -> None:
         )
 
 
+def _machines(n: int, n_tags: int = 10, prefix: str = "swp"):
+    from gordo_tpu.workflow.config import Machine
+
+    return [
+        Machine.from_config(
+            {
+                "name": f"{prefix}-{i:04d}",
+                "dataset": {
+                    "type": "RandomDataset",
+                    "tag_list": [f"t-{i}-{j}" for j in range(n_tags)],
+                },
+            }
+        )
+        for i in range(n)
+    ]
+
+
+def sweep_multibucket() -> None:
+    """Bench-diversity scenario: a project whose machines split across 4
+    tag widths (4 buckets, 4 programs) vs a uniform project of the same
+    size — measures the per-bucket compile+dispatch overhead."""
+    from gordo_tpu.builder.fleet_build import build_project
+    import shutil as sh
+    import tempfile as tf
+
+    uniform = _machines(512, 10, "uni")
+    mixed = (
+        _machines(128, 8, "w8") + _machines(128, 12, "w12")
+        + _machines(128, 16, "w16") + _machines(128, 24, "w24")
+    )
+    for label, machines in (("uniform-1-bucket", uniform),
+                            ("mixed-4-buckets", mixed)):
+        rates = []
+        for _run in range(2):
+            out = tf.mkdtemp()
+            t0 = time.perf_counter()
+            res = build_project(machines, out)
+            dt = time.perf_counter() - t0
+            sh.rmtree(out, ignore_errors=True)
+            assert not res.failed, list(res.failed.items())[:2]
+            rates.append(len(res.artifacts) / dt * 3600)
+        print(f"{label}: warm {rates[-1]:,.0f} models/h "
+              f"(cold {rates[0]:,.0f})", flush=True)
+
+
+def sweep_sustained(n: int = 4096) -> None:
+    """Bench-diversity scenario: one sustained 4096-machine project build
+    (8 chunks of 512) — the memory-bounded stream at scale, warm rate."""
+    from gordo_tpu.builder.fleet_build import build_project
+    import shutil as sh
+    import tempfile as tf
+
+    machines = _machines(n, 10, "sus")
+    for run in range(2):
+        out = tf.mkdtemp()
+        t0 = time.perf_counter()
+        res = build_project(machines, out)
+        dt = time.perf_counter() - t0
+        sh.rmtree(out, ignore_errors=True)
+        assert not res.failed, list(res.failed.items())[:2]
+        print(f"run {run}: {len(res.artifacts)} machines in {dt:.1f}s "
+              f"({len(res.artifacts) / dt * 3600:,.0f} models/h, "
+              f"peak_loaded={res.peak_loaded})", flush=True)
+
+
 def sweep_smooth() -> None:
     """Probe the smoothing windows-tensor guard: disable it and drive
     stacked scoring at sizes spanning the current 2^27-element bound."""
@@ -159,6 +224,8 @@ if __name__ == "__main__":
         "minbucket": sweep_minbucket,
         "bucket": sweep_bucket,
         "smooth": sweep_smooth,
+        "multibucket": sweep_multibucket,
+        "sustained": sweep_sustained,
     }
     which = sys.argv[1] if len(sys.argv) > 1 else ""
     if which not in sweeps:

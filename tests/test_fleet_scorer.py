@@ -195,3 +195,47 @@ def test_repeated_calls_with_fresh_data_stay_exact(models):
         {names[0]: rng.standard_normal((32, 3)).astype(np.float32)}
     )
     assert (fresh[names[0]]["tag-anomaly-thresholds"] >= 0).all()
+
+
+def test_lstm_machines_stack_and_match_per_machine_scorer():
+    """BASELINE config 2's serving side: windowed LSTM detectors must
+    stack into one vmapped program and match each machine's own
+    CompiledScorer output exactly (windowing offset included)."""
+    from gordo_tpu.anomaly.diff import DiffBasedAnomalyDetector
+    from gordo_tpu.models.estimator import LSTMAutoEncoder
+    from gordo_tpu.ops.scalers import MinMaxScaler
+    from gordo_tpu.pipeline import Pipeline
+
+    rng = np.random.default_rng(4)
+    L = 6
+    dets = {}
+    for i in range(3):
+        X_train = rng.standard_normal((160, 3)).astype(np.float32)
+        det = DiffBasedAnomalyDetector(
+            base_estimator=Pipeline([
+                MinMaxScaler(),
+                LSTMAutoEncoder(lookback_window=L, epochs=1, batch_size=64),
+            ]),
+        )
+        det.cross_validate(X_train)
+        det.fit(X_train)
+        dets[f"lstm-{i}"] = det
+
+    scorer = FleetScorer.from_models(dets)
+    assert scorer.n_stacked == 3 and len(scorer.buckets) == 1
+
+    X_by = {
+        name: rng.standard_normal((40 + 3 * i, 3)).astype(np.float32)
+        for i, name in enumerate(sorted(dets))
+    }
+    bulk = scorer.score_all(X_by)
+    for name, det in dets.items():
+        single = CompiledScorer(det).anomaly_arrays(X_by[name])
+        # windowing consumes lookback-1 rows at the front
+        assert bulk[name]["model-output"].shape[0] == len(X_by[name]) - (L - 1)
+        for key in ("model-output", "tag-anomaly-scores",
+                    "total-anomaly-score", "anomaly-confidence"):
+            np.testing.assert_allclose(
+                bulk[name][key], single[key], rtol=1e-5, atol=1e-6,
+                err_msg=f"{name}/{key}",
+            )
