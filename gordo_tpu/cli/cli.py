@@ -181,13 +181,20 @@ def build_project_cmd(machine_config, project_name, output_dir,
 @click.option("--rescan-interval", default=30.0, show_default=True,
               help="Seconds between artifact-dir rescans picking up newly "
                    "built machines (0 disables).")
-def run_server_cmd(model_dir, host, port, project, rescan_interval):
+@click.option("--coalesce-ms", default=0.0, show_default=True,
+              help="Micro-batch concurrent single-machine anomaly requests "
+                   "into stacked fleet dispatches, waiting up to this many "
+                   "ms per request (0 disables). Big win under concurrent "
+                   "load; adds up to the window in latency when idle.")
+def run_server_cmd(model_dir, host, port, project, rescan_interval,
+                   coalesce_ms):
     """Serve model(s) over the /gordo/v0/<project>/<machine>/ routes."""
     from gordo_tpu.serve.server import run_server
 
     run_server(
         model_dir, host=host, port=port, project=project,
         rescan_interval=rescan_interval,
+        coalesce_window_ms=coalesce_ms,
     )
 
 

@@ -145,6 +145,13 @@ class _Bucket:
             self.thresholds_np = None
             self.agg_thresholds_np = None
             self.agg_thresholds = None
+        #: authoritative input width (detector scaler stats are per-feature
+        #: arrays), used to reject malformed requests per machine instead
+        #: of letting one bad array sink a whole stacked dispatch
+        det_leaves = jax.tree.leaves(self.det_stats)
+        self.n_features = (
+            int(det_leaves[0].shape[-1]) if det_leaves else None
+        )
         #: pinned host stacking buffer, reused across score_all calls while
         #: the (rows, features) request shape repeats; guarded by _lock —
         #: concurrent bulk requests run score_all from executor threads
@@ -254,15 +261,29 @@ class FleetScorer:
             )
             ok_names = []
             for n in wanted:
-                rows = np.asarray(X_by_name[n]).shape[0]
-                if rows <= offset_check:
-                    # report per machine; one short machine must not sink
-                    # the whole bulk request
+                arr = np.asarray(X_by_name[n])
+                # report malformed requests per machine; one bad machine
+                # must not sink the whole stacked dispatch.  "client-error"
+                # lets transports map these to 400 instead of 500.
+                if arr.shape[0] <= offset_check:
                     results[n] = {
                         "error": (
                             f"needs more than {offset_check} rows "
-                            f"(lookback window), got {rows}"
-                        )
+                            f"(lookback window), got {arr.shape[0]}"
+                        ),
+                        "client-error": True,
+                    }
+                elif (
+                    bucket.n_features is not None
+                    and arr.ndim == 2
+                    and arr.shape[1] != bucket.n_features
+                ):
+                    results[n] = {
+                        "error": (
+                            f"X has {arr.shape[1]} columns; model expects "
+                            f"{bucket.n_features}"
+                        ),
+                        "client-error": True,
                     }
                 else:
                     ok_names.append(n)
@@ -290,7 +311,10 @@ class FleetScorer:
                         # same per-machine isolation as the fallbacks loop:
                         # one machine's model-internal error must not 500
                         # the whole bulk request
-                        results[n] = {"error": str(exc)}
+                        results[n] = {
+                            "error": str(exc),
+                            "client-error": isinstance(exc, ValueError),
+                        }
                 continue
             # build (M, n_rows, F) in bucket.names order: requested machines
             # get repeat-last row padding; absent slots score a dummy copy
@@ -352,5 +376,8 @@ class FleetScorer:
                 except Exception as exc:
                     # missing thresholds, short rows, model-internal errors —
                     # report per machine instead of sinking the bulk request
-                    results[name] = {"error": str(exc)}
+                    results[name] = {
+                        "error": str(exc),
+                        "client-error": isinstance(exc, ValueError),
+                    }
         return results

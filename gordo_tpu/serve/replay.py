@@ -57,8 +57,11 @@ async def _replay(
     parallelism: int,
     machines: Optional[Sequence[str]],
     timeout_s: float,
+    coalesce_window_ms: float = 0.0,
 ) -> Dict[str, Any]:
-    runner = web.AppRunner(build_app(collection))
+    runner = web.AppRunner(
+        build_app(collection, coalesce_window_ms=coalesce_window_ms)
+    )
     await runner.setup()
     site = web.TCPSite(runner, "127.0.0.1", 0)
     await site.start()
@@ -156,16 +159,18 @@ def replay_bench(
     parallelism: int = 8,
     machines: Optional[Sequence[str]] = None,
     timeout_s: float = 600.0,
+    coalesce_window_ms: float = 0.0,
 ) -> Dict[str, Any]:
     """Measure end-to-end HTTP anomaly-scoring throughput.
 
     ``mode``: ``"bulk"`` (one ``_bulk`` request per round carrying every
     machine's chunk) or ``"single"`` (one request per machine per round,
     ``parallelism`` in flight).  ``wire``: ``"json"`` or ``"msgpack"``.
+    ``coalesce_window_ms``: enable the server's cross-request coalescer.
     """
     return asyncio.run(
         _replay(
             collection, mode, wire, n_rounds, rows, parallelism, machines,
-            timeout_s,
+            timeout_s, coalesce_window_ms,
         )
     )

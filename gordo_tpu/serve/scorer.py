@@ -194,9 +194,20 @@ class CompiledScorer:
         n_valid = n - self.offset
         return {k: np.asarray(v)[:n_valid] for k, v in out.items()}
 
+    def _require_rows(self, X: np.ndarray) -> None:
+        """Windowed models consume ``offset`` rows; fewer input rows than
+        that would slice the padded output with a NEGATIVE bound and return
+        silently wrong arrays — reject as a client error instead."""
+        if X.shape[0] <= self.offset:
+            raise ValueError(
+                f"needs more than {self.offset} rows (lookback window), "
+                f"got {X.shape[0]}"
+            )
+
     # -- public surface ------------------------------------------------------
     def predict(self, X) -> np.ndarray:
         X = np.asarray(X, np.float32)
+        self._require_rows(X)
         if self.fused:
             return self._run(X, with_anomaly=False)["model-output"]
         return np.asarray(self.model.predict(X))
@@ -208,6 +219,7 @@ class CompiledScorer:
                 f"{type(self.model).__name__} is not an anomaly detector"
             )
         X = np.asarray(X, np.float32)
+        self._require_rows(X)
         use_fused = self.fused and (y is None or y is X)
         if use_fused and self.chain["detector"]["window"]:
             # smoothing materializes an (n, window, tags) tensor on device;

@@ -32,6 +32,7 @@ from aiohttp import web
 import gordo_tpu
 from gordo_tpu import serializer
 from gordo_tpu.serve import codec
+from gordo_tpu.serve import coalesce as coalesce_mod
 from gordo_tpu.serve.scorer import CompiledScorer
 
 logger = logging.getLogger(__name__)
@@ -41,6 +42,7 @@ API_PREFIX = "/gordo/v0"
 COLLECTION_KEY: "web.AppKey[ModelCollection]" = web.AppKey(
     "collection", object
 )
+COALESCER_KEY: "web.AppKey[object]" = web.AppKey("coalescer", object)
 
 
 class ModelEntry:
@@ -335,6 +337,8 @@ async def prediction(request: web.Request) -> web.Response:
     loop = asyncio.get_running_loop()
     try:
         out = await loop.run_in_executor(None, entry.scorer.predict, X)
+    except ValueError as exc:  # client-input problem (e.g. short rows)
+        return web.json_response({"error": str(exc)}, status=400)
     except Exception as exc:
         logger.exception("Prediction failed for %s", entry.name)
         return web.json_response({"error": str(exc)}, status=500)
@@ -373,10 +377,20 @@ async def anomaly_prediction(request: web.Request) -> web.Response:
     except ValueError as exc:
         return web.json_response({"error": str(exc)}, status=400)
     loop = asyncio.get_running_loop()
+    coalescer = request.app.get(COALESCER_KEY)
     try:
-        out = await loop.run_in_executor(
-            None, entry.scorer.anomaly_arrays, X, y
-        )
+        if coalescer is not None and y is None:
+            # concurrent requests across machines merge into one stacked
+            # dispatch (same vmapped program family as the _bulk route)
+            out = await asyncio.wrap_future(
+                coalescer.submit(entry.name, X)
+            )
+        else:
+            out = await loop.run_in_executor(
+                None, entry.scorer.anomaly_arrays, X, y
+            )
+    except ValueError as exc:  # client-input problem (e.g. short rows)
+        return web.json_response({"error": str(exc)}, status=400)
     except Exception as exc:
         logger.exception("Anomaly scoring failed for %s", entry.name)
         return web.json_response({"error": str(exc)}, status=500)
@@ -444,7 +458,12 @@ async def bulk_anomaly_prediction(request: web.Request) -> web.Response:
     except Exception as exc:
         logger.exception("Bulk anomaly scoring failed")
         return web.json_response({"error": str(exc)}, status=500)
-    data = {name: dict(res) for name, res in out.items()}
+    # "client-error" is transport metadata (exception-type routing for the
+    # coalescer), not response schema — strip it
+    data = {
+        name: {k: v for k, v in res.items() if k != "client-error"}
+        for name, res in out.items()
+    }
     for name, res in data.items():
         if name in index_by_name and "model-output" in res:
             entry = collection.get(name)
@@ -480,6 +499,7 @@ async def project_index(request: web.Request) -> web.Response:
             "project-name": collection.project,
             "machines": sorted(collection.entries),
             "gordo-server-version": gordo_tpu.__version__,
+            "coalescer": coalesce_mod.stats(request.app.get(COALESCER_KEY)),
         }
     )
 
@@ -503,12 +523,31 @@ def _json_dumps(obj) -> str:
 # ---------------------------------------------------------------------------
 
 def build_app(
-    collection: ModelCollection, rescan_interval: float = 0.0
+    collection: ModelCollection,
+    rescan_interval: float = 0.0,
+    coalesce_window_ms: float = 0.0,
 ) -> web.Application:
     """``rescan_interval > 0`` starts a background artifact-dir rescan so
-    machines built after startup begin serving without a restart."""
+    machines built after startup begin serving without a restart.
+    ``coalesce_window_ms > 0`` micro-batches concurrent single-machine
+    anomaly requests into stacked fleet dispatches (``serve/coalesce.py``)
+    at the cost of up to that much added latency per request."""
     app = web.Application(client_max_size=256 * 1024 * 1024)
     app[COLLECTION_KEY] = collection
+
+    if coalesce_window_ms > 0:
+        coalescer = coalesce_mod.CoalescingScorer(
+            lambda: collection.fleet_scorer,
+            max_wait_s=coalesce_window_ms / 1000.0,
+        )
+        app[COALESCER_KEY] = coalescer
+
+        async def _close_coalescer(app: web.Application):
+            await asyncio.get_running_loop().run_in_executor(
+                None, coalescer.close
+            )
+
+        app.on_cleanup.append(_close_coalescer)
 
     if rescan_interval > 0 and collection.source_dir is not None:
 
@@ -559,6 +598,7 @@ def run_server(
     port: int = 5555,
     project: str = "project",
     rescan_interval: float = 30.0,
+    coalesce_window_ms: float = 0.0,
 ) -> None:
     """Blocking entrypoint (reference: ``gordo run-server``)."""
     collection = ModelCollection.from_directory(model_dir, project=project)
@@ -570,7 +610,11 @@ def run_server(
         port,
     )
     web.run_app(
-        build_app(collection, rescan_interval=rescan_interval),
+        build_app(
+            collection,
+            rescan_interval=rescan_interval,
+            coalesce_window_ms=coalesce_window_ms,
+        ),
         host=host,
         port=port,
     )

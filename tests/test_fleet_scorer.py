@@ -239,3 +239,23 @@ def test_lstm_machines_stack_and_match_per_machine_scorer():
                 bulk[name][key], single[key], rtol=1e-5, atol=1e-6,
                 err_msg=f"{name}/{key}",
             )
+
+
+def test_width_mismatch_isolated_in_stacked_dispatch(models):
+    """score_all itself (no HTTP-level validation in front of it — the
+    coalescer path) must reject a wrong-width array in ITS machine's slot
+    instead of corrupting or crashing the stacked dispatch."""
+    scorer = FleetScorer.from_models(models[0])
+    rng = np.random.default_rng(8)
+    names = sorted(models[0])
+    X_by = {n: rng.standard_normal((30, 3)).astype(np.float32) for n in names}
+    X_by[names[0]] = rng.standard_normal((30, 5)).astype(np.float32)  # bad
+    out = scorer.score_all(X_by)
+    assert "columns" in out[names[0]]["error"]
+    assert out[names[0]]["client-error"] is True
+    for n in names[1:]:
+        single = CompiledScorer(models[0][n]).anomaly_arrays(X_by[n])
+        np.testing.assert_allclose(
+            out[n]["total-anomaly-score"], single["total-anomaly-score"],
+            rtol=1e-5, atol=1e-6,
+        )

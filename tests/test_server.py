@@ -490,3 +490,142 @@ def test_replay_bench_smoke(model_dir):
             )
             assert out["samples_per_sec"] > 0, out
             assert out["n_machines"] == 2
+
+
+def test_coalesced_requests_match_direct_path(model_dir):
+    """serve/coalesce.py: concurrent single-machine anomaly requests ride
+    one stacked dispatch and must return the same scores as the
+    per-machine executor path — including several concurrent requests for
+    the SAME machine (round-splitting)."""
+    import numpy as np
+
+    rng = np.random.default_rng(9)
+    payloads = [
+        ("machine-a", rng.standard_normal((50 + i, 3)).astype(np.float32))
+        for i in range(4)
+    ] + [
+        ("machine-b", rng.standard_normal((64, 3)).astype(np.float32))
+        for _ in range(3)
+    ]
+
+    async def fire(client):
+        async def one(name, X):
+            resp = await client.post(
+                f"/gordo/v0/testproj/{name}/anomaly/prediction",
+                json={"X": X.tolist()},
+            )
+            assert resp.status == 200, await resp.text()
+            return await resp.json()
+
+        bodies = await asyncio.gather(
+            *(one(name, X) for name, X in payloads)
+        )
+        idx = await client.get("/gordo/v0/testproj/")
+        return bodies, (await idx.json())["coalescer"]
+
+    async def run(coalesce_ms):
+        collection = ModelCollection.from_directory(model_dir, project="testproj")
+        client = TestClient(TestServer(
+            build_app(collection, coalesce_window_ms=coalesce_ms)
+        ))
+        await client.start_server()
+        try:
+            return await fire(client)
+        finally:
+            await client.close()
+
+    direct, stats_off = asyncio.run(run(0.0))
+    coalesced, stats_on = asyncio.run(run(5.0))
+    assert stats_off == {"enabled": False}
+    assert stats_on["enabled"] and stats_on["requests"] == len(payloads)
+    for d, c in zip(direct, coalesced):
+        np.testing.assert_allclose(
+            np.asarray(c["data"]["total-anomaly-score"]),
+            np.asarray(d["data"]["total-anomaly-score"]),
+            rtol=1e-4, atol=1e-5,
+        )
+        assert c["data"]["total-anomaly-threshold"] == pytest.approx(
+            d["data"]["total-anomaly-threshold"], rel=1e-5
+        )
+
+
+def test_short_rows_are_400_on_both_paths(model_dir, tmp_path):
+    """A request with fewer rows than the model's lookback window is a
+    client error: 400 from the direct path AND the coalesced path (it
+    previously sliced padded output with a negative bound -> garbage 200)."""
+    import numpy as np
+
+    from gordo_tpu import serializer
+    from gordo_tpu.anomaly.diff import DiffBasedAnomalyDetector
+    from gordo_tpu.models.estimator import LSTMAutoEncoder
+    from gordo_tpu.ops.scalers import MinMaxScaler
+    from gordo_tpu.pipeline import Pipeline
+
+    rng = np.random.default_rng(1)
+    det = DiffBasedAnomalyDetector(
+        base_estimator=Pipeline([
+            MinMaxScaler(),
+            LSTMAutoEncoder(lookback_window=10, epochs=1, batch_size=64),
+        ]),
+    )
+    X_train = rng.standard_normal((150, 3)).astype(np.float32)
+    det.cross_validate(X_train)
+    det.fit(X_train)
+    art_dir = tmp_path / "lstm-short" / "lstm-m"
+    serializer.dump(det, str(art_dir), metadata={
+        "dataset": {"tag_list": ["a", "b", "c"], "resolution": "10min"},
+    })
+
+    short = rng.standard_normal((4, 3)).astype(np.float32).tolist()
+
+    async def run(coalesce_ms):
+        collection = ModelCollection.from_directory(
+            str(tmp_path / "lstm-short"), project="shortproj"
+        )
+        client = TestClient(TestServer(
+            build_app(collection, coalesce_window_ms=coalesce_ms)
+        ))
+        await client.start_server()
+        try:
+            anom = await client.post(
+                "/gordo/v0/shortproj/lstm-m/anomaly/prediction",
+                json={"X": short},
+            )
+            pred = await client.post(
+                "/gordo/v0/shortproj/lstm-m/prediction",
+                json={"X": short},
+            )
+            return anom.status, await anom.json(), pred.status
+        finally:
+            await client.close()
+
+    for coalesce_ms in (0.0, 5.0):
+        status, body, pred_status = asyncio.run(run(coalesce_ms))
+        assert status == 400, (coalesce_ms, body)
+        assert "rows" in body["error"]
+        assert pred_status == 400
+
+
+def test_bulk_width_mismatch_isolated_per_machine(model_dir):
+    """One machine's malformed width must error in ITS slot, not sink the
+    stacked dispatch for the healthy machines riding the same request."""
+    import numpy as np
+
+    X_good = np.asarray(X_ROWS, np.float32)
+
+    async def fn(client):
+        resp = await client.post(
+            "/gordo/v0/testproj/_bulk/anomaly/prediction",
+            json={"X": {
+                "machine-a": X_good.tolist(),
+                "machine-b": X_good[:, :2].tolist(),  # wrong width
+            }},
+        )
+        assert resp.status == 200, await resp.text()
+        return await resp.json()
+
+    body = _call(model_dir, fn)
+    assert "model-output" in body["data"]["machine-a"]
+    mb = body["data"]["machine-b"]
+    assert "columns" in mb["error"]
+    assert "client-error" not in mb  # transport metadata, not schema
