@@ -26,7 +26,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from concurrent.futures import Future
+from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -56,6 +56,12 @@ class CoalescingScorer:
         self._closed = False
         self.n_dispatches = 0
         self.n_requests = 0
+        # machines the fleet scorer can't stack run its slow host-side
+        # fallback; they score HERE instead, so one slow machine can't
+        # head-of-line-block the stacked batches on the worker thread
+        self._fallback_pool = ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix="gordo-coalesce-fb"
+        )
         self._thread = threading.Thread(
             target=self._run, name="gordo-coalescer", daemon=True
         )
@@ -78,6 +84,7 @@ class CoalescingScorer:
             self._closed = True
             self._cv.notify()
         self._thread.join(timeout=5)
+        self._fallback_pool.shutdown(wait=False)
 
     # -- worker side ---------------------------------------------------------
     def _drain(self) -> List[Tuple[str, np.ndarray, Future]]:
@@ -93,8 +100,11 @@ class CoalescingScorer:
                 if remaining <= 0 or self._closed:
                     break
                 self._cv.wait(remaining)
-            batch = self._queue
-            self._queue = []
+            # hand over at most max_batch; overload leaves the rest queued
+            # for the next iteration (which skips the window wait — the
+            # queue is non-empty) instead of one unbounded mega-batch
+            batch = self._queue[: self.max_batch]
+            self._queue = self._queue[self.max_batch:]
             return batch
 
     def _run(self) -> None:
@@ -138,11 +148,39 @@ class CoalescingScorer:
         except Exception:
             logger.exception("Failed to resolve coalesced future")
 
+    def _score_one(self, scorer: Any, name: str, X: np.ndarray, fut: Future) -> None:
+        """Score a non-stackable machine on the fallback pool."""
+        try:
+            out = scorer.score_all({name: X})
+        except Exception as exc:
+            self._resolve(fut, exc=exc)
+            return
+        self._finish(name, fut, out)
+
     def _score_round(self, rnd: Dict[str, Tuple[np.ndarray, Future]]) -> None:
-        self.n_dispatches += 1
         self.n_requests += len(rnd)
         try:
             scorer = self._provider()
+        except Exception as exc:
+            for _, fut in rnd.values():
+                self._resolve(fut, exc=exc)
+            return
+        # machines outside the stacked buckets run FleetScorer's host-side
+        # fallback (potentially 100s of ms each) — push those off the
+        # worker so they can't head-of-line-block the fast stacked batch
+        stacked = {}
+        for name, (X, fut) in rnd.items():
+            if name in scorer.machine_bucket or name not in scorer.models:
+                stacked[name] = (X, fut)  # unknown names error in-slot
+            else:
+                self._fallback_pool.submit(
+                    self._score_one, scorer, name, X, fut
+                )
+        if not stacked:
+            return
+        rnd = stacked
+        self.n_dispatches += 1
+        try:
             out = scorer.score_all({n: x for n, (x, _) in rnd.items()})
         except Exception as exc:  # whole-dispatch failure: fail each future
             logger.exception("Coalesced dispatch failed")
@@ -150,21 +188,24 @@ class CoalescingScorer:
                 self._resolve(fut, exc=exc)
             return
         for name, (_, fut) in rnd.items():
-            res = out.get(name)
-            if res is None:
-                self._resolve(
-                    fut, exc=RuntimeError(f"No result for machine {name!r}")
-                )
-            elif "error" in res and "model-output" not in res:
-                # same exception surface as the per-machine scorer path:
-                # client-input problems raise ValueError (-> HTTP 400),
-                # everything else RuntimeError (-> 500)
-                exc_cls = (
-                    ValueError if res.get("client-error") else RuntimeError
-                )
-                self._resolve(fut, exc=exc_cls(str(res["error"])))
-            else:
-                self._resolve(fut, res=res)
+            self._finish(name, fut, out)
+
+    def _finish(self, name: str, fut: Future, out: Dict[str, Any]) -> None:
+        res = out.get(name)
+        if res is None:
+            self._resolve(
+                fut, exc=RuntimeError(f"No result for machine {name!r}")
+            )
+        elif "error" in res and "model-output" not in res:
+            # same exception surface as the per-machine scorer path:
+            # client-input problems raise ValueError (-> HTTP 400),
+            # everything else RuntimeError (-> 500)
+            exc_cls = (
+                ValueError if res.get("client-error") else RuntimeError
+            )
+            self._resolve(fut, exc=exc_cls(str(res["error"])))
+        else:
+            self._resolve(fut, res=res)
 
 
 def stats(coalescer: Optional[CoalescingScorer]) -> Dict[str, Any]:

@@ -30,6 +30,7 @@ from gordo_tpu.serve.scorer import (
     _bucket_rows,
     _extract_chain,
     _rolling_median,
+    short_rows_message,
 )
 
 #: same device-memory bound as CompiledScorer's smoothing guard (elements of
@@ -265,17 +266,22 @@ class FleetScorer:
                 # report malformed requests per machine; one bad machine
                 # must not sink the whole stacked dispatch.  "client-error"
                 # lets transports map these to 400 instead of 500.
-                if arr.shape[0] <= offset_check:
+                if arr.ndim != 2:
                     results[n] = {
                         "error": (
-                            f"needs more than {offset_check} rows "
-                            f"(lookback window), got {arr.shape[0]}"
+                            f"X must be 2-dimensional, got shape {arr.shape}"
+                        ),
+                        "client-error": True,
+                    }
+                elif arr.shape[0] <= offset_check:
+                    results[n] = {
+                        "error": short_rows_message(
+                            offset_check, arr.shape[0]
                         ),
                         "client-error": True,
                     }
                 elif (
                     bucket.n_features is not None
-                    and arr.ndim == 2
                     and arr.shape[1] != bucket.n_features
                 ):
                     results[n] = {

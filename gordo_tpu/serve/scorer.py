@@ -41,6 +41,14 @@ from gordo_tpu.pipeline import Pipeline
 MIN_BUCKET = 64
 
 
+def short_rows_message(offset: int, rows: int) -> str:
+    """The one short-rows client-error text — the direct, bulk, and
+    coalesced transports must emit identical 400 bodies."""
+    return (
+        f"needs more than {offset} rows (lookback window), got {rows}"
+    )
+
+
 def _bucket_rows(n: int) -> int:
     b = MIN_BUCKET
     while b < n:
@@ -199,10 +207,7 @@ class CompiledScorer:
         that would slice the padded output with a NEGATIVE bound and return
         silently wrong arrays — reject as a client error instead."""
         if X.shape[0] <= self.offset:
-            raise ValueError(
-                f"needs more than {self.offset} rows (lookback window), "
-                f"got {X.shape[0]}"
-            )
+            raise ValueError(short_rows_message(self.offset, X.shape[0]))
 
     # -- public surface ------------------------------------------------------
     def predict(self, X) -> np.ndarray:

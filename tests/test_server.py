@@ -629,3 +629,67 @@ def test_bulk_width_mismatch_isolated_per_machine(model_dir):
     mb = body["data"]["machine-b"]
     assert "columns" in mb["error"]
     assert "client-error" not in mb  # transport metadata, not schema
+
+
+def test_coalescer_routes_fallback_machines_off_worker(model_dir, tmp_path):
+    """A non-fusable machine (host-path fallback, potentially slow) must
+    not head-of-line-block coalesced requests for stacked machines — and
+    both kinds still answer correctly through the same app."""
+    import shutil
+
+    import numpy as np
+
+    from gordo_tpu import serializer
+    from gordo_tpu.anomaly.diff import DiffBasedAnomalyDetector
+    from gordo_tpu.models.estimator import AutoEncoder
+    from gordo_tpu.ops.scalers import FunctionTransformer
+    from gordo_tpu.ops.transformer_funcs import multiplier
+    from gordo_tpu.pipeline import Pipeline
+
+    live = tmp_path / "mixed"
+    shutil.copytree(model_dir, live)
+    rng = np.random.default_rng(3)
+    X_train = rng.standard_normal((150, 3)).astype(np.float32)
+    slow = DiffBasedAnomalyDetector(
+        base_estimator=Pipeline([
+            FunctionTransformer(func=multiplier, kw_args={"factor": 1.0}),
+            AutoEncoder(epochs=1, batch_size=64),
+        ]),
+    )
+    slow.cross_validate(X_train)
+    slow.fit(X_train)
+    serializer.dump(slow, str(live / "machine-slow"), metadata={
+        "dataset": {"tag_list": ["a", "b", "c"]},
+    })
+
+    async def main():
+        collection = ModelCollection.from_directory(str(live), project="mx")
+        fs = collection.fleet_scorer
+        assert "machine-slow" in fs.fallbacks  # premise: truly non-fusable
+        assert "machine-a" in fs.machine_bucket
+        client = TestClient(TestServer(
+            build_app(collection, coalesce_window_ms=5.0)
+        ))
+        await client.start_server()
+        try:
+            X = rng.standard_normal((40, 3)).astype(np.float32).tolist()
+
+            async def one(name):
+                resp = await client.post(
+                    f"/gordo/v0/mx/{name}/anomaly/prediction",
+                    json={"X": X},
+                )
+                assert resp.status == 200, (name, await resp.text())
+                return await resp.json()
+
+            bodies = await asyncio.gather(
+                *(one(n) for n in
+                  ["machine-a", "machine-slow", "machine-b", "machine-slow"])
+            )
+            return bodies
+        finally:
+            await client.close()
+
+    bodies = asyncio.run(main())
+    for body in bodies:
+        assert len(body["data"]["total-anomaly-score"]) == 40
