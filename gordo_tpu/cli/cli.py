@@ -128,6 +128,9 @@ def build(output_dir, name, model_config, data_config, metadata,
               help="Max machines per stacked XLA program.")
 @click.option("--data-parallel", default=1, show_default=True,
               help="Mesh 'data' axis size (chips per model shard).")
+@click.option("--data-workers", default=8, show_default=True,
+              type=click.IntRange(min=1),
+              help="Concurrent data-loader threads feeding the stream.")
 @click.option("--align-lengths", default=None,
               type=click.IntRange(min=2),
               help="Truncate each machine's train rows down to a multiple "
@@ -137,7 +140,7 @@ def build(output_dir, name, model_config, data_config, metadata,
 @click.option("--replace-cache", is_flag=True)
 def build_project_cmd(machine_config, project_name, output_dir,
                       model_register_dir, max_bucket_size, data_parallel,
-                      align_lengths, replace_cache):
+                      data_workers, align_lengths, replace_cache):
     """Build EVERY machine in the project config — homogeneous machines
     train as single mesh-sharded fleet programs (the TPU-native
     replacement for the reference's one-pod-per-machine Argo DAG)."""
@@ -161,6 +164,7 @@ def build_project_cmd(machine_config, project_name, output_dir,
         mesh=mesh,
         replace_cache=replace_cache,
         max_bucket_size=max_bucket_size,
+        data_workers=data_workers,
         align_lengths=align_lengths,
     )
     click.echo(json.dumps(result.summary()))

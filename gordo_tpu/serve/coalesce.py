@@ -56,6 +56,7 @@ class CoalescingScorer:
         self._closed = False
         self.n_dispatches = 0
         self.n_requests = 0
+        self.n_fallback = 0
         # machines the fleet scorer can't stack run its slow host-side
         # fallback; they score HERE instead, so one slow machine can't
         # head-of-line-block the stacked batches on the worker thread
@@ -94,15 +95,19 @@ class CoalescingScorer:
                 self._cv.wait()
             if not self._queue:
                 return []
-            deadline = time.monotonic() + self.max_wait_s
-            while len(self._queue) < self.max_batch:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0 or self._closed:
-                    break
-                self._cv.wait(remaining)
-            # hand over at most max_batch; overload leaves the rest queued
-            # for the next iteration (which skips the window wait — the
-            # queue is non-empty) instead of one unbounded mega-batch
+            if len(self._queue) < self.max_batch:
+                # normal operation: gather arrivals for one window.  Under
+                # overload (a full batch already queued) dispatch NOW —
+                # the leftovers of a burst must not sit through an extra
+                # idle window each round.
+                deadline = time.monotonic() + self.max_wait_s
+                while len(self._queue) < self.max_batch:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or self._closed:
+                        break
+                    self._cv.wait(remaining)
+            # hand over at most max_batch; the rest stays queued for the
+            # next iteration instead of one unbounded mega-batch
             batch = self._queue[: self.max_batch]
             self._queue = self._queue[self.max_batch:]
             return batch
@@ -173,6 +178,7 @@ class CoalescingScorer:
             if name in scorer.machine_bucket or name not in scorer.models:
                 stacked[name] = (X, fut)  # unknown names error in-slot
             else:
+                self.n_fallback += 1
                 self._fallback_pool.submit(
                     self._score_one, scorer, name, X, fut
                 )
@@ -211,12 +217,16 @@ class CoalescingScorer:
 def stats(coalescer: Optional[CoalescingScorer]) -> Dict[str, Any]:
     if coalescer is None:
         return {"enabled": False}
+    stacked = coalescer.n_requests - coalescer.n_fallback
     return {
         "enabled": True,
         "requests": coalescer.n_requests,
+        "fallback_requests": coalescer.n_fallback,
         "dispatches": coalescer.n_dispatches,
+        # amortization of the STACKED path only — fallback-routed requests
+        # never ride a dispatch and must not inflate the ratio
         "mean_batch": (
-            round(coalescer.n_requests / coalescer.n_dispatches, 2)
+            round(stacked / coalescer.n_dispatches, 2)
             if coalescer.n_dispatches
             else None
         ),

@@ -372,6 +372,15 @@ class FleetScorer:
         for name, scorer in self.fallbacks.items():
             if name in X_by_name:
                 X = np.asarray(X_by_name[name], np.float32)
+                if X.ndim != 2:
+                    # same clean client error as the bucketed machines get
+                    results[name] = {
+                        "error": (
+                            f"X must be 2-dimensional, got shape {X.shape}"
+                        ),
+                        "client-error": True,
+                    }
+                    continue
                 try:
                     if scorer.is_anomaly:
                         results[name] = scorer.anomaly_arrays(X)
