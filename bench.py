@@ -268,18 +268,6 @@ def bench_lstm_build(mesh, out: dict) -> None:
         out["lstm_serving_samples_per_sec_inprocess"] = round(lstm_serving)
         log(f"lstm serving (in-process): {lstm_serving:,.0f} samples/s")
 
-        # LSTM serving rate (in-process fused scorer)
-        scorer = CompiledScorer(model)
-        rng = np.random.default_rng(0)
-        X = rng.standard_normal((4096, N_LSTM_TAGS)).astype(np.float32)
-        scorer.anomaly_arrays(X, None)  # compile
-        n_iter, t0 = 10, time.perf_counter()
-        for _ in range(n_iter):
-            scorer.anomaly_arrays(X, None)
-        lstm_serving = n_iter * X.size / (time.perf_counter() - t0)
-        out["lstm_serving_samples_per_sec_inprocess"] = round(lstm_serving)
-        log(f"lstm serving (in-process): {lstm_serving:,.0f} samples/s")
-
 
 # ---------------------------------------------------------------------------
 # serving benches

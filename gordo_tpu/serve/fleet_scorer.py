@@ -38,14 +38,7 @@ from gordo_tpu.serve.scorer import (
 SMOOTH_ELEMENT_BOUND = 2 ** 27
 
 
-@partial(
-    jax.jit,
-    static_argnames=(
-        "module", "scaler_classes", "mode", "lookback", "det_cls",
-        "with_thresholds", "smooth_window",
-    ),
-)
-def _fleet_score_program(
+def _fleet_score_core(
     module,
     scaler_classes,
     mode,
@@ -91,6 +84,53 @@ def _fleet_score_program(
             agg_thresholds[:, None], 1e-12
         )
     return out
+
+
+_STATIC_ARGS = (
+    "module", "scaler_classes", "mode", "lookback", "det_cls",
+    "with_thresholds", "smooth_window",
+)
+
+_fleet_score_program = partial(jax.jit, static_argnames=_STATIC_ARGS)(
+    _fleet_score_core
+)
+
+
+@partial(jax.jit, static_argnames=_STATIC_ARGS)
+def _fleet_score_subset_program(
+    module,
+    scaler_classes,
+    mode,
+    lookback,
+    det_cls,
+    with_thresholds,
+    smooth_window,
+    scaler_stats,
+    params,
+    det_stats,
+    agg_thresholds,
+    idx,             # (m_sub,) int32 positions into the stacked machine axis
+    X,               # (m_sub, N, F)
+):
+    """Score a SUBSET of a bucket's machines: gather their stacked slots on
+    device, then run the same fused program at the subset size.
+
+    ``idx`` is a traced array, so which machines are requested never
+    recompiles — only the subset SIZE does, and callers pad that to a power
+    of two.  This is what makes small coalesced rounds cheap: an 8-machine
+    dispatch against a 512-machine bucket computes (and transfers back)
+    8 slots, not 512.
+    """
+    take = lambda t: jax.tree.map(lambda a: a[idx], t)  # noqa: E731
+    return _fleet_score_core(
+        module, scaler_classes, mode, lookback, det_cls, with_thresholds,
+        smooth_window,
+        take(scaler_stats),
+        take(params),
+        take(det_stats),
+        None if agg_thresholds is None else agg_thresholds[idx],
+        X,
+    )
 
 
 class _Bucket:
@@ -153,11 +193,20 @@ class _Bucket:
         self.n_features = (
             int(det_leaves[0].shape[-1]) if det_leaves else None
         )
-        #: pinned host stacking buffer, reused across score_all calls while
-        #: the (rows, features) request shape repeats; guarded by _lock —
-        #: concurrent bulk requests run score_all from executor threads
-        self._stack_buf: Optional[np.ndarray] = None
+        #: pinned host stacking buffers keyed by (machines, rows, features),
+        #: reused across score_all calls while request shapes repeat (shapes
+        #: are power-of-two bucketed, so the dict stays tiny); guarded by
+        #: _lock — concurrent bulk requests run score_all from executor
+        #: threads
+        self._stack_bufs: Dict[Tuple[int, int, int], np.ndarray] = {}
         self._lock = threading.Lock()
+
+    def stack_buffer(self, shape: Tuple[int, int, int]) -> np.ndarray:
+        """Pinned stacking buffer for ``shape`` (call with ``_lock`` held)."""
+        buf = self._stack_bufs.get(shape)
+        if buf is None:
+            buf = self._stack_bufs[shape] = np.empty(shape, np.float32)
+        return buf
 
     def score(self, X_stack: np.ndarray) -> Dict[str, np.ndarray]:
         return _fleet_score_program(
@@ -172,6 +221,25 @@ class _Bucket:
             self.params,
             self.det_stats,
             self.agg_thresholds,
+            jnp.asarray(X_stack, jnp.float32),
+        )
+
+    def score_subset(
+        self, X_stack: np.ndarray, idx: np.ndarray
+    ) -> Dict[str, np.ndarray]:
+        return _fleet_score_subset_program(
+            self.module,
+            self.scaler_classes,
+            self.mode,
+            self.lookback,
+            self.det_cls,
+            self.with_thresholds,
+            self.smooth_window,
+            self.scaler_stats,
+            self.params,
+            self.det_stats,
+            self.agg_thresholds,
+            jnp.asarray(idx, jnp.int32),
             jnp.asarray(X_stack, jnp.float32),
         )
 
@@ -299,9 +367,20 @@ class FleetScorer:
             arrays = {n: np.asarray(X_by_name[n], np.float32) for n in wanted}
             n_rows = _bucket_rows(max(a.shape[0] for a in arrays.values()))
             n_feat = next(iter(arrays.values())).shape[1]
+            # A request covering only part of the bucket dispatches at the
+            # SUBSET size (padded to a power of two so the jit cache stays
+            # log-sized): compute and device->host transfer scale with the
+            # machines actually requested, not the bucket's resident count.
+            # This is what keeps coalesced rounds (~8 machines of a 64+
+            # bucket) from paying full-bucket cost per dispatch.
+            n_bucket = len(bucket.names)
+            pos = [self.machine_bucket[n][1] for n in wanted]
+            m_sub = 1 << (len(pos) - 1).bit_length()
+            subset = m_sub < n_bucket
+            m_eff = m_sub if subset else n_bucket
             if (
                 bucket.smooth_window
-                and len(bucket.names) * n_rows * bucket.smooth_window * n_feat
+                and m_eff * n_rows * bucket.smooth_window * n_feat
                 > SMOOTH_ELEMENT_BOUND
             ):
                 # smoothing windows tensor would blow device memory at this
@@ -322,11 +401,7 @@ class FleetScorer:
                             "client-error": isinstance(exc, ValueError),
                         }
                 continue
-            # build (M, n_rows, F) in bucket.names order: requested machines
-            # get repeat-last row padding; absent slots score a dummy copy
-            # whose output is discarded
-            spare = next(iter(arrays.values()))
-            # reuse the pinned stacking buffer while the shape repeats (the
+            # reuse the pinned stacking buffer while shapes repeat (the
             # replayed-stream case).  The lock spans stack -> dispatch ->
             # device_get: concurrent bulk requests score from executor
             # threads, and an unguarded shared buffer would let one
@@ -334,38 +409,59 @@ class FleetScorer:
             # through the dispatch costs nothing — the device serializes
             # same-bucket programs anyway.
             with bucket._lock:
-                stacked = bucket._stack_buf
-                if stacked is None or stacked.shape != (
-                    len(bucket.names), n_rows, n_feat,
-                ):
-                    stacked = bucket._stack_buf = np.empty(
-                        (len(bucket.names), n_rows, n_feat), np.float32
+                if subset:
+                    # slot i holds wanted[i]'s rows; padding slots repeat
+                    # slot 0 (their outputs are discarded).  idx is traced,
+                    # so machine choice never recompiles — only m_sub does.
+                    idx = np.asarray(
+                        pos + [pos[0]] * (m_sub - len(pos)), np.int32
                     )
-                for pos, name in enumerate(bucket.names):
-                    a = arrays.get(name, spare)
-                    stacked[pos, : a.shape[0]] = a
-                    stacked[pos, a.shape[0]:] = a[-1:]
-                # ONE device->host transfer per output array; slicing per
-                # machine afterwards is pure numpy (per-machine indexing of
-                # device arrays would issue hundreds of tiny transfers)
-                out = jax.device_get(bucket.score(stacked))
+                    stacked = bucket.stack_buffer((m_sub, n_rows, n_feat))
+                    for i, name in enumerate(wanted):
+                        a = arrays[name]
+                        stacked[i, : a.shape[0]] = a
+                        stacked[i, a.shape[0]:] = a[-1:]
+                    stacked[len(wanted): m_sub] = stacked[0]
+                    out = jax.device_get(bucket.score_subset(stacked, idx))
+                    slot_of = {n: i for i, n in enumerate(wanted)}
+                else:
+                    # full-bucket dispatch in bucket.names order: requested
+                    # machines get repeat-last row padding; absent slots
+                    # score a dummy copy whose output is discarded
+                    spare = next(iter(arrays.values()))
+                    stacked = bucket.stack_buffer(
+                        (n_bucket, n_rows, n_feat)
+                    )
+                    for i, name in enumerate(bucket.names):
+                        a = arrays.get(name, spare)
+                        stacked[i, : a.shape[0]] = a
+                        stacked[i, a.shape[0]:] = a[-1:]
+                    # ONE device->host transfer per output array; slicing
+                    # per machine afterwards is pure numpy (per-machine
+                    # indexing of device arrays would issue hundreds of
+                    # tiny transfers)
+                    out = jax.device_get(bucket.score(stacked))
+                    slot_of = {
+                        n: self.machine_bucket[n][1] for n in wanted
+                    }
             offset_rows = (
                 bucket.lookback - 1
                 if bucket.mode == "ae"
                 else bucket.lookback if bucket.mode == "forecast" else 0
             )
             for name in wanted:
-                _, pos = self.machine_bucket[name]
+                slot = slot_of[name]
+                stack_pos = self.machine_bucket[name][1]
                 n_valid = arrays[name].shape[0] - offset_rows
                 res = {
-                    k: np.asarray(v[pos])[:n_valid] for k, v in out.items()
+                    k: np.asarray(v[slot])[:n_valid] for k, v in out.items()
                 }
                 if bucket.with_thresholds:
                     res["tag-anomaly-thresholds"] = bucket.thresholds_np[
-                        pos
+                        stack_pos
                     ].copy()
                     res["total-anomaly-threshold"] = float(
-                        bucket.agg_thresholds_np[pos]
+                        bucket.agg_thresholds_np[stack_pos]
                     )
                 results[name] = res
 

@@ -92,6 +92,45 @@ class TestFleetScorer:
         assert sorted(out) == names
         assert out[names[0]]["model-output"].shape == (10, 3)
 
+    def test_subset_dispatch_matches_per_machine(self, models):
+        """Partial-bucket requests ride the gathered subset program (not a
+        dummy-padded full-bucket dispatch); results must still match each
+        machine's own scorer exactly, for any machine positions, with full
+        and subset shapes alternating over the same bucket."""
+        scorer = FleetScorer.from_models(models[0])
+        rng = np.random.default_rng(9)
+        names = sorted(models[0])
+        full = {
+            n: rng.standard_normal((24, 3)).astype(np.float32) for n in names
+        }
+        scorer.score_all(full)  # warm the full-bucket path first
+        for subset_names in ([names[2]], [names[3], names[1]]):
+            X_by = {
+                n: rng.standard_normal((24, 3)).astype(np.float32)
+                for n in subset_names
+            }
+            out = scorer.score_all(X_by)
+            assert sorted(out) == sorted(subset_names)
+            for n in subset_names:
+                single = CompiledScorer(models[0][n]).anomaly_arrays(X_by[n])
+                for key in ("model-output", "tag-anomaly-scores",
+                            "total-anomaly-score", "anomaly-confidence"):
+                    np.testing.assert_allclose(
+                        out[n][key], single[key], rtol=1e-5, atol=1e-6,
+                        err_msg=f"{n}/{key}",
+                    )
+                assert out[n]["total-anomaly-threshold"] == pytest.approx(
+                    single["total-anomaly-threshold"]
+                )
+        # full-bucket calls still exact after subset calls reused buffers
+        again = scorer.score_all(full)
+        for n in names:
+            single = CompiledScorer(models[0][n]).anomaly_arrays(full[n])
+            np.testing.assert_allclose(
+                again[n]["total-anomaly-score"],
+                single["total-anomaly-score"], rtol=1e-5, atol=1e-6,
+            )
+
 
 def test_bulk_route(models):
     model_dir = models[1]
