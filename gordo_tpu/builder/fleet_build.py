@@ -52,6 +52,9 @@ logger = logging.getLogger(__name__)
 
 #: fleet programs are chunked so a bucket's stacked arrays stay well inside
 #: device memory (tiny models: the data, not the params, is the footprint).
+#: Hardware sweep (v5e via tunnel, r4, 512 ff machines): warm build rate is
+#: 131k models/h at 128, 188k at 256, 184k at 512 — flat at >=256, so 512
+#: stands (fewer chunks per big project at the same rate).
 DEFAULT_MAX_BUCKET = 512
 
 

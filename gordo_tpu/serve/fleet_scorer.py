@@ -16,6 +16,7 @@ the replayed-stream benchmark (BASELINE config 5).
 from __future__ import annotations
 
 import threading
+from collections import OrderedDict
 from functools import partial
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -34,7 +35,10 @@ from gordo_tpu.serve.scorer import (
 )
 
 #: same device-memory bound as CompiledScorer's smoothing guard (elements of
-#: the rolling-median windows tensor), applied across the stacked machine axis
+#: the rolling-median windows tensor), applied across the stacked machine
+#: axis.  Hardware probe (v5e via tunnel, r4, guard disabled): 2^27.5
+#: elements still scores (1.36s/call), 2^28.5 kills the XLA compile — the
+#: bound sits just under the measured cliff with <2x headroom.
 SMOOTH_ELEMENT_BOUND = 2 ** 27
 
 
@@ -194,18 +198,38 @@ class _Bucket:
             int(det_leaves[0].shape[-1]) if det_leaves else None
         )
         #: pinned host stacking buffers keyed by (machines, rows, features),
-        #: reused across score_all calls while request shapes repeat (shapes
-        #: are power-of-two bucketed, so the dict stays tiny); guarded by
-        #: _lock — concurrent bulk requests run score_all from executor
-        #: threads
-        self._stack_bufs: Dict[Tuple[int, int, int], np.ndarray] = {}
+        #: reused across score_all calls while request shapes repeat;
+        #: LRU-bounded so a long-lived server with varied request shapes
+        #: can't accumulate unbounded host memory; guarded by _lock —
+        #: concurrent bulk requests run score_all from executor threads
+        self._stack_bufs: "OrderedDict[Tuple[int, int, int], np.ndarray]" = (
+            OrderedDict()
+        )
         self._lock = threading.Lock()
+
+    #: max retained stacking buffers per bucket (power-of-two shape
+    #: bucketing keeps distinct shapes few; 4 covers a steady mix of bulk +
+    #: coalesced sizes while bounding worst-case host residency)
+    MAX_STACK_BUFS = 4
+
+    @staticmethod
+    def fill_slot(stacked: np.ndarray, i: int, a: np.ndarray) -> None:
+        """Write machine rows into dispatch slot ``i`` with repeat-last row
+        padding — the ONE padding scheme both subset and full-bucket
+        dispatches must share (divergence would make partial- and
+        full-bucket results differ for the same machine)."""
+        stacked[i, : a.shape[0]] = a
+        stacked[i, a.shape[0]:] = a[-1:]
 
     def stack_buffer(self, shape: Tuple[int, int, int]) -> np.ndarray:
         """Pinned stacking buffer for ``shape`` (call with ``_lock`` held)."""
         buf = self._stack_bufs.get(shape)
         if buf is None:
             buf = self._stack_bufs[shape] = np.empty(shape, np.float32)
+            while len(self._stack_bufs) > self.MAX_STACK_BUFS:
+                self._stack_bufs.popitem(last=False)
+        else:
+            self._stack_bufs.move_to_end(shape)
         return buf
 
     def score(self, X_stack: np.ndarray) -> Dict[str, np.ndarray]:
@@ -323,7 +347,9 @@ class FleetScorer:
             wanted = [n for n in bucket.names if n in X_by_name]
             if not wanted:
                 continue
-            offset_check = (
+            # rows a windowed model consumes: validation bound AND output
+            # slicing offset (one expression — they must never diverge)
+            offset_rows = (
                 bucket.lookback - 1
                 if bucket.mode == "ae"
                 else bucket.lookback if bucket.mode == "forecast" else 0
@@ -341,10 +367,10 @@ class FleetScorer:
                         ),
                         "client-error": True,
                     }
-                elif arr.shape[0] <= offset_check:
+                elif arr.shape[0] <= offset_rows:
                     results[n] = {
                         "error": short_rows_message(
-                            offset_check, arr.shape[0]
+                            offset_rows, arr.shape[0]
                         ),
                         "client-error": True,
                     }
@@ -374,96 +400,108 @@ class FleetScorer:
             # This is what keeps coalesced rounds (~8 machines of a 64+
             # bucket) from paying full-bucket cost per dispatch.
             n_bucket = len(bucket.names)
-            pos = [self.machine_bucket[n][1] for n in wanted]
-            m_sub = 1 << (len(pos) - 1).bit_length()
-            subset = m_sub < n_bucket
-            m_eff = m_sub if subset else n_bucket
-            if (
-                bucket.smooth_window
-                and m_eff * n_rows * bucket.smooth_window * n_feat
-                > SMOOTH_ELEMENT_BOUND
-            ):
-                # smoothing windows tensor would blow device memory at this
-                # stacked size — score these machines individually (the
-                # per-machine scorer has its own memory guard + host
-                # fallback)
-                for n in wanted:
-                    try:
-                        results[n] = self._machine_scorer(n).anomaly_arrays(
-                            arrays[n]
+            m_full = 1 << (len(wanted) - 1).bit_length()
+            m_eff = m_full if m_full < n_bucket else n_bucket
+            chunks = [wanted]
+            if bucket.smooth_window:
+                per_machine_elems = n_rows * bucket.smooth_window * n_feat
+                if per_machine_elems > SMOOTH_ELEMENT_BOUND:
+                    # ONE machine's windows tensor alone blows device memory
+                    # — score each through its own scorer (which has its own
+                    # memory guard + host fallback)
+                    for n in wanted:
+                        try:
+                            results[n] = self._machine_scorer(
+                                n
+                            ).anomaly_arrays(arrays[n])
+                        except Exception as exc:
+                            # same per-machine isolation as the fallbacks
+                            # loop: one machine's model-internal error must
+                            # not 500 the whole bulk request
+                            results[n] = {
+                                "error": str(exc),
+                                "client-error": isinstance(exc, ValueError),
+                            }
+                    continue
+                if m_eff * per_machine_elems > SMOOTH_ELEMENT_BOUND:
+                    # the windows tensor at the full dispatch size would
+                    # blow device memory — split the MACHINE axis into
+                    # bound-respecting subset dispatches instead of falling
+                    # back to sequential per-machine scoring (which costs a
+                    # full ~230ms dispatch round-trip per machine over the
+                    # tunnel)
+                    cap = 1 << (
+                        (SMOOTH_ELEMENT_BOUND // per_machine_elems)
+                        .bit_length() - 1
+                    )
+                    chunks = [
+                        wanted[i: i + cap]
+                        for i in range(0, len(wanted), cap)
+                    ]
+            for chunk in chunks:
+                pos = [self.machine_bucket[n][1] for n in chunk]
+                m_sub = 1 << (len(pos) - 1).bit_length()
+                subset = m_sub < n_bucket
+                # reuse the pinned stacking buffer while shapes repeat (the
+                # replayed-stream case).  The lock spans stack -> dispatch
+                # -> device_get: concurrent bulk requests score from
+                # executor threads, and an unguarded shared buffer would
+                # let one request's rows overwrite another's mid-transfer.
+                # Holding it through the dispatch costs nothing — the
+                # device serializes same-bucket programs anyway.
+                with bucket._lock:
+                    if subset:
+                        # slot i holds chunk[i]'s rows; padding slots
+                        # repeat slot 0 (their outputs are discarded).  idx
+                        # is traced, so machine choice never recompiles —
+                        # only m_sub does.
+                        idx = np.asarray(
+                            pos + [pos[0]] * (m_sub - len(pos)), np.int32
                         )
-                    except Exception as exc:
-                        # same per-machine isolation as the fallbacks loop:
-                        # one machine's model-internal error must not 500
-                        # the whole bulk request
-                        results[n] = {
-                            "error": str(exc),
-                            "client-error": isinstance(exc, ValueError),
-                        }
-                continue
-            # reuse the pinned stacking buffer while shapes repeat (the
-            # replayed-stream case).  The lock spans stack -> dispatch ->
-            # device_get: concurrent bulk requests score from executor
-            # threads, and an unguarded shared buffer would let one
-            # request's rows overwrite another's mid-transfer.  Holding it
-            # through the dispatch costs nothing — the device serializes
-            # same-bucket programs anyway.
-            with bucket._lock:
-                if subset:
-                    # slot i holds wanted[i]'s rows; padding slots repeat
-                    # slot 0 (their outputs are discarded).  idx is traced,
-                    # so machine choice never recompiles — only m_sub does.
-                    idx = np.asarray(
-                        pos + [pos[0]] * (m_sub - len(pos)), np.int32
-                    )
-                    stacked = bucket.stack_buffer((m_sub, n_rows, n_feat))
-                    for i, name in enumerate(wanted):
-                        a = arrays[name]
-                        stacked[i, : a.shape[0]] = a
-                        stacked[i, a.shape[0]:] = a[-1:]
-                    stacked[len(wanted): m_sub] = stacked[0]
-                    out = jax.device_get(bucket.score_subset(stacked, idx))
-                    slot_of = {n: i for i, n in enumerate(wanted)}
-                else:
-                    # full-bucket dispatch in bucket.names order: requested
-                    # machines get repeat-last row padding; absent slots
-                    # score a dummy copy whose output is discarded
-                    spare = next(iter(arrays.values()))
-                    stacked = bucket.stack_buffer(
-                        (n_bucket, n_rows, n_feat)
-                    )
-                    for i, name in enumerate(bucket.names):
-                        a = arrays.get(name, spare)
-                        stacked[i, : a.shape[0]] = a
-                        stacked[i, a.shape[0]:] = a[-1:]
-                    # ONE device->host transfer per output array; slicing
-                    # per machine afterwards is pure numpy (per-machine
-                    # indexing of device arrays would issue hundreds of
-                    # tiny transfers)
-                    out = jax.device_get(bucket.score(stacked))
-                    slot_of = {
-                        n: self.machine_bucket[n][1] for n in wanted
+                        stacked = bucket.stack_buffer(
+                            (m_sub, n_rows, n_feat)
+                        )
+                        for i, name in enumerate(chunk):
+                            bucket.fill_slot(stacked, i, arrays[name])
+                        stacked[len(chunk): m_sub] = stacked[0]
+                        out = jax.device_get(
+                            bucket.score_subset(stacked, idx)
+                        )
+                        slot_of = {n: i for i, n in enumerate(chunk)}
+                    else:
+                        # full-bucket dispatch in bucket.names order:
+                        # requested machines get repeat-last row padding;
+                        # absent slots score a dummy copy whose output is
+                        # discarded
+                        spare = next(iter(arrays.values()))
+                        stacked = bucket.stack_buffer(
+                            (n_bucket, n_rows, n_feat)
+                        )
+                        for i, name in enumerate(bucket.names):
+                            bucket.fill_slot(stacked, i, arrays.get(name, spare))
+                        # ONE device->host transfer per output array;
+                        # slicing per machine afterwards is pure numpy
+                        # (per-machine indexing of device arrays would
+                        # issue hundreds of tiny transfers)
+                        out = jax.device_get(bucket.score(stacked))
+                        # full dispatch: output slots ARE stack positions
+                        slot_of = None
+                for name in chunk:
+                    stack_pos = self.machine_bucket[name][1]
+                    slot = stack_pos if slot_of is None else slot_of[name]
+                    n_valid = arrays[name].shape[0] - offset_rows
+                    res = {
+                        k: np.asarray(v[slot])[:n_valid]
+                        for k, v in out.items()
                     }
-            offset_rows = (
-                bucket.lookback - 1
-                if bucket.mode == "ae"
-                else bucket.lookback if bucket.mode == "forecast" else 0
-            )
-            for name in wanted:
-                slot = slot_of[name]
-                stack_pos = self.machine_bucket[name][1]
-                n_valid = arrays[name].shape[0] - offset_rows
-                res = {
-                    k: np.asarray(v[slot])[:n_valid] for k, v in out.items()
-                }
-                if bucket.with_thresholds:
-                    res["tag-anomaly-thresholds"] = bucket.thresholds_np[
-                        stack_pos
-                    ].copy()
-                    res["total-anomaly-threshold"] = float(
-                        bucket.agg_thresholds_np[stack_pos]
-                    )
-                results[name] = res
+                    if bucket.with_thresholds:
+                        res["tag-anomaly-thresholds"] = bucket.thresholds_np[
+                            stack_pos
+                        ].copy()
+                        res["total-anomaly-threshold"] = float(
+                            bucket.agg_thresholds_np[stack_pos]
+                        )
+                    results[name] = res
 
         for name, scorer in self.fallbacks.items():
             if name in X_by_name:

@@ -122,6 +122,11 @@ class TestFleetScorer:
                 assert out[n]["total-anomaly-threshold"] == pytest.approx(
                     single["total-anomaly-threshold"]
                 )
+        # the subset PROGRAM must actually have run (not the dummy-padded
+        # full-bucket path): subset-sized stacking buffers prove the route
+        bucket = scorer.buckets[0]
+        machine_dims = {shape[0] for shape in bucket._stack_bufs}
+        assert {1, 2, len(bucket.names)} <= machine_dims
         # full-bucket calls still exact after subset calls reused buffers
         again = scorer.score_all(full)
         for n in names:
@@ -277,6 +282,56 @@ def test_lstm_machines_stack_and_match_per_machine_scorer():
             np.testing.assert_allclose(
                 bulk[name][key], single[key], rtol=1e-5, atol=1e-6,
                 err_msg=f"{name}/{key}",
+            )
+
+
+def test_smoothing_bound_chunks_machine_axis(monkeypatch):
+    """When the smoothing windows tensor would exceed the device-memory
+    bound at the full dispatch size, score_all must split the MACHINE axis
+    into bound-respecting subset dispatches (not degrade to sequential
+    per-machine scoring) and still match each machine's own scorer."""
+    import gordo_tpu.serve.fleet_scorer as fs_mod
+    from gordo_tpu.anomaly.diff import DiffBasedAnomalyDetector
+    from gordo_tpu.models.estimator import AutoEncoder
+    from gordo_tpu.ops.scalers import MinMaxScaler
+    from gordo_tpu.pipeline import Pipeline
+
+    rng = np.random.default_rng(6)
+    window = 4
+    dets = {}
+    for i in range(4):
+        X_train = rng.standard_normal((120, 3)).astype(np.float32)
+        det = DiffBasedAnomalyDetector(
+            base_estimator=Pipeline(
+                [MinMaxScaler(), AutoEncoder(epochs=1, batch_size=64)]
+            ),
+            window=window,
+        )
+        det.cross_validate(X_train)
+        det.fit(X_train)
+        dets[f"sm-{i}"] = det
+
+    scorer = FleetScorer.from_models(dets)
+    assert scorer.n_stacked == 4 and len(scorer.buckets) == 1
+    X_by = {
+        n: rng.standard_normal((40, 3)).astype(np.float32) for n in dets
+    }
+    # rows pad to a bucket; allow exactly 2 machines' windows tensors per
+    # dispatch -> the 4-machine request must split into 2 subset dispatches
+    from gordo_tpu.serve.scorer import _bucket_rows
+    per_machine = _bucket_rows(40) * window * 3
+    monkeypatch.setattr(fs_mod, "SMOOTH_ELEMENT_BOUND", 2 * per_machine)
+    out = scorer.score_all(X_by)
+    bucket = scorer.buckets[0]
+    machine_dims = {shape[0] for shape in bucket._stack_bufs}
+    assert machine_dims == {2}, machine_dims  # chunked, never full-size
+    for n, det in dets.items():
+        single = CompiledScorer(det).anomaly_arrays(X_by[n])
+        for key in ("model-output", "tag-anomaly-scores",
+                    "total-anomaly-score", "anomaly-confidence"):
+            np.testing.assert_allclose(
+                out[n][key], single[key], rtol=1e-5, atol=1e-6,
+                err_msg=f"{n}/{key}",
             )
 
 
