@@ -426,9 +426,68 @@ def init_devices_bounded():
     return box["devices"]
 
 
+def run_stage_bounded(
+    name: str, fn, out: dict, budget_s: float
+) -> bool:
+    """Run one bench stage in a side thread with its own time budget.
+
+    The TPU tunnel's observed failure mode mid-bench is an INDEFINITE block
+    inside a device transfer (r4: the serving stage wedged after the builds
+    finished, and the global watchdog threw away 3 whole stages' remaining
+    budget waiting on it).  A stage that exceeds its budget is abandoned
+    (its daemon thread may stay blocked on the wedged grant) and the next
+    stage gets its chance; every stage writes its fields into ``out``
+    incrementally, so whatever finished is in the emitted line either way.
+    """
+    if budget_s <= 0:
+        out.setdefault("error", f"{name} stage skipped: no budget left")
+        log(f"stage {name}: skipped (no budget left)")
+        return False
+    box: dict = {}
+
+    def target():
+        try:
+            fn()
+        except Exception as exc:
+            box["error"] = exc
+
+    t = threading.Thread(
+        target=target, name=f"bench-{name}", daemon=True
+    )
+    t.start()
+    t.join(budget_s)
+    if t.is_alive():
+        out.setdefault(
+            "error",
+            f"{name} stage exceeded {budget_s:.0f}s (tunnel wedge?)",
+        )
+        # the thread cannot be cancelled; if it is slow rather than wedged
+        # it keeps running and CONTENDS with later stages — record that so
+        # numbers measured after an abandonment are read as suspect
+        out.setdefault("stages_abandoned", []).append(name)
+        log(f"stage {name}: abandoned after {budget_s:.0f}s")
+        return False
+    if "error" in box:
+        log(f"stage {name} failed: {box['error']!r}")
+        out.setdefault("error", f"{name}: {box['error']}")
+        return False
+    return True
+
+
 def main() -> None:
     """Run each bench stage independently; ALWAYS print exactly one JSON
-    line, even on failure (a diagnostic record instead of a dead rc=1)."""
+    line, even on failure (a diagnostic record instead of a dead rc=1).
+
+    Stage order tracks metric priority: the build headline first, the
+    serving headline second, LSTM scenario third — a mid-run tunnel wedge
+    costs the LEAST important remaining numbers, and each stage runs under
+    its own budget so one stuck transfer can't starve the rest.
+    """
+    t_start = time.monotonic()
+
+    def remaining() -> float:
+        return DEADLINE_S - (time.monotonic() - t_start)
+
     out: dict = {
         "metric": "per-tag anomaly-detector builds/hour/chip (full build path)",
         "value": None,
@@ -451,30 +510,33 @@ def main() -> None:
     out["platform"] = devices[0].platform
     mesh = fleet_mesh(devices) if n_chips > 1 else None
 
-    try:
+    def build_stage():
         models_per_hour = bench_build(mesh, out)
         per_chip = models_per_hour / n_chips
         out["value"] = round(per_chip, 1)
         out["vs_baseline"] = round(
             per_chip / NORTH_STAR_MODELS_PER_HOUR_PER_CHIP, 3
         )
-    except Exception as exc:
-        log(f"build bench failed: {exc!r}")
-        out["error"] = f"build bench: {exc}"
 
-    try:
-        bench_lstm_build(mesh, out)
-    except Exception as exc:
-        log(f"lstm bench failed: {exc!r}")
-        out.setdefault("error", f"lstm bench: {exc}")
-
-    try:
-        bench_serving(out)
-    except Exception as exc:  # serving is the secondary metric
-        log(f"serving bench failed: {exc!r}")
-        out.setdefault("error", f"serving bench: {exc}")
+    # proportional budgets (not fixed offsets): whatever DEADLINE_S is,
+    # the headline build stage gets the largest share of what's left at
+    # its turn, and a short operator-set deadline shrinks every stage
+    # instead of silently skipping the most important one
+    run_stage_bounded("build", build_stage, out, remaining() * 0.6)
+    run_stage_bounded(
+        "serving", lambda: bench_serving(out), out,
+        min(remaining() * 0.7, 480),
+    )
+    run_stage_bounded(
+        "lstm", lambda: bench_lstm_build(mesh, out), out, remaining() - 30
+    )
 
     emit_once(out)
+    # abandoned stage threads may still be blocked on a wedged device
+    # grant; a plain return would hang interpreter shutdown on their jax
+    # finalizers
+    sys.stdout.flush()
+    os._exit(0)
 
 
 if __name__ == "__main__":

@@ -11,6 +11,7 @@ the builder moves to device once; nothing here runs under jit.
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Dict, List, Optional, Tuple, Union
 
 import numpy as np
@@ -61,6 +62,35 @@ def _summary_statistics(data: pd.DataFrame) -> Dict[str, Dict[str, float]]:
         }
         for i, c in enumerate(cols)
     }
+
+
+def _bin_label_index(
+    origin: int, first_bin: int, last_bin: int, nanos: int, name
+) -> pd.DatetimeIndex:
+    """Resample-output label index, cached: machines in a fleet share the
+    train period and resolution, so the (identical) tz-aware label grid was
+    being rebuilt per tag per machine — about half of the vectorized
+    resample's remaining cost."""
+    key = (origin, first_bin, last_bin, nanos, name)
+    cached = _bin_label_index._cache.get(key)
+    if cached is None:
+        label_ns = origin + np.arange(first_bin, last_bin + 1) * nanos
+        cached = pd.DatetimeIndex(
+            label_ns.view("datetime64[ns]"), name=name
+        ).tz_localize("UTC")
+        # loader-pool threads share this cache; the lock only guards the
+        # bounded-size eviction (reads stay lock-free)
+        with _bin_label_index._lock:
+            if len(_bin_label_index._cache) >= 32:
+                _bin_label_index._cache.pop(
+                    next(iter(_bin_label_index._cache)), None
+                )
+            _bin_label_index._cache[key] = cached
+    return cached
+
+
+_bin_label_index._cache = {}
+_bin_label_index._lock = threading.Lock()
 
 
 def _to_timestamp(value) -> pd.Timestamp:
@@ -176,10 +206,9 @@ class TimeSeriesDataset(GordoBaseDataset):
         # labels, and metadata match the pandas path exactly
         grid = np.full(int(bins[-1] - bins[0]) + 1, np.nan)
         grid[(bins[starts] - bins[0]).astype(np.int64)] = means
-        label_ns = origin + np.arange(bins[0], bins[-1] + 1) * nanos
-        index = pd.DatetimeIndex(
-            label_ns.view("datetime64[ns]"), name=series.index.name
-        ).tz_localize("UTC")
+        index = _bin_label_index(
+            origin, int(bins[0]), int(bins[-1]), nanos, series.index.name
+        )
         return pd.Series(grid, index=index, name=series.name)
 
     def _join_timeseries(self, series_iter) -> pd.DataFrame:
@@ -197,7 +226,26 @@ class TimeSeriesDataset(GordoBaseDataset):
                 "original_length": int(raw_len),
                 "resampled_length": int(len(agg)),
             }
-        joined = pd.concat(frames, axis=1, join="inner").dropna()
+        if (
+            len(frames) > 1
+            and all(
+                isinstance(f, pd.Series) and f.dtype == np.float64
+                for f in frames
+            )
+            and all(f.index.equals(frames[0].index) for f in frames[1:])
+        ):
+            # identical indexes (regular-grid case — guaranteed when tags
+            # share a provider period and the label-index cache hits): skip
+            # concat's alignment machinery and build the matrix directly
+            # (measured ~4x on the fleet-build hot path; inner join over
+            # equal indexes is the identity)
+            joined = pd.DataFrame(
+                np.column_stack([f.to_numpy() for f in frames]),
+                index=frames[0].index,
+                columns=[f.name for f in frames],
+            ).dropna()
+        else:
+            joined = pd.concat(frames, axis=1, join="inner").dropna()
         self._metadata["tag_loading_metadata"] = metadata
         return joined
 
@@ -229,12 +277,24 @@ class TimeSeriesDataset(GordoBaseDataset):
         # them all (the reference behaves the same way).
         x_cols = [t.name for t in self.tag_list]
         y_cols = [t.name for t in self.target_tag_list]
-        X = data[x_cols] if all(c in data.columns for c in x_cols) else data
-        y = (
-            data[y_cols]
-            if all(c in data.columns for c in y_cols)
-            else X.copy()
-        )
+        cols = list(data.columns)
+        # already in config order (the normal case): skip the listlike
+        # reindex, which costs more than the rest of column selection
+        # combined on the fleet-build hot path
+        if cols == x_cols:
+            X = data
+        elif all(c in data.columns for c in x_cols):
+            X = data[x_cols]
+        else:
+            X = data
+        if y_cols == x_cols:
+            # autoencoder default (targets == inputs): reuse X — every
+            # consumer treats X and y as read-only (jax conversion copies)
+            y = X
+        elif all(c in data.columns for c in y_cols):
+            y = data[y_cols]
+        else:
+            y = X.copy()
 
         self._metadata.update(
             {
