@@ -440,6 +440,9 @@ def run_stage_bounded(
     incrementally, so whatever finished is in the emitted line either way.
     """
     if budget_s <= 0:
+        # machine-readable even when an earlier stage already claimed
+        # out["error"] (setdefault would no-op there)
+        out.setdefault("stages_skipped", []).append(name)
         out.setdefault("error", f"{name} stage skipped: no budget left")
         log(f"stage {name}: skipped (no budget left)")
         return False
@@ -449,6 +452,10 @@ def run_stage_bounded(
         try:
             fn()
         except Exception as exc:
+            # log immediately: if this stage was already abandoned, nobody
+            # reads box afterwards and the real cause (e.g. an OOM behind
+            # an apparent "wedge") would vanish
+            log(f"stage {name} raised: {exc!r}")
             box["error"] = exc
 
     t = threading.Thread(

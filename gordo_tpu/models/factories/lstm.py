@@ -24,18 +24,25 @@ import jax.numpy as jnp
 from gordo_tpu.models.factories.feedforward import (
     _broadcast_funcs,
     resolve_activation,
+    resolve_compute_dtype,
 )
 from gordo_tpu.models.factories.utils import hourglass_calc_dims
 from gordo_tpu.registry import register_model_builder
 
 
 class LSTMAutoEncoderModule(nn.Module):
-    """Stacked LSTM layers over the window, final-step dense head."""
+    """Stacked LSTM layers over the window, final-step dense head.
+
+    Recurrent compute runs in ``compute_dtype`` (bfloat16 by default —
+    MXU-native, same mixed-precision scheme as the feedforward modules)
+    with float32 params and a float32 output head.
+    """
 
     dims: Tuple[int, ...]
     funcs: Tuple[Union[str], ...]
     out_dim: int
     out_func: str = "linear"
+    compute_dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -43,10 +50,16 @@ class LSTMAutoEncoderModule(nn.Module):
         squeeze = x.ndim == 2
         if squeeze:  # single window
             x = x[None]
+        x = x.astype(self.compute_dtype)
         for i, (d, f) in enumerate(zip(self.dims, self.funcs)):
-            x = nn.RNN(nn.OptimizedLSTMCell(int(d)), name=f"lstm_{i}")(x)
+            x = nn.RNN(
+                nn.OptimizedLSTMCell(int(d), dtype=self.compute_dtype),
+                name=f"lstm_{i}",
+            )(x)
             x = resolve_activation(f)(x)
-        out = nn.Dense(self.out_dim, dtype=jnp.float32, name="out")(x[:, -1, :])
+        out = nn.Dense(self.out_dim, dtype=jnp.float32, name="out")(
+            x[:, -1, :].astype(jnp.float32)
+        )
         out = resolve_activation(self.out_func)(out)
         return out[0] if squeeze else out
 
@@ -61,12 +74,14 @@ def lstm_model(
     decoding_dim: Sequence[int] = (64, 128, 256),
     decoding_func: Sequence[str] = None,
     out_func: str = "linear",
+    compute_dtype: str = "auto",
     **_ignored,
 ) -> nn.Module:
     """Encoder/decoder LSTM stack (reference: ``lstm_autoencoder.lstm_model``).
 
     ``lookback_window`` is consumed by the estimator for windowing; the module
     itself handles any window length (scan over time axis).
+    ``compute_dtype="float32"`` opts out of mixed precision.
     """
     n_features_out = n_features_out or n_features
     enc = tuple(int(d) for d in encoding_dim)
@@ -79,6 +94,7 @@ def lstm_model(
         funcs=funcs,
         out_dim=int(n_features_out),
         out_func=out_func,
+        compute_dtype=resolve_compute_dtype(compute_dtype),
     )
 
 

@@ -86,3 +86,43 @@ def test_unknown_activation_raises():
     with pytest.raises(ValueError, match="Unknown activation"):
         mod = feedforward_model(4, encoding_dim=(4,), encoding_func=["nope"], decoding_dim=(4,))
         mod.init(jax.random.PRNGKey(0), jnp.zeros((1, 4)))
+
+
+def test_compute_dtype_auto_resolves_by_backend():
+    """"auto" is float32 on CPU (bf16 is emulated ~3x slower there) and
+    bfloat16 only on TPU; explicit names always win."""
+    from gordo_tpu.models.factories.feedforward import resolve_compute_dtype
+
+    assert resolve_compute_dtype("auto") == jnp.dtype(
+        jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    )
+    assert resolve_compute_dtype("bfloat16") == jnp.dtype(jnp.bfloat16)
+    assert resolve_compute_dtype("float32") == jnp.dtype(jnp.float32)
+
+
+def test_mixed_precision_modules_keep_f32_params_and_outputs():
+    """Explicit bfloat16 compute: params and outputs stay float32 (mixed
+    precision — bf16 is the matmul dtype, not the state dtype)."""
+    rng = jax.random.PRNGKey(1)
+    ff = feedforward_model(
+        6, encoding_dim=(8,), decoding_dim=(8,), compute_dtype="bfloat16"
+    )
+    x = jax.random.normal(rng, (4, 6))
+    params = ff.init(rng, x)["params"]
+    assert all(
+        p.dtype == jnp.float32 for p in jax.tree.leaves(params)
+    )
+    out = ff.apply({"params": params}, x)
+    assert out.dtype == jnp.float32 and bool(jnp.isfinite(out).all())
+
+    lstm = lstm_model(
+        5, lookback_window=4, encoding_dim=(8,), decoding_dim=(8,),
+        compute_dtype="bfloat16",
+    )
+    xw = jax.random.normal(rng, (3, 4, 5))
+    lparams = lstm.init(rng, xw)["params"]
+    assert all(
+        p.dtype == jnp.float32 for p in jax.tree.leaves(lparams)
+    )
+    lout = lstm.apply({"params": lparams}, xw)
+    assert lout.dtype == jnp.float32 and bool(jnp.isfinite(lout).all())
