@@ -190,8 +190,13 @@ def build_project_cmd(machine_config, project_name, output_dir,
                    "into stacked fleet dispatches, waiting up to this many "
                    "ms per request (0 disables). Big win under concurrent "
                    "load; adds up to the window in latency when idle.")
+@click.option("--model-parallel/--no-model-parallel", default=False,
+              show_default=True,
+              help="Shard stacked serving dispatches over ALL visible "
+                   "devices (the 'models' mesh axis): one server process "
+                   "drives a whole slice instead of one chip.")
 def run_server_cmd(model_dir, host, port, project, rescan_interval,
-                   coalesce_ms):
+                   coalesce_ms, model_parallel):
     """Serve model(s) over the /gordo/v0/<project>/<machine>/ routes."""
     from gordo_tpu.serve.server import run_server
 
@@ -199,6 +204,7 @@ def run_server_cmd(model_dir, host, port, project, rescan_interval,
         model_dir, host=host, port=port, project=project,
         rescan_interval=rescan_interval,
         coalesce_window_ms=coalesce_ms,
+        model_parallel=model_parallel,
     )
 
 

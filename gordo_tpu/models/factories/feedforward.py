@@ -85,7 +85,10 @@ class FeedForwardAutoEncoder(nn.Module):
     funcs: Tuple[Union[str, Callable], ...]
     out_dim: int
     out_func: Union[str, Callable, None] = "linear"
-    compute_dtype: jnp.dtype = jnp.bfloat16
+    #: class default is float32 — NOT bf16 — so artifacts pickled before
+    #: this field existed unpickle to exactly the numerics they trained and
+    #: calibrated thresholds with; factories always pass a resolved value
+    compute_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:

@@ -42,7 +42,10 @@ class LSTMAutoEncoderModule(nn.Module):
     funcs: Tuple[Union[str], ...]
     out_dim: int
     out_func: str = "linear"
-    compute_dtype: jnp.dtype = jnp.bfloat16
+    #: class default is float32 — NOT bf16 — so artifacts pickled before
+    #: this field existed unpickle to exactly the numerics they trained and
+    #: calibrated thresholds with; factories always pass a resolved value
+    compute_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:

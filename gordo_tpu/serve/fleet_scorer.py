@@ -138,9 +138,22 @@ def _fleet_score_subset_program(
 
 
 class _Bucket:
-    """One structurally identical group of machines, params stacked."""
+    """One structurally identical group of machines, params stacked.
 
-    def __init__(self, names: List[str], chains: List[Dict[str, Any]]):
+    With ``mesh`` (a ``("models", "data")`` fleet mesh spanning >1 device),
+    the stacked machine axis is padded to a multiple of the model-shard
+    count and placed with a ``models``-axis ``NamedSharding`` — the fused
+    program is a pure map over machines, so XLA partitions one serving
+    dispatch across every chip with zero collectives.  This is the serving
+    twin of the fleet trainer's sharding (``parallel/fleet.py``).
+    """
+
+    def __init__(
+        self,
+        names: List[str],
+        chains: List[Dict[str, Any]],
+        mesh: Optional[Any] = None,
+    ):
         self.names = names
         c0 = chains[0]
         self.module = c0["module"]
@@ -197,6 +210,45 @@ class _Bucket:
         self.n_features = (
             int(det_leaves[0].shape[-1]) if det_leaves else None
         )
+
+        from gordo_tpu.parallel.mesh import MODEL_AXIS
+
+        self.mesh = (
+            mesh
+            if mesh is not None and mesh.shape.get(MODEL_AXIS, 1) > 1
+            else None
+        )
+        #: stacked machine-axis length on device (== len(names) without a
+        #: mesh; padded to a shard multiple with one)
+        self.m_pad = len(names)
+        if self.mesh is not None:
+            from gordo_tpu.parallel.mesh import (
+                model_sharding,
+                pad_to_multiple,
+            )
+
+            shards = self.mesh.shape[MODEL_AXIS]
+            self.m_pad = pad_to_multiple(len(names), shards)
+            pad = self.m_pad - len(names)
+
+            def shard(tree):
+                def one(a):
+                    if pad:
+                        a = jnp.concatenate(
+                            [a, jnp.repeat(a[:1], pad, axis=0)]
+                        )
+                    return jax.device_put(
+                        a, model_sharding(self.mesh, a.ndim - 1)
+                    )
+
+                return jax.tree.map(one, tree)
+
+            self.params = shard(self.params)
+            self.scaler_stats = shard(self.scaler_stats)
+            self.det_stats = shard(self.det_stats)
+            if self.agg_thresholds is not None:
+                self.agg_thresholds = shard(self.agg_thresholds)
+            self._x_sharding = model_sharding(self.mesh, 2)
         #: pinned host stacking buffers keyed by (machines, rows, features),
         #: reused across score_all calls while request shapes repeat;
         #: LRU-bounded so a long-lived server with varied request shapes
@@ -233,6 +285,17 @@ class _Bucket:
         return buf
 
     def score(self, X_stack: np.ndarray) -> Dict[str, np.ndarray]:
+        if self.mesh is not None:
+            # host array straight to its shards (committed sharding -> XLA
+            # partitions the whole fused program over the fleet axis, a
+            # pure map with no collectives); going via jnp.asarray first
+            # would stage the full array on device 0 and pay a second
+            # device-to-device scatter
+            X = jax.device_put(
+                np.asarray(X_stack, np.float32), self._x_sharding
+            )
+        else:
+            X = jnp.asarray(X_stack, jnp.float32)
         return _fleet_score_program(
             self.module,
             self.scaler_classes,
@@ -245,7 +308,7 @@ class _Bucket:
             self.params,
             self.det_stats,
             self.agg_thresholds,
-            jnp.asarray(X_stack, jnp.float32),
+            X,
         )
 
     def score_subset(
@@ -309,7 +372,13 @@ class FleetScorer:
         return self._machine_scorers[name]
 
     @classmethod
-    def from_models(cls, models: Dict[str, Any]) -> "FleetScorer":
+    def from_models(
+        cls, models: Dict[str, Any], mesh: Optional[Any] = None
+    ) -> "FleetScorer":
+        """``mesh``: optional ``("models", "data")`` fleet mesh; buckets
+        shard their stacked machine axis over it so one serving dispatch
+        spans every chip (single-device behavior is unchanged without it).
+        """
         self = cls()
         self.models = dict(models)
         groups: Dict[Tuple, Tuple[List[str], List[Dict]]] = {}
@@ -323,7 +392,7 @@ class FleetScorer:
             names.append(name)
             chains.append(chain)
         for names, chains in groups.values():
-            bucket = _Bucket(names, chains)
+            bucket = _Bucket(names, chains, mesh=mesh)
             idx = len(self.buckets)
             self.buckets.append(bucket)
             for pos, name in enumerate(names):
@@ -401,7 +470,17 @@ class FleetScorer:
             # bucket) from paying full-bucket cost per dispatch.
             n_bucket = len(bucket.names)
             m_full = 1 << (len(wanted) - 1).bit_length()
-            m_eff = m_full if m_full < n_bucket else n_bucket
+            if m_full < n_bucket:
+                m_eff = m_full  # subset dispatch (unsharded gather)
+            else:
+                # full dispatch; with a mesh the windows tensor shards
+                # along the machine axis, so the PER-DEVICE bound sees
+                # only each shard's machines
+                m_eff = bucket.m_pad
+                if bucket.mesh is not None:
+                    from gordo_tpu.parallel.mesh import MODEL_AXIS
+
+                    m_eff = -(-m_eff // bucket.mesh.shape[MODEL_AXIS])
             chunks = [wanted]
             if bucket.smooth_window:
                 per_machine_elems = n_rows * bucket.smooth_window * n_feat
@@ -471,14 +550,16 @@ class FleetScorer:
                     else:
                         # full-bucket dispatch in bucket.names order:
                         # requested machines get repeat-last row padding;
-                        # absent slots score a dummy copy whose output is
+                        # absent slots (and mesh shard-padding slots past
+                        # n_bucket) score a dummy copy whose output is
                         # discarded
                         spare = next(iter(arrays.values()))
                         stacked = bucket.stack_buffer(
-                            (n_bucket, n_rows, n_feat)
+                            (bucket.m_pad, n_rows, n_feat)
                         )
                         for i, name in enumerate(bucket.names):
                             bucket.fill_slot(stacked, i, arrays.get(name, spare))
+                        stacked[n_bucket: bucket.m_pad] = stacked[0]
                         # ONE device->host transfer per output array;
                         # slicing per machine afterwards is pure numpy
                         # (per-machine indexing of device arrays would

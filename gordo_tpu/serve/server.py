@@ -86,10 +86,14 @@ class ModelCollection:
         entries: Dict[str, ModelEntry],
         project: str = "project",
         source_dir: Optional[str] = None,
+        serve_mesh=None,
     ):
         self.entries = entries
         self.project = project
         self.source_dir = source_dir
+        #: optional ("models","data") fleet mesh: stacked serving dispatches
+        #: shard their machine axis over it (multi-chip serving)
+        self.serve_mesh = serve_mesh
         self._fleet_scorer = None
         # guards the (entries, _fleet_scorer) pair: the background rescan
         # swaps both from an executor thread while bulk requests lazily
@@ -104,7 +108,8 @@ class ModelCollection:
                 from gordo_tpu.serve.fleet_scorer import FleetScorer
 
                 self._fleet_scorer = FleetScorer.from_models(
-                    {name: e.model for name, e in self.entries.items()}
+                    {name: e.model for name, e in self.entries.items()},
+                    mesh=self.serve_mesh,
                 )
             return self._fleet_scorer
 
@@ -599,9 +604,33 @@ def run_server(
     project: str = "project",
     rescan_interval: float = 30.0,
     coalesce_window_ms: float = 0.0,
+    model_parallel: bool = False,
 ) -> None:
-    """Blocking entrypoint (reference: ``gordo run-server``)."""
+    """Blocking entrypoint (reference: ``gordo run-server``).
+
+    ``model_parallel=True`` shards every stacked serving dispatch over all
+    visible devices (the ``"models"`` mesh axis) — one server process
+    driving a whole slice instead of one chip.
+    """
     collection = ModelCollection.from_directory(model_dir, project=project)
+    if model_parallel:
+        import jax
+
+        from gordo_tpu.parallel.mesh import fleet_mesh
+
+        devices = jax.devices()
+        if len(devices) > 1:
+            collection.serve_mesh = fleet_mesh(devices)
+            logger.info(
+                "Model-parallel serving over %d devices", len(devices)
+            )
+        else:
+            logger.warning(
+                "--model-parallel requested but only 1 device is visible "
+                "(%s) — serving single-device; check the TPU runtime/"
+                "device visibility if a slice was expected",
+                devices[0].platform,
+            )
     logger.info(
         "Serving %d machine(s) from %s on %s:%d",
         len(collection.entries),

@@ -285,6 +285,51 @@ def test_lstm_machines_stack_and_match_per_machine_scorer():
             )
 
 
+def test_mesh_sharded_serving_matches_single_device(models):
+    """Multi-chip stacked serving: with a ("models","data") mesh the
+    bucket's machine axis is padded to a shard multiple, placed with a
+    models-axis NamedSharding, and one dispatch spans every device —
+    results must match the single-device scorer exactly."""
+    import jax
+    from gordo_tpu.parallel.mesh import MODEL_AXIS, fleet_mesh
+
+    mesh = fleet_mesh(jax.devices())  # conftest: 8 virtual CPU devices
+    assert mesh.shape[MODEL_AXIS] == 8
+    sharded = FleetScorer.from_models(models[0], mesh=mesh)
+    plain = FleetScorer.from_models(models[0])
+
+    bucket = sharded.buckets[0]
+    assert bucket.m_pad == 8  # 4 machines padded to the 8-way shard axis
+    leaf = jax.tree.leaves(bucket.params)[0]
+    assert leaf.shape[0] == 8
+    assert MODEL_AXIS in str(leaf.sharding.spec)
+
+    rng = np.random.default_rng(13)
+    X_by = {
+        name: rng.standard_normal((40 + 5 * i, 3)).astype(np.float32)
+        for i, name in enumerate(sorted(models[0]))
+    }
+    out_s = sharded.score_all(X_by)
+    out_p = plain.score_all(X_by)
+    for name in X_by:
+        for key in ("model-output", "tag-anomaly-scores",
+                    "total-anomaly-score", "anomaly-confidence"):
+            np.testing.assert_allclose(
+                out_s[name][key], out_p[name][key], rtol=1e-5, atol=1e-6,
+                err_msg=f"{name}/{key}",
+            )
+        assert out_s[name]["total-anomaly-threshold"] == pytest.approx(
+            out_p[name]["total-anomaly-threshold"]
+        )
+    # subset requests (gather from sharded params) also stay exact
+    one = sorted(models[0])[2]
+    sub = sharded.score_all({one: X_by[one]})
+    np.testing.assert_allclose(
+        sub[one]["total-anomaly-score"],
+        out_p[one]["total-anomaly-score"], rtol=1e-5, atol=1e-6,
+    )
+
+
 def test_smoothing_bound_chunks_machine_axis(monkeypatch):
     """When the smoothing windows tensor would exceed the device-memory
     bound at the full dispatch size, score_all must split the MACHINE axis
