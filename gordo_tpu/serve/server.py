@@ -114,7 +114,9 @@ class ModelCollection:
             return self._fleet_scorer
 
     @classmethod
-    def from_directory(cls, path: str, project: str = "project") -> "ModelCollection":
+    def from_directory(
+        cls, path: str, project: str = "project", serve_mesh=None
+    ) -> "ModelCollection":
         entries: Dict[str, ModelEntry] = {}
         source_dir: Optional[str] = None
         if os.path.exists(os.path.join(path, serializer.MODEL_FILE)):
@@ -131,7 +133,12 @@ class ModelCollection:
                         logger.exception("Failed to load artifact %s", sub)
         if not entries:
             raise FileNotFoundError(f"No model artifacts under {path!r}")
-        return cls(entries, project=project, source_dir=source_dir)
+        return cls(
+            entries,
+            project=project,
+            source_dir=source_dir,
+            serve_mesh=serve_mesh,
+        )
 
     def get(self, name: str) -> Optional[ModelEntry]:
         return self.entries.get(name)
@@ -612,7 +619,7 @@ def run_server(
     visible devices (the ``"models"`` mesh axis) — one server process
     driving a whole slice instead of one chip.
     """
-    collection = ModelCollection.from_directory(model_dir, project=project)
+    serve_mesh = None
     if model_parallel:
         import jax
 
@@ -620,7 +627,7 @@ def run_server(
 
         devices = jax.devices()
         if len(devices) > 1:
-            collection.serve_mesh = fleet_mesh(devices)
+            serve_mesh = fleet_mesh(devices)
             logger.info(
                 "Model-parallel serving over %d devices", len(devices)
             )
@@ -631,6 +638,9 @@ def run_server(
                 "device visibility if a slice was expected",
                 devices[0].platform,
             )
+    collection = ModelCollection.from_directory(
+        model_dir, project=project, serve_mesh=serve_mesh
+    )
     logger.info(
         "Serving %d machine(s) from %s on %s:%d",
         len(collection.entries),
