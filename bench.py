@@ -347,11 +347,19 @@ def bench_serving(out: dict) -> None:
             if coalesce_ms:
                 key += "_coalesced"
             out[key] = round(res["samples_per_sec"])
+            out[key.replace("samples_per_sec", "latency_p50_ms")] = round(
+                res["latency_p50_ms"], 2
+            )
+            out[key.replace("samples_per_sec", "latency_p99_ms")] = round(
+                res["latency_p99_ms"], 2
+            )
             http[(mode, wire, bool(coalesce_ms))] = res["samples_per_sec"]
             log(f"serving HTTP {mode}/{wire}"
                 f"{' +coalesce' if coalesce_ms else ''}: "
                 f"{res['samples_per_sec']:,.0f} samples/s "
-                f"({res['response_mb_per_sec']:.1f} MB/s responses)")
+                f"({res['response_mb_per_sec']:.1f} MB/s responses, "
+                f"p50 {res['latency_p50_ms']:.0f}ms / "
+                f"p99 {res['latency_p99_ms']:.0f}ms)")
         # headline serving number = HTTP bulk over the production wire
         out["serving_samples_per_sec"] = round(http[("bulk", "msgpack", False)])
         out["serving_devices"] = 1

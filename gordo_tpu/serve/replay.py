@@ -110,12 +110,17 @@ async def _replay(
     async with aiohttp.ClientSession(timeout=client_timeout) as session:
         sem = asyncio.Semaphore(parallelism)
 
+        latencies: List[float] = []
+
         async def post(url: str, body: bytes) -> int:
+            t_req = time.perf_counter()  # before the semaphore: queueing
+            # behind in-flight peers is part of what a real client sees
             async with sem:
                 async with session.post(
                     url, data=body, headers=headers
                 ) as resp:
                     raw = await resp.read()
+                    latencies.append(time.perf_counter() - t_req)
                     if resp.status != 200:
                         errors.append(
                             f"{resp.status}: {raw[:200]!r}"
@@ -126,6 +131,7 @@ async def _replay(
         await asyncio.gather(*(post(u, b) for u, b in bodies[0]))
         if errors:
             raise RuntimeError(f"Replay warm-up failed: {errors[:3]}")
+        latencies.clear()  # warm-up requests are not part of the measurement
 
         t0 = time.perf_counter()
         response_bytes = 0
@@ -138,6 +144,9 @@ async def _replay(
     await runner.cleanup()
     if errors:
         raise RuntimeError(f"Replay had {len(errors)} errors: {errors[:3]}")
+    p50, p99 = (
+        np.percentile(latencies, [50, 99]) if latencies else (float("nan"),) * 2
+    )
     return {
         "mode": mode,
         "wire": wire,
@@ -147,6 +156,10 @@ async def _replay(
         "seconds": dt,
         "samples_per_sec": n_rounds * n_samples_round / dt,
         "response_mb_per_sec": response_bytes / dt / 1e6,
+        # under-load request latency, timed from submission (queueing
+        # behind the in-flight window included — what a client experiences)
+        "latency_p50_ms": float(p50 * 1e3),
+        "latency_p99_ms": float(p99 * 1e3),
     }
 
 
