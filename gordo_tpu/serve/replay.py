@@ -157,7 +157,10 @@ async def _replay(
         "samples_per_sec": n_rounds * n_samples_round / dt,
         "response_mb_per_sec": response_bytes / dt / 1e6,
         # under-load request latency, timed from submission (queueing
-        # behind the in-flight window included — what a client experiences)
+        # behind the in-flight window included — what a client experiences).
+        # latency_n is the sample count: with few requests (bulk mode runs
+        # one per round) the "p99" is really a near-max — read it with n.
+        "latency_n": len(latencies),
         "latency_p50_ms": float(p50 * 1e3),
         "latency_p99_ms": float(p99 * 1e3),
     }

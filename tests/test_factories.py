@@ -126,3 +126,23 @@ def test_mixed_precision_modules_keep_f32_params_and_outputs():
     )
     lout = lstm.apply({"params": lparams}, xw)
     assert lout.dtype == jnp.float32 and bool(jnp.isfinite(lout).all())
+
+
+def test_legacy_pickles_without_compute_dtype_stay_float32():
+    """Artifacts pickled before the compute_dtype field existed unpickle
+    WITHOUT the attribute and must fall back to the float32 class default
+    — bf16 here would silently change the numerics those artifacts'
+    anomaly thresholds were calibrated with."""
+    from gordo_tpu.models.factories.feedforward import FeedForwardAutoEncoder
+    from gordo_tpu.models.factories.lstm import LSTMAutoEncoderModule
+
+    for mod in (
+        feedforward_model(4, encoding_dim=(4,), decoding_dim=(4,)),
+        lstm_model(4, lookback_window=2, encoding_dim=(4,), decoding_dim=(4,)),
+    ):
+        # simulate a pre-field pickle: the instance attribute is absent,
+        # so lookup falls through to the class default
+        object.__delattr__(mod, "compute_dtype")
+        assert mod.compute_dtype == jnp.float32
+    assert FeedForwardAutoEncoder.compute_dtype == jnp.float32
+    assert LSTMAutoEncoderModule.compute_dtype == jnp.float32
