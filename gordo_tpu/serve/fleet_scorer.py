@@ -27,6 +27,7 @@ import numpy as np
 from gordo_tpu.anomaly.diff import scores_fn
 from gordo_tpu.ops.windows import make_windows
 from gordo_tpu.serve.scorer import (
+    SMOOTH_ONE_SHOT_BOUND,
     CompiledScorer,
     _bucket_rows,
     _extract_chain,
@@ -34,12 +35,11 @@ from gordo_tpu.serve.scorer import (
     short_rows_message,
 )
 
-#: same device-memory bound as CompiledScorer's smoothing guard (elements of
-#: the rolling-median windows tensor), applied across the stacked machine
-#: axis.  Hardware probe (v5e via tunnel, r4, guard disabled): 2^27.5
-#: elements still scores (1.36s/call), 2^28.5 kills the XLA compile — the
-#: bound sits just under the measured cliff with <2x headroom.
-SMOOTH_ELEMENT_BOUND = 2 ** 27
+#: the ONE measured windows-tensor ceiling (scorer.SMOOTH_ONE_SHOT_BOUND:
+#: 2^27.5 compiles, 2^28.5 kills XLA — v5e probe, r4), applied here across
+#: the stacked machine axis; aliased so a re-probe updates both the fleet
+#: chunking and the single-machine blocked-median switch together
+SMOOTH_ELEMENT_BOUND = SMOOTH_ONE_SHOT_BOUND
 
 
 def _fleet_score_core(
@@ -485,9 +485,9 @@ class FleetScorer:
             if bucket.smooth_window:
                 per_machine_elems = n_rows * bucket.smooth_window * n_feat
                 if per_machine_elems > SMOOTH_ELEMENT_BOUND:
-                    # ONE machine's windows tensor alone blows device memory
-                    # — score each through its own scorer (which has its own
-                    # memory guard + host fallback)
+                    # ONE machine's windows tensor alone exceeds the bound —
+                    # score each through its own scorer, whose over-bound
+                    # smoothing runs the blocked on-device rolling median
                     for n in wanted:
                         try:
                             results[n] = self._machine_scorer(

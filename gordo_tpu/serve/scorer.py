@@ -44,6 +44,14 @@ from gordo_tpu.pipeline import Pipeline
 #: small-request compute waste bounded on CPU/attached-device deployments.
 MIN_BUCKET = 256
 
+#: one-shot smoothing windows-tensor ceiling (elements).  Hardware probe
+#: (v5e, r4): 2^27.5 still compiles, 2^28.5 kills XLA — past this, the
+#: scorer switches to the blocked rolling median rather than leaving the
+#: device.
+SMOOTH_ONE_SHOT_BOUND = 2 ** 27
+#: per-block windows-tensor size the blocked median aims for (~64MB f32)
+SMOOTH_BLOCK_TARGET = 2 ** 24
+
 
 def short_rows_message(offset: int, rows: int) -> str:
     """The one short-rows client-error text — the direct, bulk, and
@@ -127,7 +135,40 @@ def _rolling_median(a: jnp.ndarray, window: int) -> jnp.ndarray:
     return out[:, 0] if squeeze else out
 
 
-@partial(jax.jit, static_argnames=("module", "scaler_classes", "mode", "lookback", "det_cls", "with_anomaly", "smooth_window"))
+def _rolling_median_blocked(
+    a: jnp.ndarray, window: int, block_rows: int
+) -> jnp.ndarray:
+    """:func:`_rolling_median` with the windows tensor materialized only
+    ``block_rows`` rows at a time (``lax.map`` over row blocks, each block
+    sliced with ``window - 1`` rows of preceding context).
+
+    Bit-identical to the one-shot version; memory drops from
+    ``n x window x tags`` to ``block_rows x window x tags`` per step.
+    Exists because the one-shot tensor has a hard compile ceiling on TPU
+    (measured r4: 2^27.5 elements OK, 2^28.5 fails XLA) — beyond it, huge
+    smoothed requests previously fell off the device entirely.
+    """
+    squeeze = a.ndim == 1
+    if squeeze:
+        a = a[:, None]
+    n, f = a.shape
+    n_blocks = -(-n // block_rows)
+    ctx = jnp.full((window - 1, f), jnp.nan, a.dtype)
+    tail = jnp.full((n_blocks * block_rows - n, f), jnp.nan, a.dtype)
+    buf = jnp.concatenate([ctx, a, tail], axis=0)
+
+    def one(start):
+        blk = jax.lax.dynamic_slice(
+            buf, (start, 0), (block_rows + window - 1, f)
+        )
+        return jnp.nanmedian(make_windows(blk, window), axis=1)
+
+    out = jax.lax.map(one, jnp.arange(n_blocks) * block_rows)
+    out = out.reshape(n_blocks * block_rows, f)[:n]
+    return out[:, 0] if squeeze else out
+
+
+@partial(jax.jit, static_argnames=("module", "scaler_classes", "mode", "lookback", "det_cls", "with_anomaly", "smooth_window", "smooth_block"))
 def _score_program(
     module,
     scaler_classes,
@@ -140,6 +181,7 @@ def _score_program(
     params,
     det_stats,
     X,
+    smooth_block=0,
 ):
     """(X padded to bucket) -> dict of arrays; the whole pipeline fused."""
     Xs = X
@@ -159,7 +201,12 @@ def _score_program(
         offset = X.shape[0] - pred.shape[0]
         y_al = X[offset:]
         tag, total = scores_fn(det_cls, det_stats, y_al, pred)
-        if smooth_window:
+        if smooth_window and smooth_block:
+            tag = _rolling_median_blocked(tag, smooth_window, smooth_block)
+            total = _rolling_median_blocked(
+                total, smooth_window, smooth_block
+            )
+        elif smooth_window:
             tag = _rolling_median(tag, smooth_window)
             total = _rolling_median(total, smooth_window)
         out["tag-anomaly-scores"] = tag
@@ -181,7 +228,9 @@ class CompiledScorer:
         return self.chain is not None
 
     # -- fused path ----------------------------------------------------------
-    def _run(self, X: np.ndarray, with_anomaly: bool) -> Dict[str, np.ndarray]:
+    def _run(
+        self, X: np.ndarray, with_anomaly: bool, smooth_block: int = 0
+    ) -> Dict[str, np.ndarray]:
         c = self.chain
         n = X.shape[0]
         bucket = _bucket_rows(n)
@@ -202,6 +251,7 @@ class CompiledScorer:
             c["params"],
             det["scaler_stats"] if det else None,
             jnp.asarray(X, jnp.float32),
+            smooth_block=smooth_block,
         )
         n_valid = n - self.offset
         return {k: np.asarray(v)[:n_valid] for k, v in out.items()}
@@ -230,12 +280,21 @@ class CompiledScorer:
         X = np.asarray(X, np.float32)
         self._require_rows(X)
         use_fused = self.fused and (y is None or y is X)
+        smooth_block = 0
         if use_fused and self.chain["detector"]["window"]:
-            # smoothing materializes an (n, window, tags) tensor on device;
-            # bound it (~512MB of f32) and fall back to the host path beyond
+            # the one-shot smoothing path materializes an (n, window, tags)
+            # windows tensor; past the measured device bound, switch to the
+            # blocked rolling median (identical results, lax.map over row
+            # blocks) instead of leaving the device
             det_w = self.chain["detector"]["window"]
-            if _bucket_rows(X.shape[0]) * det_w * max(X.shape[1], 1) > 2 ** 27:
-                use_fused = False
+            n_feat = max(X.shape[1], 1)
+            if (
+                _bucket_rows(X.shape[0]) * det_w * n_feat
+                > SMOOTH_ONE_SHOT_BOUND
+            ):
+                smooth_block = max(
+                    1, SMOOTH_BLOCK_TARGET // (det_w * n_feat)
+                )
         if use_fused:
             det = self.chain["detector"]
             if det["feature_thresholds"] is None and det["require_thresholds"]:
@@ -246,7 +305,7 @@ class CompiledScorer:
                     "require_thresholds=True but cross_validate() has not "
                     "been run to derive thresholds"
                 )
-            out = self._run(X, with_anomaly=True)
+            out = self._run(X, with_anomaly=True, smooth_block=smooth_block)
             result = {
                 "model-output": out["model-output"],
                 "tag-anomaly-scores": out["tag-anomaly-scores"],

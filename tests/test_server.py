@@ -255,6 +255,55 @@ class TestScorerContractParity:
             atol=1e-6,
         )
 
+    def test_blocked_rolling_median_equals_one_shot(self):
+        from gordo_tpu.serve.scorer import (
+            _rolling_median,
+            _rolling_median_blocked,
+        )
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(3)
+        a = rng.standard_normal((101, 4)).astype(np.float32)
+        a[rng.random((101, 4)) < 0.05] = np.nan  # NaNs must not diverge
+        for window in (1, 5, 16):
+            ref = np.asarray(_rolling_median(jnp.asarray(a), window))
+            for block in (1, 7, 64, 101, 200):
+                got = np.asarray(
+                    _rolling_median_blocked(jnp.asarray(a), window, block)
+                )
+                np.testing.assert_allclose(
+                    got, ref, rtol=1e-6, atol=1e-7, equal_nan=True,
+                    err_msg=f"window={window} block={block}",
+                )
+
+    def test_over_bound_smoothing_stays_fused_and_exact(
+        self, sine_tags, monkeypatch
+    ):
+        """Requests whose smoothing windows tensor exceeds the device
+        bound must score through the blocked fused path (not the host
+        pandas fallback) and still match the model exactly."""
+        import gordo_tpu.serve.scorer as sc_mod
+
+        det = self._fitted_detector(sine_tags, window=5)
+        scorer = CompiledScorer(det)
+        monkeypatch.setattr(sc_mod, "SMOOTH_ONE_SHOT_BOUND", 1)
+        monkeypatch.setattr(sc_mod, "SMOOTH_BLOCK_TARGET", 60)
+        host_calls = []
+        orig_anomaly = det.anomaly
+        monkeypatch.setattr(
+            det, "anomaly",
+            lambda *a, **k: host_calls.append(1) or orig_anomaly(*a, **k),
+        )
+        X = sine_tags[:80]
+        out = scorer.anomaly_arrays(X)
+        assert not host_calls, "fell back to the host path"
+        frame = orig_anomaly(X)
+        np.testing.assert_allclose(
+            out["total-anomaly-score"],
+            frame[("total-anomaly-score", "")].to_numpy(),
+            rtol=1e-5, atol=1e-6,
+        )
+
     def test_require_thresholds_raises_like_model(self, sine_tags):
         det = self._fitted_detector(sine_tags, cv=False)  # no thresholds
         scorer = CompiledScorer(det)
