@@ -195,8 +195,12 @@ def build_project_cmd(machine_config, project_name, output_dir,
               help="Shard stacked serving dispatches over ALL visible "
                    "devices (the 'models' mesh axis): one server process "
                    "drives a whole slice instead of one chip.")
+@click.option("--warmup/--no-warmup", default=False, show_default=True,
+              help="Precompile the serving programs in the background at "
+                   "startup so the first request doesn't pay jit "
+                   "compilation (~20-40s cold on TPU).")
 def run_server_cmd(model_dir, host, port, project, rescan_interval,
-                   coalesce_ms, model_parallel):
+                   coalesce_ms, model_parallel, warmup):
     """Serve model(s) over the /gordo/v0/<project>/<machine>/ routes."""
     from gordo_tpu.serve.server import run_server
 
@@ -205,6 +209,7 @@ def run_server_cmd(model_dir, host, port, project, rescan_interval,
         rescan_interval=rescan_interval,
         coalesce_window_ms=coalesce_ms,
         model_parallel=model_parallel,
+        warmup=warmup,
     )
 
 

@@ -482,12 +482,20 @@ class FleetScorer:
 
                     m_eff = -(-m_eff // bucket.mesh.shape[MODEL_AXIS])
             chunks = [wanted]
-            if bucket.smooth_window:
-                per_machine_elems = n_rows * bucket.smooth_window * n_feat
+            # every per-machine windows tensor the fused program
+            # materializes one-shot: the MODEL-INPUT windows of lookback
+            # models (n, lookback, tags) and the smoothing windows
+            # (n, smooth_window, tags) — summed, since both can be live
+            win_factor = (bucket.smooth_window or 0) + (
+                bucket.lookback if bucket.mode != "none" else 0
+            )
+            if win_factor:
+                per_machine_elems = n_rows * win_factor * n_feat
                 if per_machine_elems > SMOOTH_ELEMENT_BOUND:
-                    # ONE machine's windows tensor alone exceeds the bound —
-                    # score each through its own scorer, whose over-bound
-                    # smoothing runs the blocked on-device rolling median
+                    # ONE machine's windows tensors alone exceed the bound
+                    # — score each through its own scorer (blocked
+                    # on-device median for smoothing overflow; host path
+                    # for lookback overflow)
                     for n in wanted:
                         try:
                             results[n] = self._machine_scorer(

@@ -263,11 +263,24 @@ class CompiledScorer:
         if X.shape[0] <= self.offset:
             raise ValueError(short_rows_message(self.offset, X.shape[0]))
 
+    def _input_windows_within_bound(self, X: np.ndarray) -> bool:
+        """The fused program materializes the model-input windows tensor
+        ``(n, lookback, tags)`` one-shot; past the measured compile
+        ceiling there is no blocked variant (inference consumes the
+        windows), so callers route such requests to the host path."""
+        if self.chain["mode"] == "none":
+            return True
+        n_feat = max(X.shape[1], 1)
+        return (
+            _bucket_rows(X.shape[0]) * self.chain["lookback"] * n_feat
+            <= SMOOTH_ONE_SHOT_BOUND
+        )
+
     # -- public surface ------------------------------------------------------
     def predict(self, X) -> np.ndarray:
         X = np.asarray(X, np.float32)
         self._require_rows(X)
-        if self.fused:
+        if self.fused and self._input_windows_within_bound(X):
             return self._run(X, with_anomaly=False)["model-output"]
         return np.asarray(self.model.predict(X))
 
@@ -279,7 +292,11 @@ class CompiledScorer:
             )
         X = np.asarray(X, np.float32)
         self._require_rows(X)
-        use_fused = self.fused and (y is None or y is X)
+        use_fused = (
+            self.fused
+            and (y is None or y is X)
+            and self._input_windows_within_bound(X)
+        )
         smooth_block = 0
         if use_fused and self.chain["detector"]["window"]:
             # the one-shot smoothing path materializes an (n, window, tags)

@@ -43,6 +43,7 @@ COLLECTION_KEY: "web.AppKey[ModelCollection]" = web.AppKey(
     "collection", object
 )
 COALESCER_KEY: "web.AppKey[object]" = web.AppKey("coalescer", object)
+WARMUP_TASK_KEY: "web.AppKey[object]" = web.AppKey("warmup_task", object)
 
 
 class ModelEntry:
@@ -534,18 +535,142 @@ def _json_dumps(obj) -> str:
 # app factory
 # ---------------------------------------------------------------------------
 
+def warmup_scorers(
+    collection: ModelCollection,
+    row_sizes: Optional[List[int]] = None,
+) -> Dict[str, Any]:
+    """Precompile the serving programs so early requests don't pay jit
+    compilation (~20-40s cold on TPU).
+
+    Per structural bucket, per row size in ``row_sizes`` (default: the
+    minimum bucket and the 2048-row bucket — the replayed-stream request
+    shape): one full-bucket stacked dispatch (the ``_bulk`` route's
+    program) and one per-machine fused program, plus one single-machine
+    subset dispatch (the coalescer's common case).  Programs are keyed by
+    power-of-two row bucket, so request sizes outside ``row_sizes`` still
+    compile on first use.  Flax modules hash structurally, so one machine
+    per bucket warms every machine sharing its architecture.  Errors are
+    logged, never raised: a warmup failure must not take down startup.
+    """
+    from gordo_tpu.serve.scorer import MIN_BUCKET
+
+    if row_sizes is None:
+        row_sizes = [MIN_BUCKET, 2048]
+    t0 = time.monotonic()
+    stats = {"buckets": 0, "fallbacks": 0, "errors": 0}
+    try:
+        fleet = collection.fleet_scorer
+    except Exception:
+        logger.exception("Warmup: fleet scorer construction failed")
+        stats["errors"] += 1
+        return stats
+    for bucket in fleet.buckets:
+        n_feat = bucket.n_features or 1
+        ok = True
+        for rows in sorted({max(r, bucket.lookback + 1) for r in row_sizes}):
+            X = np.zeros((rows, n_feat), np.float32)
+            try:
+                fleet.score_all({n: X for n in bucket.names})  # full bucket
+                entry = collection.get(bucket.names[0])
+                if entry is not None and entry.scorer.is_anomaly:
+                    entry.scorer.anomaly_arrays(X)  # per-machine route
+            except Exception:
+                logger.exception(
+                    "Warmup failed for bucket %s rows=%d",
+                    bucket.names[:3], rows,
+                )
+                stats["errors"] += 1
+                ok = False
+        if len(bucket.names) > 1:
+            try:  # 1-machine subset dispatch (coalescer's common case)
+                fleet.score_all(
+                    {
+                        bucket.names[0]: np.zeros(
+                            (max(row_sizes[0], bucket.lookback + 1), n_feat),
+                            np.float32,
+                        )
+                    }
+                )
+            except Exception:
+                logger.exception(
+                    "Warmup subset failed for bucket %s", bucket.names[:3]
+                )
+                stats["errors"] += 1
+                ok = False
+        if ok:
+            stats["buckets"] += 1
+    for name in fleet.fallbacks:
+        entry = collection.get(name)
+        if entry is None:
+            continue
+        try:
+            rows = max(MIN_BUCKET, getattr(entry.scorer, "offset", 0) + 1)
+            n_feat = len(entry.tags) or 1
+            X = np.zeros((rows, n_feat), np.float32)
+            if entry.scorer.is_anomaly:
+                entry.scorer.anomaly_arrays(X)
+            else:
+                entry.scorer.predict(X)
+            stats["fallbacks"] += 1
+        except Exception:
+            # fallback models often fail on zeros (e.g. missing thresholds
+            # raise by design) — debug-level, not an operational error
+            logger.debug("Warmup skipped fallback %s", name, exc_info=True)
+    stats["seconds"] = round(time.monotonic() - t0, 2)
+    logger.info("Serving warmup done: %s", stats)
+    return stats
+
+
 def build_app(
     collection: ModelCollection,
     rescan_interval: float = 0.0,
     coalesce_window_ms: float = 0.0,
+    warmup: bool = False,
 ) -> web.Application:
     """``rescan_interval > 0`` starts a background artifact-dir rescan so
     machines built after startup begin serving without a restart.
     ``coalesce_window_ms > 0`` micro-batches concurrent single-machine
     anomaly requests into stacked fleet dispatches (``serve/coalesce.py``)
-    at the cost of up to that much added latency per request."""
+    at the cost of up to that much added latency per request.
+    ``warmup`` precompiles the serving programs in a background executor
+    task at startup (``warmup_scorers``) — the server accepts traffic
+    immediately; an early request races the warmup at worst."""
     app = web.Application(client_max_size=256 * 1024 * 1024)
     app[COLLECTION_KEY] = collection
+
+    if warmup:
+
+        async def _warmup(app: web.Application):
+            # a DAEMON thread, not the loop's executor: compiles can't be
+            # interrupted, and a non-daemon worker (incl. any
+            # ThreadPoolExecutor's) would be joined at interpreter exit —
+            # Ctrl-C during a multi-minute TPU warmup must still exit
+            # promptly
+            loop = asyncio.get_running_loop()
+            fut: asyncio.Future = loop.create_future()
+
+            def _resolve(setter):
+                try:
+                    loop.call_soon_threadsafe(
+                        lambda: None if fut.done() else setter()
+                    )
+                except RuntimeError:
+                    pass  # loop already closed — nothing to resolve into
+
+            def runner():
+                try:
+                    res = warmup_scorers(collection)
+                except Exception as exc:  # warmup_scorers logs details
+                    _resolve(lambda: fut.set_exception(exc))
+                else:
+                    _resolve(lambda: fut.set_result(res))
+
+            threading.Thread(
+                target=runner, name="gordo-warmup", daemon=True
+            ).start()
+            app[WARMUP_TASK_KEY] = fut
+
+        app.on_startup.append(_warmup)
 
     if coalesce_window_ms > 0:
         coalescer = coalesce_mod.CoalescingScorer(
@@ -612,6 +737,7 @@ def run_server(
     rescan_interval: float = 30.0,
     coalesce_window_ms: float = 0.0,
     model_parallel: bool = False,
+    warmup: bool = False,
 ) -> None:
     """Blocking entrypoint (reference: ``gordo run-server``).
 
@@ -653,6 +779,7 @@ def run_server(
             collection,
             rescan_interval=rescan_interval,
             coalesce_window_ms=coalesce_window_ms,
+            warmup=warmup,
         ),
         host=host,
         port=port,

@@ -380,6 +380,49 @@ def test_smoothing_bound_chunks_machine_axis(monkeypatch):
             )
 
 
+def test_lookback_windows_bound_chunks_machine_axis(monkeypatch):
+    """The machine-axis chunking bound must count the MODEL-INPUT windows
+    tensor of lookback models, not just smoothing — a bulk dispatch whose
+    stacked (m, n, lookback, tags) tensor would exceed the bound splits
+    into subset chunks and stays exact."""
+    import gordo_tpu.serve.fleet_scorer as fs_mod
+    from gordo_tpu.anomaly.diff import DiffBasedAnomalyDetector
+    from gordo_tpu.models.estimator import LSTMAutoEncoder
+    from gordo_tpu.ops.scalers import MinMaxScaler
+    from gordo_tpu.pipeline import Pipeline
+    from gordo_tpu.serve.scorer import _bucket_rows
+
+    rng = np.random.default_rng(21)
+    L = 4
+    dets = {}
+    for i in range(4):
+        X_train = rng.standard_normal((140, 3)).astype(np.float32)
+        det = DiffBasedAnomalyDetector(
+            base_estimator=Pipeline([
+                MinMaxScaler(),
+                LSTMAutoEncoder(lookback_window=L, epochs=1, batch_size=64),
+            ]),
+        )
+        det.cross_validate(X_train)
+        det.fit(X_train)
+        dets[f"lb-{i}"] = det
+
+    scorer = FleetScorer.from_models(dets)
+    assert scorer.n_stacked == 4
+    X_by = {n: rng.standard_normal((40, 3)).astype(np.float32) for n in dets}
+    per_machine = _bucket_rows(40) * L * 3  # win_factor = lookback only
+    monkeypatch.setattr(fs_mod, "SMOOTH_ELEMENT_BOUND", 2 * per_machine)
+    out = scorer.score_all(X_by)
+    dims = {s[0] for s in scorer.buckets[0]._stack_bufs}
+    assert dims == {2}, dims  # chunked into 2-machine subset dispatches
+    for n, det in dets.items():
+        single = CompiledScorer(det).anomaly_arrays(X_by[n])
+        np.testing.assert_allclose(
+            out[n]["total-anomaly-score"], single["total-anomaly-score"],
+            rtol=1e-5, atol=1e-6, err_msg=n,
+        )
+
+
 def test_width_mismatch_isolated_in_stacked_dispatch(models):
     """score_all itself (no HTTP-level validation in front of it — the
     coalescer path) must reject a wrong-width array in ITS machine's slot

@@ -742,3 +742,84 @@ def test_coalescer_routes_fallback_machines_off_worker(model_dir, tmp_path):
     bodies = asyncio.run(main())
     for body in bodies:
         assert len(body["data"]["total-anomaly-score"]) == 40
+
+
+def test_warmup_scorers_compiles_and_app_serves(model_dir):
+    """warmup_scorers precompiles every bucket without error, and an app
+    built with warmup=True still serves normally (the warmup runs in a
+    background executor task at startup)."""
+    from gordo_tpu.serve.server import warmup_scorers
+
+    collection = ModelCollection.from_directory(model_dir, project="testproj")
+    stats = warmup_scorers(collection)
+    assert stats["errors"] == 0
+    assert stats["buckets"] == len(collection.fleet_scorer.buckets) >= 1
+
+    async def runner():
+        coll2 = ModelCollection.from_directory(model_dir, project="testproj")
+        client = TestClient(TestServer(build_app(coll2, warmup=True)))
+        await client.start_server()
+        try:
+            name = sorted(coll2.entries)[0]
+            n_tags = len(coll2.get(name).tags)
+            resp = await client.post(
+                f"/gordo/v0/testproj/{name}/anomaly/prediction",
+                json={"X": [[0.0] * n_tags] * 12},
+            )
+            assert resp.status == 200, await resp.text()
+            from gordo_tpu.serve.server import WARMUP_TASK_KEY
+
+            task = client.app.get(WARMUP_TASK_KEY)
+            assert task is not None
+            stats2 = await task  # warmup finishes without error
+            assert stats2["errors"] == 0
+        finally:
+            await client.close()
+
+    asyncio.run(runner())
+
+
+def test_over_bound_lookback_windows_fall_back_to_host(monkeypatch):
+    """The model-input windows tensor (n, lookback, tags) has no blocked
+    variant — requests past the device bound on that axis must score
+    through the host path (and stay exact), not crash the fused compile."""
+    import gordo_tpu.serve.scorer as sc_mod
+    from gordo_tpu.anomaly.diff import DiffBasedAnomalyDetector
+    from gordo_tpu.models.estimator import LSTMAutoEncoder
+    from gordo_tpu.ops.scalers import MinMaxScaler
+    from gordo_tpu.pipeline import Pipeline
+
+    rng = np.random.default_rng(7)
+    X_train = rng.standard_normal((200, 3)).astype(np.float32)
+    det = DiffBasedAnomalyDetector(
+        base_estimator=Pipeline([
+            MinMaxScaler(),
+            LSTMAutoEncoder(lookback_window=8, epochs=1, batch_size=64),
+        ]),
+    )
+    det.cross_validate(X_train)
+    det.fit(X_train)
+    scorer = CompiledScorer(det)
+    X = rng.standard_normal((60, 3)).astype(np.float32)
+    fused = scorer.anomaly_arrays(X)
+
+    monkeypatch.setattr(sc_mod, "SMOOTH_ONE_SHOT_BOUND", 1)
+    host_calls = []
+    orig_anomaly = det.anomaly
+    monkeypatch.setattr(
+        det, "anomaly",
+        lambda *a, **k: host_calls.append(1) or orig_anomaly(*a, **k),
+    )
+    out = scorer.anomaly_arrays(X)
+    assert host_calls, "over-bound lookback request did not use the host path"
+    np.testing.assert_allclose(
+        out["total-anomaly-score"], fused["total-anomaly-score"],
+        rtol=1e-4, atol=1e-5,
+    )
+    # the /prediction surface is guarded too (same bound, host predict)
+    fused_pred = None
+    monkeypatch.setattr(sc_mod, "SMOOTH_ONE_SHOT_BOUND", 2 ** 27)
+    fused_pred = scorer.predict(X)
+    monkeypatch.setattr(sc_mod, "SMOOTH_ONE_SHOT_BOUND", 1)
+    host_pred = scorer.predict(X)
+    np.testing.assert_allclose(host_pred, fused_pred, rtol=1e-4, atol=1e-5)
