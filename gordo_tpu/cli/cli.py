@@ -367,9 +367,13 @@ def workflow_group():
 @click.option("--project-name", envvar="PROJECT_NAME", default="project")
 @click.option("--image", default="gordo-tpu", show_default=True)
 @click.option("--server-replicas", default=1, show_default=True)
+@click.option("--server-arg", "server_args", multiple=True,
+              help="Extra 'gordo run-server' flag for the ml-server "
+                   "Deployment; repeatable (e.g. --server-arg=--coalesce-ms "
+                   "--server-arg=2 --server-arg=--model-parallel).")
 @click.option("--output-file", type=click.File("w"), default="-")
 def workflow_generate(machine_config, project_name, image, server_replicas,
-                      output_file):
+                      server_args, output_file):
     """Render the kubernetes manifests + fleet build plan (reference:
     the Argo workflow template render)."""
     from gordo_tpu.workflow import (
@@ -381,7 +385,8 @@ def workflow_generate(machine_config, project_name, image, server_replicas,
 
     config = NormalizedConfig(load_machine_config(machine_config), project_name)
     docs = generate_workflow(
-        config, image=image, server_replicas=server_replicas
+        config, image=image, server_replicas=server_replicas,
+        server_args=list(server_args),
     )
     output_file.write(workflow_to_yaml(docs))
 

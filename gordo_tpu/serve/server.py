@@ -505,6 +505,20 @@ async def download_model(request: web.Request) -> web.Response:
     return web.Response(body=body, content_type="application/octet-stream")
 
 
+async def readiness(request: web.Request) -> web.Response:
+    """Readiness endpoint for orchestrators: 503 while a startup warmup is
+    still compiling, 200 once it finishes (or when warmup is off).  The
+    generated k8s Deployment points its readinessProbe here so a
+    rescheduled pod only receives traffic once its programs are compiled.
+    """
+    fut = request.app.get(WARMUP_TASK_KEY)
+    if fut is not None and not fut.done():
+        return web.json_response(
+            {"ready": False, "reason": "warmup in progress"}, status=503
+        )
+    return web.json_response({"ready": True})
+
+
 async def project_index(request: web.Request) -> web.Response:
     collection: ModelCollection = request.app[COLLECTION_KEY]
     return web.json_response(
@@ -718,6 +732,7 @@ def build_app(
 
     p = f"{API_PREFIX}/{{project}}"
     app.router.add_get(f"{p}/", project_index)
+    app.router.add_get(f"{p}/ready", readiness)
     # registered before the {machine} routes so "_bulk" never resolves as a
     # machine name
     app.router.add_post(f"{p}/_bulk/anomaly/prediction", bulk_anomaly_prediction)

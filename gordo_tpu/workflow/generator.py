@@ -177,7 +177,12 @@ def _builder_job(project: str, image: str, tpu_resources: Dict[str, Any]) -> Dic
     }
 
 
-def _server_deployment(project: str, image: str, replicas: int) -> Dict:
+def _server_deployment(
+    project: str,
+    image: str,
+    replicas: int,
+    server_args: Optional[List[str]] = None,
+) -> Dict:
     return {
         "apiVersion": "apps/v1",
         "kind": "Deployment",
@@ -200,11 +205,20 @@ def _server_deployment(project: str, image: str, replicas: int) -> Dict:
                                 "--model-dir", "/models",
                                 "--project", project,
                                 "--port", str(DEFAULT_SERVER_PORT),
+                                # warmup by default + the /ready-gated
+                                # readinessProbe below: pods receive no
+                                # traffic until their programs are compiled
+                                "--warmup",
+                                *(server_args or []),
                             ],
                             "ports": [{"containerPort": DEFAULT_SERVER_PORT}],
                             "readinessProbe": {
+                                # /ready returns 503 until the startup
+                                # warmup finishes compiling, so a
+                                # rescheduled pod only receives traffic
+                                # with warm programs
                                 "httpGet": {
-                                    "path": f"{API_PREFIX}/{project}/",
+                                    "path": f"{API_PREFIX}/{project}/ready",
                                     "port": DEFAULT_SERVER_PORT,
                                 },
                             },
@@ -303,9 +317,15 @@ def generate_workflow(
     server_replicas: int = 1,
     tpu_resources: Optional[Dict[str, Any]] = None,
     include_plan: bool = True,
+    server_args: Optional[List[str]] = None,
 ) -> List[Dict[str, Any]]:
     """Project config → list of k8s manifest dicts (+ the build plan as a
-    ConfigMap so the cluster state carries the bucketing decision)."""
+    ConfigMap so the cluster state carries the bucketing decision).
+
+    ``server_args``: extra ``gordo run-server`` flags for the ml-server
+    Deployment (e.g. ``["--coalesce-ms", "2"]`` or ``["--model-parallel"]``
+    on a slice-backed node pool).
+    """
     project = config.project_name
     machines = [m.name for m in config.machines]
     tpu_resources = tpu_resources or {
@@ -314,7 +334,7 @@ def generate_workflow(
     }
     docs: List[Dict[str, Any]] = [
         _builder_job(project, image, tpu_resources),
-        _server_deployment(project, image, server_replicas),
+        _server_deployment(project, image, server_replicas, server_args),
         _service(project, "ml-server", DEFAULT_SERVER_PORT),
         _watchman_deployment(project, image, machines),
         _service(project, "watchman", DEFAULT_WATCHMAN_PORT),

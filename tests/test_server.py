@@ -773,10 +773,25 @@ def test_warmup_scorers_compiles_and_app_serves(model_dir):
             assert task is not None
             stats2 = await task  # warmup finishes without error
             assert stats2["errors"] == 0
+            # readiness gate: 200 once warmup is done
+            ready = await client.get("/gordo/v0/testproj/ready")
+            assert ready.status == 200
         finally:
             await client.close()
 
     asyncio.run(runner())
+
+    async def no_warmup_runner():
+        coll3 = ModelCollection.from_directory(model_dir, project="testproj")
+        client = TestClient(TestServer(build_app(coll3)))  # warmup off
+        await client.start_server()
+        try:
+            ready = await client.get("/gordo/v0/testproj/ready")
+            assert ready.status == 200  # no warmup configured -> ready
+        finally:
+            await client.close()
+
+    asyncio.run(no_warmup_runner())
 
 
 def test_over_bound_lookback_windows_fall_back_to_host(monkeypatch):

@@ -84,3 +84,21 @@ def test_workflow_yaml_roundtrip():
     parsed = list(yaml.safe_load_all(workflow_to_yaml(docs)))
     assert len(parsed) == len(docs)
     assert parsed[0]["kind"] == "Job"
+
+
+def test_server_deployment_args_and_warmup_default():
+    """The ml-server Deployment warms up by default (pods must not serve
+    cold-compile responses after a reschedule) and carries user-supplied
+    extra run-server flags."""
+    docs = generate_workflow(
+        _config(), server_args=["--coalesce-ms", "2", "--model-parallel"]
+    )
+    dep = next(
+        d for d in docs
+        if d["kind"] == "Deployment"
+        and d["metadata"]["name"].startswith("gordo-server-")
+    )
+    args = dep["spec"]["template"]["spec"]["containers"][0]["args"]
+    assert "--warmup" in args
+    i = args.index("--coalesce-ms")
+    assert args[i: i + 3] == ["--coalesce-ms", "2", "--model-parallel"]
