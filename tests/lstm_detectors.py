@@ -1,0 +1,41 @@
+"""Shared LSTM detector builder for tests.
+
+ONE set of fit-program shapes, used by every test that fits an LSTM
+detector in-process.  This is load-bearing beyond deduplication: a fresh
+late-suite XLA CPU compile of a NEW LSTM fit shape segfaulted
+reproducibly inside ``backend_compile_and_load`` (jax 0.9.0 CPU, ~200
+tests of accumulated compile state; the same test alone passed).  Tests
+that share these shapes hit the in-process jit cache after the first
+fit, so changing the constants here changes every user together — the
+coupling breaks loudly, not silently.
+"""
+
+import numpy as np
+
+LOOKBACK = 6
+ROWS = 160
+N_TAGS = 3
+BATCH = 64
+
+
+def fitted_lstm_detector(rng: np.random.Generator, cv: bool = True):
+    """Build + (optionally cross-validate) + fit one LSTM diff detector
+    with the shared shapes."""
+    from gordo_tpu.anomaly.diff import DiffBasedAnomalyDetector
+    from gordo_tpu.models.estimator import LSTMAutoEncoder
+    from gordo_tpu.ops.scalers import MinMaxScaler
+    from gordo_tpu.pipeline import Pipeline
+
+    X_train = rng.standard_normal((ROWS, N_TAGS)).astype(np.float32)
+    det = DiffBasedAnomalyDetector(
+        base_estimator=Pipeline([
+            MinMaxScaler(),
+            LSTMAutoEncoder(
+                lookback_window=LOOKBACK, epochs=1, batch_size=BATCH
+            ),
+        ]),
+    )
+    if cv:
+        det.cross_validate(X_train)
+    det.fit(X_train)
+    return det

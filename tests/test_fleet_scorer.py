@@ -245,25 +245,10 @@ def test_lstm_machines_stack_and_match_per_machine_scorer():
     """BASELINE config 2's serving side: windowed LSTM detectors must
     stack into one vmapped program and match each machine's own
     CompiledScorer output exactly (windowing offset included)."""
-    from gordo_tpu.anomaly.diff import DiffBasedAnomalyDetector
-    from gordo_tpu.models.estimator import LSTMAutoEncoder
-    from gordo_tpu.ops.scalers import MinMaxScaler
-    from gordo_tpu.pipeline import Pipeline
+    from tests.lstm_detectors import LOOKBACK as L, fitted_lstm_detector
 
     rng = np.random.default_rng(4)
-    L = 6
-    dets = {}
-    for i in range(3):
-        X_train = rng.standard_normal((160, 3)).astype(np.float32)
-        det = DiffBasedAnomalyDetector(
-            base_estimator=Pipeline([
-                MinMaxScaler(),
-                LSTMAutoEncoder(lookback_window=L, epochs=1, batch_size=64),
-            ]),
-        )
-        det.cross_validate(X_train)
-        det.fit(X_train)
-        dets[f"lstm-{i}"] = det
+    dets = {f"lstm-{i}": fitted_lstm_detector(rng) for i in range(3)}
 
     scorer = FleetScorer.from_models(dets)
     assert scorer.n_stacked == 3 and len(scorer.buckets) == 1
@@ -386,31 +371,22 @@ def test_lookback_windows_bound_chunks_machine_axis(monkeypatch):
     stacked (m, n, lookback, tags) tensor would exceed the bound splits
     into subset chunks and stays exact."""
     import gordo_tpu.serve.fleet_scorer as fs_mod
-    from gordo_tpu.anomaly.diff import DiffBasedAnomalyDetector
-    from gordo_tpu.models.estimator import LSTMAutoEncoder
-    from gordo_tpu.ops.scalers import MinMaxScaler
-    from gordo_tpu.pipeline import Pipeline
     from gordo_tpu.serve.scorer import _bucket_rows
+    from tests.lstm_detectors import (
+        LOOKBACK as L,
+        N_TAGS,
+        fitted_lstm_detector,
+    )
 
     rng = np.random.default_rng(21)
-    L = 4
-    dets = {}
-    for i in range(4):
-        X_train = rng.standard_normal((140, 3)).astype(np.float32)
-        det = DiffBasedAnomalyDetector(
-            base_estimator=Pipeline([
-                MinMaxScaler(),
-                LSTMAutoEncoder(lookback_window=L, epochs=1, batch_size=64),
-            ]),
-        )
-        det.cross_validate(X_train)
-        det.fit(X_train)
-        dets[f"lb-{i}"] = det
+    dets = {f"lb-{i}": fitted_lstm_detector(rng) for i in range(4)}
 
     scorer = FleetScorer.from_models(dets)
     assert scorer.n_stacked == 4
-    X_by = {n: rng.standard_normal((40, 3)).astype(np.float32) for n in dets}
-    per_machine = _bucket_rows(40) * L * 3  # win_factor = lookback only
+    X_by = {
+        n: rng.standard_normal((40, N_TAGS)).astype(np.float32) for n in dets
+    }
+    per_machine = _bucket_rows(40) * L * N_TAGS  # win_factor = lookback
     monkeypatch.setattr(fs_mod, "SMOOTH_ELEMENT_BOUND", 2 * per_machine)
     out = scorer.score_all(X_by)
     dims = {s[0] for s in scorer.buckets[0]._stack_bufs}

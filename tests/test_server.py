@@ -799,21 +799,10 @@ def test_over_bound_lookback_windows_fall_back_to_host(monkeypatch):
     variant — requests past the device bound on that axis must score
     through the host path (and stay exact), not crash the fused compile."""
     import gordo_tpu.serve.scorer as sc_mod
-    from gordo_tpu.anomaly.diff import DiffBasedAnomalyDetector
-    from gordo_tpu.models.estimator import LSTMAutoEncoder
-    from gordo_tpu.ops.scalers import MinMaxScaler
-    from gordo_tpu.pipeline import Pipeline
+    from tests.lstm_detectors import fitted_lstm_detector
 
     rng = np.random.default_rng(7)
-    X_train = rng.standard_normal((200, 3)).astype(np.float32)
-    det = DiffBasedAnomalyDetector(
-        base_estimator=Pipeline([
-            MinMaxScaler(),
-            LSTMAutoEncoder(lookback_window=8, epochs=1, batch_size=64),
-        ]),
-    )
-    det.cross_validate(X_train)
-    det.fit(X_train)
+    det = fitted_lstm_detector(rng)  # shared shapes — see that module
     scorer = CompiledScorer(det)
     X = rng.standard_normal((60, 3)).astype(np.float32)
     fused = scorer.anomaly_arrays(X)
