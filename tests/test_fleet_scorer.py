@@ -245,7 +245,7 @@ def test_lstm_machines_stack_and_match_per_machine_scorer():
     """BASELINE config 2's serving side: windowed LSTM detectors must
     stack into one vmapped program and match each machine's own
     CompiledScorer output exactly (windowing offset included)."""
-    from tests.lstm_detectors import LOOKBACK as L, fitted_lstm_detector
+    from lstm_detectors import LOOKBACK as L, fitted_lstm_detector
 
     rng = np.random.default_rng(4)
     dets = {f"lstm-{i}": fitted_lstm_detector(rng) for i in range(3)}
@@ -372,7 +372,7 @@ def test_lookback_windows_bound_chunks_machine_axis(monkeypatch):
     into subset chunks and stays exact."""
     import gordo_tpu.serve.fleet_scorer as fs_mod
     from gordo_tpu.serve.scorer import _bucket_rows
-    from tests.lstm_detectors import (
+    from lstm_detectors import (
         LOOKBACK as L,
         N_TAGS,
         fitted_lstm_detector,

@@ -799,7 +799,7 @@ def test_over_bound_lookback_windows_fall_back_to_host(monkeypatch):
     variant — requests past the device bound on that axis must score
     through the host path (and stay exact), not crash the fused compile."""
     import gordo_tpu.serve.scorer as sc_mod
-    from tests.lstm_detectors import fitted_lstm_detector
+    from lstm_detectors import fitted_lstm_detector
 
     rng = np.random.default_rng(7)
     det = fitted_lstm_detector(rng)  # shared shapes — see that module
