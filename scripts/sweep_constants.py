@@ -1,9 +1,8 @@
 #!/usr/bin/env python
-"""Hardware sweeps for the device-side tuning constants (docs/perf.md).
-
-Three independent sweeps, one per constant, each sized to finish well
-inside a 10-minute window (TPU-tunnel processes must not be timeout-killed
-— a killed client can wedge the relay):
+"""Hardware sweeps for device-side tuning constants and perf scenarios
+(results recorded in docs/perf.md).  Each sweep is sized to finish well
+inside a 10-minute window (TPU-tunnel processes must not be
+timeout-killed — a killed client can wedge the relay):
 
 - ``minbucket``: fused-scorer latency vs padded row-bucket size
   (→ ``serve/scorer.py::MIN_BUCKET``)
@@ -11,8 +10,14 @@ inside a 10-minute window (TPU-tunnel processes must not be timeout-killed
   (→ ``builder/fleet_build.py::DEFAULT_MAX_BUCKET``)
 - ``smooth``: stacked smoothing-window scoring vs the windows-tensor size
   (→ ``serve/fleet_scorer.py::SMOOTH_ELEMENT_BOUND``)
+- ``multibucket``: mixed-tag-width project vs a uniform one (per-bucket
+  compile/dispatch overhead)
+- ``sustained``: one 4096-machine memory-bounded project build
+- ``lstmdtype``: LSTM fleet build rate, bfloat16 vs float32 compute
 
-Usage: python scripts/sweep_constants.py {minbucket|bucket|smooth}
+Usage: python scripts/sweep_constants.py
+           {minbucket|bucket|smooth|multibucket|sustained|lstmdtype} [n]
+(``n`` — machine count — applies to bucket/sustained/lstmdtype only.)
 """
 
 from __future__ import annotations
@@ -100,20 +105,29 @@ def sweep_bucket(n_machines: int = 512) -> None:
         for i in range(n_machines)
     ]
     for bucket in (128, 256, 512):
-        rates = []
-        for _run in range(2):
-            out = tempfile.mkdtemp()
-            t0 = time.perf_counter()
-            res = build_project(machines, out, max_bucket_size=bucket)
-            dt = time.perf_counter() - t0
-            shutil.rmtree(out, ignore_errors=True)
-            assert not res.failed, list(res.failed.items())[:2]
-            rates.append(len(res.artifacts) / dt * 3600)
-        print(
-            f"max_bucket={bucket:5d}: warm {rates[-1]:,.0f} models/h "
-            f"(cold {rates[0]:,.0f})",
-            flush=True,
+        _timed_build(
+            machines, f"max_bucket={bucket:5d}", max_bucket_size=bucket
         )
+
+
+def _timed_build(machines, label: str, **build_kwargs) -> None:
+    """Cold + warm timed ``build_project`` runs; prints one result line —
+    the ONE measurement harness every build-rate sweep shares."""
+    from gordo_tpu.builder.fleet_build import build_project
+
+    rates = []
+    for _run in range(2):
+        out = tempfile.mkdtemp()
+        t0 = time.perf_counter()
+        res = build_project(machines, out, **build_kwargs)
+        dt = time.perf_counter() - t0
+        shutil.rmtree(out, ignore_errors=True)
+        assert not res.failed, list(res.failed.items())[:2]
+        rates.append(len(res.artifacts) / dt * 3600)
+    print(
+        f"{label}: warm {rates[-1]:,.0f} models/h (cold {rates[0]:,.0f})",
+        flush=True,
+    )
 
 
 def _machines(n: int, n_tags: int = 10, prefix: str = "swp"):
@@ -148,17 +162,7 @@ def sweep_multibucket() -> None:
     )
     for label, machines in (("uniform-1-bucket", uniform),
                             ("mixed-4-buckets", mixed)):
-        rates = []
-        for _run in range(2):
-            out = tf.mkdtemp()
-            t0 = time.perf_counter()
-            res = build_project(machines, out)
-            dt = time.perf_counter() - t0
-            sh.rmtree(out, ignore_errors=True)
-            assert not res.failed, list(res.failed.items())[:2]
-            rates.append(len(res.artifacts) / dt * 3600)
-        print(f"{label}: warm {rates[-1]:,.0f} models/h "
-              f"(cold {rates[0]:,.0f})", flush=True)
+        _timed_build(machines, label)
 
 
 def sweep_sustained(n: int = 4096) -> None:
@@ -179,6 +183,52 @@ def sweep_sustained(n: int = 4096) -> None:
         print(f"run {run}: {len(res.artifacts)} machines in {dt:.1f}s "
               f"({len(res.artifacts) / dt * 3600:,.0f} models/h, "
               f"peak_loaded={res.peak_loaded})", flush=True)
+
+
+def sweep_lstmdtype(n_machines: int = 32) -> None:
+    """The r4 pending measurement (docs/perf.md): LSTM fleet build rate
+    with bfloat16 vs float32 recurrent compute.  The LSTM scenario is the
+    only FLOP-heavy path, so the MXU-native dtype should move it; run on a
+    healthy TPU (each dtype compiles its own program — cold run first,
+    warm run is the number)."""
+    from gordo_tpu.builder.fleet_build import build_project
+    from gordo_tpu.workflow.config import Machine
+
+    for dtype in ("bfloat16", "float32"):
+        machines = [
+            Machine.from_config(
+                {
+                    "name": f"dt-{dtype[:4]}-{i:03d}",
+                    "dataset": {
+                        "type": "RandomDataset",
+                        "tag_list": [f"t-{i}-{j}" for j in range(50)],
+                    },
+                    "model": {
+                        "gordo_tpu.anomaly.diff.DiffBasedAnomalyDetector": {
+                            "base_estimator": {
+                                "gordo_tpu.pipeline.Pipeline": {
+                                    "steps": [
+                                        "gordo_tpu.ops.scalers.MinMaxScaler",
+                                        {
+                                            "gordo_tpu.models.estimator"
+                                            ".LSTMAutoEncoder": {
+                                                "kind": "lstm_hourglass",
+                                                "lookback_window": 12,
+                                                "epochs": 10,
+                                                "batch_size": 64,
+                                                "compute_dtype": dtype,
+                                            }
+                                        },
+                                    ]
+                                }
+                            }
+                        }
+                    },
+                }
+            )
+            for i in range(n_machines)
+        ]
+        _timed_build(machines, f"compute_dtype={dtype}")
 
 
 def sweep_smooth() -> None:
@@ -226,9 +276,24 @@ if __name__ == "__main__":
         "smooth": sweep_smooth,
         "multibucket": sweep_multibucket,
         "sustained": sweep_sustained,
+        "lstmdtype": sweep_lstmdtype,
     }
     which = sys.argv[1] if len(sys.argv) > 1 else ""
     if which not in sweeps:
-        print(f"usage: {sys.argv[0]} {{{'|'.join(sweeps)}}}", file=sys.stderr)
+        print(
+            f"usage: {sys.argv[0]} {{{'|'.join(sweeps)}}} [n]",
+            file=sys.stderr,
+        )
         sys.exit(2)
-    sweeps[which]()
+    sized = {"bucket", "sustained", "lstmdtype"}
+    if len(sys.argv) > 2:
+        if which not in sized:
+            print(
+                f"sweep {which!r} takes no size argument "
+                f"(sized sweeps: {sorted(sized)})",
+                file=sys.stderr,
+            )
+            sys.exit(2)
+        sweeps[which](int(sys.argv[2]))
+    else:
+        sweeps[which]()
