@@ -69,6 +69,8 @@ def build_model(
     t_start = time.time()
 
     dataset = GordoBaseDataset.from_dict(dict(data_config))
+    # X and y may alias the SAME DataFrame (autoencoder default where
+    # targets == inputs) — treat both as read-only; np.asarray below copies
     X, y = dataset.get_data()
     t_data = time.time()
 

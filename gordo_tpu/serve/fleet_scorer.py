@@ -37,8 +37,10 @@ from gordo_tpu.serve.scorer import (
 
 #: the ONE measured windows-tensor ceiling (scorer.SMOOTH_ONE_SHOT_BOUND:
 #: 2^27.5 compiles, 2^28.5 kills XLA — v5e probe, r4), applied here across
-#: the stacked machine axis; aliased so a re-probe updates both the fleet
-#: chunking and the single-machine blocked-median switch together
+#: the stacked machine axis.  NOTE: a source-level alias — editing the
+#: scorer constant updates both, but a *runtime* rebind of
+#: scorer.SMOOTH_ONE_SHOT_BOUND (monkeypatch, dynamic re-probe) does not
+#: propagate here; rebind both names in that case.
 SMOOTH_ELEMENT_BOUND = SMOOTH_ONE_SHOT_BOUND
 
 

@@ -568,7 +568,7 @@ def warmup_scorers(
     """
     from gordo_tpu.serve.scorer import MIN_BUCKET
 
-    if row_sizes is None:
+    if not row_sizes:  # None or an explicit empty list
         row_sizes = [MIN_BUCKET, 2048]
     t0 = time.monotonic()
     stats = {"buckets": 0, "fallbacks": 0, "errors": 0}
@@ -662,6 +662,11 @@ def build_app(
             # promptly
             loop = asyncio.get_running_loop()
             fut: asyncio.Future = loop.create_future()
+            # readiness() only checks fut.done() — consume a failure here
+            # so GC doesn't log "Future exception was never retrieved"
+            fut.add_done_callback(
+                lambda f: None if f.cancelled() else f.exception()
+            )
 
             def _resolve(setter):
                 try:
@@ -675,7 +680,9 @@ def build_app(
                 try:
                     res = warmup_scorers(collection)
                 except Exception as exc:  # warmup_scorers logs details
-                    _resolve(lambda: fut.set_exception(exc))
+                    # bind now: CPython deletes the except-bound name when
+                    # the block exits, before the scheduled callback runs
+                    _resolve(lambda e=exc: fut.set_exception(e))
                 else:
                     _resolve(lambda: fut.set_result(res))
 

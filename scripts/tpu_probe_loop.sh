@@ -1,0 +1,14 @@
+#!/bin/bash
+# Probe the axon TPU tunnel every ~4 minutes; log results. Stop when healthy.
+# Usage: nohup bash scripts/tpu_probe_loop.sh >/tmp/tpu_probe.log 2>&1 &
+while true; do
+  ts=$(date -u +%H:%M:%S)
+  out=$(timeout 70 python -c "import jax; print(jax.devices())" 2>&1)
+  rc=$?
+  echo "[$ts] rc=$rc $(echo "$out" | tail -1)"
+  if [ $rc -eq 0 ] && echo "$out" | grep -q "TpuDevice"; then
+    echo "[$ts] TUNNEL HEALTHY"
+    break
+  fi
+  sleep 240
+done

@@ -794,6 +794,46 @@ def test_warmup_scorers_compiles_and_app_serves(model_dir):
     asyncio.run(no_warmup_runner())
 
 
+def test_warmup_failure_still_becomes_ready(model_dir, monkeypatch):
+    """A warmup crash must resolve the warmup future with the ORIGINAL
+    exception (not leak a NameError from the deleted except-bound name)
+    and must not wedge /ready at 503 — warmup failure can't take down
+    startup."""
+    import gordo_tpu.serve.server as server_mod
+
+    def boom(collection, row_sizes=None):
+        raise RuntimeError("synthetic warmup failure")
+
+    monkeypatch.setattr(server_mod, "warmup_scorers", boom)
+
+    async def runner():
+        coll = ModelCollection.from_directory(model_dir, project="testproj")
+        client = TestClient(TestServer(build_app(coll, warmup=True)))
+        await client.start_server()
+        try:
+            fut = client.app.get(server_mod.WARMUP_TASK_KEY)
+            assert fut is not None
+            with pytest.raises(RuntimeError, match="synthetic warmup"):
+                await asyncio.wait_for(asyncio.shield(fut), timeout=30)
+            # failed warmup is DONE -> pod enters rotation regardless
+            ready = await client.get("/gordo/v0/testproj/ready")
+            assert ready.status == 200
+        finally:
+            await client.close()
+
+    asyncio.run(runner())
+
+
+def test_warmup_scorers_empty_row_sizes(model_dir):
+    """An explicit empty row_sizes list falls back to the defaults instead
+    of IndexError-ing inside the warmup thread."""
+    from gordo_tpu.serve.server import warmup_scorers
+
+    collection = ModelCollection.from_directory(model_dir, project="testproj")
+    stats = warmup_scorers(collection, row_sizes=[])
+    assert stats["errors"] == 0
+
+
 def test_over_bound_lookback_windows_fall_back_to_host(monkeypatch):
     """The model-input windows tensor (n, lookback, tags) has no blocked
     variant — requests past the device bound on that axis must score
