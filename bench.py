@@ -94,6 +94,50 @@ def emit_line(line: str) -> None:
         _emitted = True
 
 
+_REPO_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def persist_partial(out: dict) -> None:
+    """Write the current result snapshot to a platform-tagged sidecar
+    (``BENCH_partial_{tpu,cpu}.json``) after backend init and after every
+    completed stage.
+
+    The r4 failure mode motivating this: the tunnel wedged mid-round, the
+    round-end bench fell back to CPU, and every TPU-measured stage from
+    earlier runs was lost.  With the sidecar, any stage that ever completed
+    on TPU stays on disk; a later CPU-fallback run embeds it (see
+    :func:`cpu_fallback_line`) instead of discarding it.
+    """
+    platform = out.get("platform")
+    if platform is None:
+        return
+    path = os.path.join(_REPO_DIR, f"BENCH_partial_{platform}.json")
+    snap = dict(out)
+    snap["persisted_at"] = time.strftime(
+        "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+    )
+    try:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(snap, fh, indent=1)
+        os.replace(tmp, path)
+    except Exception as exc:  # persistence must never kill the bench
+        log(f"persist_partial failed: {exc!r}")
+
+
+def attach_tpu_partial(doc: dict) -> None:
+    """Embed the latest TPU-stage sidecar into a CPU-fallback result doc so
+    the single emitted line still carries whatever the TPU measured before
+    the tunnel wedged (timestamped; the reader judges staleness)."""
+    path = os.path.join(_REPO_DIR, "BENCH_partial_tpu.json")
+    try:
+        if os.path.exists(path):
+            with open(path) as fh:
+                doc["tpu_partial"] = json.load(fh)
+    except Exception as exc:
+        log(f"attach_tpu_partial failed: {exc!r}")
+
+
 def cpu_fallback_line(budget_s: float) -> "str | None":
     """When the TPU backend can't initialize (wedged tunnel — observed to
     last hours with no client-side recovery), rerun the whole bench on CPU
@@ -140,6 +184,7 @@ def start_watchdog(out: dict) -> None:
     def fire():
         out.setdefault("error", f"bench deadline ({DEADLINE_S:.0f}s) hit")
         log(f"WATCHDOG: deadline {DEADLINE_S:.0f}s hit; emitting partial result")
+        persist_partial(out)
         emit_once(out)
         sys.stdout.flush()
         os._exit(0)
@@ -570,6 +615,7 @@ def main() -> None:
                     "TPU backend unavailable "
                     f"({type(exc).__name__}); CPU fallback run"
                 )
+                attach_tpu_partial(doc)
                 line = json.dumps(doc)
             except Exception:
                 pass  # emit the raw line rather than lose it
@@ -584,6 +630,7 @@ def main() -> None:
     n_chips = len(devices)
     out["n_chips"] = n_chips
     out["platform"] = devices[0].platform
+    persist_partial(out)
     mesh = fleet_mesh(devices) if n_chips > 1 else None
 
     def build_stage():
@@ -598,14 +645,20 @@ def main() -> None:
     # the headline build stage gets the largest share of what's left at
     # its turn, and a short operator-set deadline shrinks every stage
     # instead of silently skipping the most important one
-    run_stage_bounded("build", build_stage, out, remaining() * 0.6)
-    run_stage_bounded(
+    if run_stage_bounded("build", build_stage, out, remaining() * 0.6):
+        out.setdefault("stages_done", []).append("build")
+    persist_partial(out)
+    if run_stage_bounded(
         "serving", lambda: bench_serving(out), out,
         min(remaining() * 0.7, 480),
-    )
-    run_stage_bounded(
+    ):
+        out.setdefault("stages_done", []).append("serving")
+    persist_partial(out)
+    if run_stage_bounded(
         "lstm", lambda: bench_lstm_build(mesh, out), out, remaining() - 30
-    )
+    ):
+        out.setdefault("stages_done", []).append("lstm")
+    persist_partial(out)
 
     emit_once(out)
     # abandoned stage threads may still be blocked on a wedged device
