@@ -108,15 +108,18 @@ def persist_partial(out: dict) -> None:
     on TPU stays on disk; a later CPU-fallback run embeds it (see
     :func:`cpu_fallback_line`) instead of discarding it.
     """
-    platform = out.get("platform")
-    if platform is None:
-        return
-    path = os.path.join(_REPO_DIR, f"BENCH_partial_{platform}.json")
-    snap = dict(out)
-    snap["persisted_at"] = time.strftime(
-        "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
-    )
     try:
+        # everything inside the try: an abandoned stage's daemon thread can
+        # mutate ``out`` mid-snapshot ("dict changed size during iteration"),
+        # and the watchdog's fire() must survive that to reach emit_once
+        platform = out.get("platform")
+        if platform is None:
+            return
+        path = os.path.join(_REPO_DIR, f"BENCH_partial_{platform}.json")
+        snap = dict(out)
+        snap["persisted_at"] = time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+        )
         tmp = path + ".tmp"
         with open(tmp, "w") as fh:
             json.dump(snap, fh, indent=1)

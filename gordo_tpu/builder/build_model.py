@@ -67,6 +67,9 @@ def build_model(
     """Build one machine's model: data → model → (CV) → fit → metadata."""
     evaluation_config = evaluation_config or {"cv_mode": "full_build"}
     t_start = time.time()
+    from gordo_tpu.utils.compile_cache import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache()
 
     dataset = GordoBaseDataset.from_dict(dict(data_config))
     # X and y may alias the SAME DataFrame (autoencoder default where

@@ -170,6 +170,9 @@ def build_project(
     (identical layout to ``provide_saved_model``).
     """
     t_start = time.time()
+    from gordo_tpu.utils.compile_cache import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache()
     if align_lengths is not None and align_lengths < 2:
         raise ValueError(
             f"align_lengths must be >= 2 (got {align_lengths}); it is a "

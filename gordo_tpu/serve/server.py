@@ -649,6 +649,9 @@ def build_app(
     ``warmup`` precompiles the serving programs in a background executor
     task at startup (``warmup_scorers``) — the server accepts traffic
     immediately; an early request races the warmup at worst."""
+    from gordo_tpu.utils.compile_cache import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache()
     app = web.Application(client_max_size=256 * 1024 * 1024)
     app[COLLECTION_KEY] = collection
 

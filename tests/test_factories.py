@@ -82,6 +82,51 @@ def test_lstm_hourglass_builds():
     assert mod.apply({"params": params}, x).shape == (2, 6)
 
 
+@pytest.mark.parametrize("dtype_name", ["float32", "bfloat16"])
+def test_fused_lstm_matches_flax_cell(dtype_name):
+    """The fused scan (input projection hoisted out of the recurrence) must
+    stay interchangeable with ``nn.RNN(OptimizedLSTMCell)``: identical param
+    tree, BIT-identical init (path-derived RNG), and outputs equal to fp
+    rounding — old artifacts must keep loading and scoring the same."""
+    import flax.linen as nn
+    import numpy as np
+
+    cd = jnp.float32 if dtype_name == "float32" else jnp.bfloat16
+    dims, funcs, n_feat, lookback = (9, 7), ("tanh", "tanh"), 5, 6
+
+    class FlaxReference(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = x.astype(cd)
+            for i, d in enumerate(dims):
+                x = nn.RNN(
+                    nn.OptimizedLSTMCell(d, dtype=cd), name=f"lstm_{i}"
+                )(x)
+                x = jnp.tanh(x)
+            return nn.Dense(n_feat, dtype=jnp.float32, name="out")(
+                x[:, -1, :].astype(jnp.float32)
+            )
+
+    fused = lstm_model(
+        n_feat, encoding_dim=dims[:1], decoding_dim=dims[1:],
+        encoding_func=["tanh"], decoding_func=["tanh"],
+        compute_dtype=dtype_name,
+    )
+    ref = FlaxReference()
+    rng = jax.random.PRNGKey(3)
+    x = jax.random.normal(jax.random.PRNGKey(4), (4, lookback, n_feat))
+    p_ref = ref.init(rng, x)["params"]
+    p_fused = fused.init(rng, x)["params"]
+    assert jax.tree.structure(p_ref) == jax.tree.structure(p_fused)
+    for a, b in zip(jax.tree.leaves(p_ref), jax.tree.leaves(p_fused)):
+        assert np.array_equal(a, b)  # bit-identical init
+
+    y_ref = ref.apply({"params": p_ref}, x).astype(jnp.float32)
+    y_fused = fused.apply({"params": p_fused}, x).astype(jnp.float32)
+    tol = 1e-6 if dtype_name == "float32" else 2e-2
+    np.testing.assert_allclose(y_ref, y_fused, atol=tol, rtol=tol)
+
+
 def test_unknown_activation_raises():
     with pytest.raises(ValueError, match="Unknown activation"):
         mod = feedforward_model(4, encoding_dim=(4,), encoding_func=["nope"], decoding_dim=(4,))
