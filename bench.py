@@ -425,20 +425,28 @@ def bench_serving(out: dict) -> None:
         collection = ModelCollection(entries, project="bench")
 
         http = {}
-        for mode, wire, rounds, coalesce_ms in (
-            ("bulk", "json", 5, 0.0),
-            ("bulk", "msgpack", 5, 0.0),
-            ("single", "json", 3, 0.0),
-            ("single", "json", 3, 2.0),  # cross-request coalescer on
+        for mode, wire, rounds, coalesce_ms, par in (
+            ("bulk", "json", 5, 0.0, 8),
+            ("bulk", "msgpack", 5, 0.0, 8),
+            # coalesced-vs-not at three concurrencies (r4 verdict item 4):
+            # the adaptive bypass must make coalescing >= direct everywhere
+            ("single", "json", 2, 0.0, 1),
+            ("single", "json", 2, 2.0, 1),
+            ("single", "json", 3, 0.0, 8),
+            ("single", "json", 3, 2.0, 8),
+            ("single", "json", 3, 0.0, 64),
+            ("single", "json", 3, 2.0, 64),
         ):
             res = replay_bench(
                 collection, mode=mode, wire=wire, n_rounds=rounds,
-                rows=2048, parallelism=8,
+                rows=2048, parallelism=par,
                 coalesce_window_ms=coalesce_ms,
             )
             key = f"serving_samples_per_sec_http_{mode}_{wire}"
             if coalesce_ms:
                 key += "_coalesced"
+            if par != 8:  # 8-way keeps the r3/r4-compatible unsuffixed key
+                key += f"_p{par}"
             out[key] = round(res["samples_per_sec"])
             out[key.replace("samples_per_sec", "latency_p50_ms")] = round(
                 res["latency_p50_ms"], 2
@@ -449,18 +457,21 @@ def bench_serving(out: dict) -> None:
                 out[key.replace("samples_per_sec", "latency_p99_ms")] = round(
                     res["latency_p99_ms"], 2
                 )
-            http[(mode, wire, bool(coalesce_ms))] = res["samples_per_sec"]
-            log(f"serving HTTP {mode}/{wire}"
+            http[(mode, wire, bool(coalesce_ms), par)] = res["samples_per_sec"]
+            log(f"serving HTTP {mode}/{wire} x{par}"
                 f"{' +coalesce' if coalesce_ms else ''}: "
                 f"{res['samples_per_sec']:,.0f} samples/s "
                 f"({res['response_mb_per_sec']:.1f} MB/s responses, "
                 f"p50 {res['latency_p50_ms']:.0f}ms / "
                 f"p99 {res['latency_p99_ms']:.0f}ms)")
         # headline serving number = HTTP bulk over the production wire
-        out["serving_samples_per_sec"] = round(http[("bulk", "msgpack", False)])
+        out["serving_samples_per_sec"] = round(
+            http[("bulk", "msgpack", False, 8)]
+        )
         out["serving_devices"] = 1
         out["serving_vs_target"] = round(
-            http[("bulk", "msgpack", False)] / NORTH_STAR_SAMPLES_PER_SEC_PER_CHIP,
+            http[("bulk", "msgpack", False, 8)]
+            / NORTH_STAR_SAMPLES_PER_SEC_PER_CHIP,
             3,
         )
     finally:

@@ -189,7 +189,12 @@ def build_project_cmd(machine_config, project_name, output_dir,
               help="Micro-batch concurrent single-machine anomaly requests "
                    "into stacked fleet dispatches, waiting up to this many "
                    "ms per request (0 disables). Big win under concurrent "
-                   "load; adds up to the window in latency when idle.")
+                   "load; requests below --coalesce-min-concurrency "
+                   "bypass the window and dispatch directly.")
+@click.option("--coalesce-min-concurrency", default=2, show_default=True,
+              help="Coalesce only when at least this many single-machine "
+                   "anomaly requests are in flight; below it requests "
+                   "score directly (adaptive bypass).")
 @click.option("--model-parallel/--no-model-parallel", default=False,
               show_default=True,
               help="Shard stacked serving dispatches over ALL visible "
@@ -200,7 +205,8 @@ def build_project_cmd(machine_config, project_name, output_dir,
                    "startup so the first request doesn't pay jit "
                    "compilation (~20-40s cold on TPU).")
 def run_server_cmd(model_dir, host, port, project, rescan_interval,
-                   coalesce_ms, model_parallel, warmup):
+                   coalesce_ms, coalesce_min_concurrency, model_parallel,
+                   warmup):
     """Serve model(s) over the /gordo/v0/<project>/<machine>/ routes."""
     from gordo_tpu.serve.server import run_server
 
@@ -208,6 +214,7 @@ def run_server_cmd(model_dir, host, port, project, rescan_interval,
         model_dir, host=host, port=port, project=project,
         rescan_interval=rescan_interval,
         coalesce_window_ms=coalesce_ms,
+        coalesce_min_concurrency=coalesce_min_concurrency,
         model_parallel=model_parallel,
         warmup=warmup,
     )

@@ -58,9 +58,14 @@ async def _replay(
     machines: Optional[Sequence[str]],
     timeout_s: float,
     coalesce_window_ms: float = 0.0,
+    coalesce_min_concurrency: int = 2,
 ) -> Dict[str, Any]:
     runner = web.AppRunner(
-        build_app(collection, coalesce_window_ms=coalesce_window_ms)
+        build_app(
+            collection,
+            coalesce_window_ms=coalesce_window_ms,
+            coalesce_min_concurrency=coalesce_min_concurrency,
+        )
     )
     await runner.setup()
     site = web.TCPSite(runner, "127.0.0.1", 0)
@@ -176,17 +181,19 @@ def replay_bench(
     machines: Optional[Sequence[str]] = None,
     timeout_s: float = 600.0,
     coalesce_window_ms: float = 0.0,
+    coalesce_min_concurrency: int = 2,
 ) -> Dict[str, Any]:
     """Measure end-to-end HTTP anomaly-scoring throughput.
 
     ``mode``: ``"bulk"`` (one ``_bulk`` request per round carrying every
     machine's chunk) or ``"single"`` (one request per machine per round,
     ``parallelism`` in flight).  ``wire``: ``"json"`` or ``"msgpack"``.
-    ``coalesce_window_ms``: enable the server's cross-request coalescer.
+    ``coalesce_window_ms``: enable the server's cross-request coalescer
+    (requests below ``coalesce_min_concurrency`` in flight bypass it).
     """
     return asyncio.run(
         _replay(
             collection, mode, wire, n_rounds, rows, parallelism, machines,
-            timeout_s, coalesce_window_ms,
+            timeout_s, coalesce_window_ms, coalesce_min_concurrency,
         )
     )

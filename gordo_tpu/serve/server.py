@@ -393,11 +393,24 @@ async def anomaly_prediction(request: web.Request) -> web.Response:
     coalescer = request.app.get(COALESCER_KEY)
     try:
         if coalescer is not None and y is None:
-            # concurrent requests across machines merge into one stacked
-            # dispatch (same vmapped program family as the _bulk route)
-            out = await asyncio.wrap_future(
-                coalescer.submit(entry.name, X)
-            )
+            # handlers run on the single-threaded event loop, so the
+            # inflight counter needs no lock; it counts EVERY in-flight
+            # single-machine anomaly request (direct or coalesced) — the
+            # concurrency signal the adaptive bypass keys on
+            coalescer.inflight += 1
+            try:
+                if coalescer.should_coalesce():
+                    # concurrent requests across machines merge into one
+                    # stacked dispatch (the _bulk route's program family)
+                    out = await asyncio.wrap_future(
+                        coalescer.submit(entry.name, X)
+                    )
+                else:  # too few riders: direct dispatch wins — bypass
+                    out = await loop.run_in_executor(
+                        None, entry.scorer.anomaly_arrays, X, None
+                    )
+            finally:
+                coalescer.inflight -= 1
         else:
             out = await loop.run_in_executor(
                 None, entry.scorer.anomaly_arrays, X, y
@@ -640,12 +653,16 @@ def build_app(
     rescan_interval: float = 0.0,
     coalesce_window_ms: float = 0.0,
     warmup: bool = False,
+    coalesce_min_concurrency: int = 2,
 ) -> web.Application:
     """``rescan_interval > 0`` starts a background artifact-dir rescan so
     machines built after startup begin serving without a restart.
     ``coalesce_window_ms > 0`` micro-batches concurrent single-machine
     anomaly requests into stacked fleet dispatches (``serve/coalesce.py``)
-    at the cost of up to that much added latency per request.
+    at the cost of up to that much added latency per request — but only
+    once at least ``coalesce_min_concurrency`` such requests are in
+    flight; below that the route dispatches directly (adaptive bypass), so
+    an idle or lightly-loaded server keeps uncoalesced latency.
     ``warmup`` precompiles the serving programs in a background executor
     task at startup (``warmup_scorers``) — the server accepts traffic
     immediately; an early request races the warmup at worst."""
@@ -700,6 +717,7 @@ def build_app(
         coalescer = coalesce_mod.CoalescingScorer(
             lambda: collection.fleet_scorer,
             max_wait_s=coalesce_window_ms / 1000.0,
+            min_concurrency=coalesce_min_concurrency,
         )
         app[COALESCER_KEY] = coalescer
 
@@ -761,6 +779,7 @@ def run_server(
     project: str = "project",
     rescan_interval: float = 30.0,
     coalesce_window_ms: float = 0.0,
+    coalesce_min_concurrency: int = 2,
     model_parallel: bool = False,
     warmup: bool = False,
 ) -> None:
@@ -804,6 +823,7 @@ def run_server(
             collection,
             rescan_interval=rescan_interval,
             coalesce_window_ms=coalesce_window_ms,
+            coalesce_min_concurrency=coalesce_min_concurrency,
             warmup=warmup,
         ),
         host=host,
