@@ -149,6 +149,7 @@ def build_project(
     max_bucket_size: int = DEFAULT_MAX_BUCKET,
     data_workers: int = 8,
     align_lengths: Optional[int] = None,
+    pad_lengths: Optional[int] = None,
 ) -> ProjectBuildResult:
     """Build every machine; fleet-bucket the homogeneous ones.
 
@@ -166,6 +167,15 @@ def build_project(
     Off (None) by default — results then match the single-machine build
     of the unmodified data exactly.
 
+    ``pad_lengths``: the zero-data-loss alternative — pad each machine's
+    rows UP to a multiple of this with weight-masked rows instead of
+    truncating (``parallel.anomaly._padded_fleet_program``).  Every real
+    row trains and a ragged bucket compiles one program per ALIGNED
+    length, but CV fold boundaries and minibatch geometry derive from the
+    padded length, so results for not-already-aligned machines differ
+    slightly from their single-machine builds (see ``docs/fleet.md``).
+    Mutually exclusive with ``align_lengths``.
+
     Returns a :class:`ProjectBuildResult` with one artifact dir per machine
     (identical layout to ``provide_saved_model``).
     """
@@ -179,15 +189,29 @@ def build_project(
             "row-count multiple, and 0/1/negative would change cache "
             "identity without changing any training data"
         )
+    if pad_lengths is not None and pad_lengths < 2:
+        raise ValueError(
+            f"pad_lengths must be >= 2 (got {pad_lengths}); it is a "
+            "row-count multiple"
+        )
+    if align_lengths and pad_lengths:
+        raise ValueError(
+            "align_lengths (truncate down) and pad_lengths (pad up) are "
+            "mutually exclusive — pick one ragged-fleet strategy"
+        )
     machines = [_as_machine(m) for m in machines]
     result = ProjectBuildResult()
     tracker = _LoadTracker()
-    # alignment changes what data trains, so it must be part of the cache
-    # identity — otherwise an aligned build silently reuses full-parity
-    # artifacts (and vice versa).  Only FLEET-built machines truncate;
-    # config-determined singles train on full data and therefore key
-    # WITHOUT the alignment component.
-    key_extra = {"align_lengths": align_lengths} if align_lengths else None
+    # alignment/padding changes what data trains (or how it is batched and
+    # folded), so it must be part of the cache identity — otherwise an
+    # aligned build silently reuses full-parity artifacts (and vice
+    # versa).  Only FLEET-built machines align/pad; config-determined
+    # singles train on full data and therefore key WITHOUT the component.
+    key_extra = None
+    if align_lengths:
+        key_extra = {"align_lengths": align_lengths}
+    elif pad_lengths:
+        key_extra = {"pad_lengths": pad_lengths}
 
     # 1. Fleetability from CONFIG alone (no data loaded yet) + the
     #    config-hash cache check (reference: provide_saved_model) with the
@@ -344,7 +368,9 @@ def build_project(
             cv = ok_chunk[0].evaluation.get("cv")
             t0 = time.time()
             try:
-                builder = FleetDiffBuilder(spec, cv=cv, mesh=mesh)
+                builder = FleetDiffBuilder(
+                    spec, cv=cv, mesh=mesh, pad_lengths=pad_lengths
+                )
                 with profiling.trace(f"fleet_bucket/{len(ok_chunk)}"):
                     detectors = builder.build(
                         [loaded[m.name][0] for m in ok_chunk],
@@ -370,18 +396,23 @@ def build_project(
                     result,
                     fleet=True,
                     align_lengths=align_lengths,
+                    pad_lengths=pad_lengths,
                     cache_key=machine_keys[m.name],
                 )
                 _free(loaded, [m.name])  # artifact on disk: arrays drop
 
     # 4. Single-machine fallback (non-fleetable configs) — one at a time,
     #    each build loading and freeing its own data.
-    if singles and align_lengths:
+    if singles and (align_lengths or pad_lengths):
+        which = (
+            f"align_lengths={align_lengths}" if align_lengths
+            else f"pad_lengths={pad_lengths}"
+        )
         logger.warning(
-            "align_lengths=%d does not apply to the %d machine(s) building "
+            "%s does not apply to the %d machine(s) building "
             "through the single-machine path (%s%s): they train on their "
-            "full untruncated data",
-            align_lengths, len(singles),
+            "full unmodified data",
+            which, len(singles),
             ", ".join(m.name for m in singles[:5]),
             "..." if len(singles) > 5 else "",
         )
@@ -420,6 +451,7 @@ def _dump_machine(
     result: ProjectBuildResult,
     fleet: bool,
     align_lengths: Optional[int] = None,
+    pad_lengths: Optional[int] = None,
     cache_key: Optional[str] = None,
 ) -> None:
     X, _, dataset_meta, query_seconds = loaded_entry
@@ -440,6 +472,11 @@ def _dump_machine(
         # a truncated artifact must be distinguishable from a full-parity
         # one: record the alignment and the row count actually trained on
         metadata["model"]["align_lengths"] = int(align_lengths)
+        metadata["model"]["rows_trained"] = int(X.shape[0])
+    if pad_lengths:
+        # padded-mode artifact: every real row trained, but fold/batch
+        # geometry came from the padded group length
+        metadata["model"]["pad_lengths"] = int(pad_lengths)
         metadata["model"]["rows_trained"] = int(X.shape[0])
     # the artifact stamps its own cache identity so a later lookup can
     # detect that this dir was overwritten by a different build

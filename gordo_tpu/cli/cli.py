@@ -137,10 +137,18 @@ def build(output_dir, name, model_config, data_config, metadata,
                    "of this (oldest rows drop): ragged projects compile one "
                    "XLA program per DISTINCT row count, so alignment trades "
                    "up to N-1 old rows for ~N-fold fewer compiles.")
+@click.option("--pad-lengths", default=None,
+              type=click.IntRange(min=2),
+              help="Pad each machine's train rows UP to a multiple of this "
+                   "with weight-masked rows (zero data loss): one program "
+                   "per aligned length, at the cost of fold/batch geometry "
+                   "deriving from the padded length. Mutually exclusive "
+                   "with --align-lengths.")
 @click.option("--replace-cache", is_flag=True)
 def build_project_cmd(machine_config, project_name, output_dir,
                       model_register_dir, max_bucket_size, data_parallel,
-                      data_workers, align_lengths, replace_cache):
+                      data_workers, align_lengths, pad_lengths,
+                      replace_cache):
     """Build EVERY machine in the project config — homogeneous machines
     train as single mesh-sharded fleet programs (the TPU-native
     replacement for the reference's one-pod-per-machine Argo DAG)."""
@@ -166,6 +174,7 @@ def build_project_cmd(machine_config, project_name, output_dir,
         max_bucket_size=max_bucket_size,
         data_workers=data_workers,
         align_lengths=align_lengths,
+        pad_lengths=pad_lengths,
     )
     click.echo(json.dumps(result.summary()))
     if result.failed:

@@ -57,3 +57,48 @@ METRICS = {
     "mean_squared_error": mean_squared_error,
     "mean_absolute_error": mean_absolute_error,
 }
+
+
+# ---------------------------------------------------------------------------
+# Row-weighted variants (pad-up fleet mode: zero-weight padded rows).
+# With all-ones weights each reduces to its unweighted counterpart.
+# ---------------------------------------------------------------------------
+
+def _wmean(a: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """Weighted column mean of ``a`` (n, F) with row weights ``w`` (n,)."""
+    return jnp.sum(a * w[:, None], axis=0) / jnp.maximum(jnp.sum(w), _EPS)
+
+
+def weighted_explained_variance_score(y_true, y_pred, w) -> jnp.ndarray:
+    y_true, y_pred = _flatten_targets(y_true, y_pred)
+    diff = y_true - y_pred
+    num = _wmean((diff - _wmean(diff, w)) ** 2, w)
+    den = _wmean((y_true - _wmean(y_true, w)) ** 2, w)
+    return jnp.mean(1.0 - num / jnp.maximum(den, _EPS))
+
+
+def weighted_r2_score(y_true, y_pred, w) -> jnp.ndarray:
+    y_true, y_pred = _flatten_targets(y_true, y_pred)
+    ss_res = jnp.sum(w[:, None] * (y_true - y_pred) ** 2, axis=0)
+    ss_tot = jnp.sum(
+        w[:, None] * (y_true - _wmean(y_true, w)) ** 2, axis=0
+    )
+    return jnp.mean(1.0 - ss_res / jnp.maximum(ss_tot, _EPS))
+
+
+def weighted_mean_squared_error(y_true, y_pred, w) -> jnp.ndarray:
+    y_true, y_pred = _flatten_targets(y_true, y_pred)
+    return jnp.mean(_wmean((y_true - y_pred) ** 2, w))
+
+
+def weighted_mean_absolute_error(y_true, y_pred, w) -> jnp.ndarray:
+    y_true, y_pred = _flatten_targets(y_true, y_pred)
+    return jnp.mean(_wmean(jnp.abs(y_true - y_pred), w))
+
+
+WEIGHTED_METRICS = {
+    "explained_variance_score": weighted_explained_variance_score,
+    "r2_score": weighted_r2_score,
+    "mean_squared_error": weighted_mean_squared_error,
+    "mean_absolute_error": weighted_mean_absolute_error,
+}

@@ -12,16 +12,21 @@ a few jitted dispatches, sharded over the mesh ``"models"`` axis.  Output is
 M individually fitted :class:`DiffBasedAnomalyDetector` objects, artifact-
 and metadata-compatible with the single-machine path.
 
-Equivalence contract (tests/test_fleet.py): EVERY machine's result —
-CV-fold fits, fold metrics, thresholds, scaler stats, final params — is
-numerically identical to the single-machine path (same RNG derivation, same
-materialized fold rows, same per-fold batch geometry and shuffle).  This is
-achieved by grouping machines by row count inside each bucket: within a
-length-group, fold indices and batch geometry are shared static values, so
-each fold is materialized exactly as ``train.cv.cross_validate`` would
-(gather fold rows → fit scalers on them → window → pad to the fold's own
-``steps × bs``), then vmapped over machines.  A ragged bucket simply yields
-several length-groups, each exact — no weight-mask approximation anywhere.
+Equivalence contract (tests/test_fleet.py): in the default exact mode,
+EVERY machine's result — CV-fold fits, fold metrics, thresholds, scaler
+stats, final params — is numerically identical to the single-machine path
+(same RNG derivation, same materialized fold rows, same per-fold batch
+geometry and shuffle).  This is achieved by grouping machines by row count
+inside each bucket: within a length-group, fold indices and batch geometry
+are shared static values, so each fold is materialized exactly as
+``train.cv.cross_validate`` would (gather fold rows → fit scalers on them →
+window → pad to the fold's own ``steps × bs``), then vmapped over machines.
+A ragged bucket simply yields several length-groups, each exact — no
+weight-mask approximation anywhere.  The ONE exception is the opt-in
+``pad_lengths`` mode (:func:`_padded_fleet_program`), which deliberately
+trades that exactness for O(1) compiles on ragged buckets: rows are
+weight-masked rather than dropped, and fold/batch geometry derives from
+the padded length (see docs/fleet.md for the contract).
 
 Fleetability is *checked, not assumed*: :func:`analyze_definition` inspects
 a prototype built from the model-config definition and returns a spec only
@@ -148,14 +153,10 @@ def analyze_definition(model) -> Optional[FleetSpec]:
 # Pure device-side pieces
 # ---------------------------------------------------------------------------
 
-def _smoothed_max(err: jnp.ndarray, window: int) -> jnp.ndarray:
-    """Max over rows of the trailing rolling-min of ``err``.
-
-    Matches ``anomaly.diff._rolling_min_max`` (pandas ``rolling(window,
-    min_periods=1).min()`` then ``max()``) as a pure static-shape function.
-    ``err``: (N, F) — returns (F,).
-    """
-    neg = -jax.lax.reduce_window(
+def _trailing_rolling_min(err: jnp.ndarray, window: int) -> jnp.ndarray:
+    """Trailing rolling-min with ``min_periods=1`` semantics, (N, F)->(N, F)
+    (pandas ``rolling(window, min_periods=1).min()`` as a static-shape op)."""
+    return -jax.lax.reduce_window(
         -err,
         -jnp.inf,
         jax.lax.max,
@@ -163,7 +164,93 @@ def _smoothed_max(err: jnp.ndarray, window: int) -> jnp.ndarray:
         window_strides=(1, 1),
         padding=((window - 1, 0), (0, 0)),
     )
-    return jnp.max(neg, axis=0)
+
+
+def _smoothed_max(err: jnp.ndarray, window: int) -> jnp.ndarray:
+    """Max over rows of the trailing rolling-min of ``err``.
+
+    Matches ``anomaly.diff._rolling_min_max`` (pandas ``rolling(window,
+    min_periods=1).min()`` then ``max()``) as a pure static-shape function.
+    ``err``: (N, F) — returns (F,).
+    """
+    return jnp.max(_trailing_rolling_min(err, window), axis=0)
+
+
+def _masked_smoothed_max(err: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """(N, F) errors, (N,) row validity -> (F,): like :func:`_smoothed_max`
+    but rolling-min windows that END on a masked row are excluded from the
+    max.  With suffix padding every window ending on a real row contains
+    only real rows, so this is exact for the pad-up program."""
+    sm = _trailing_rolling_min(err, SMOOTHING_WINDOW)
+    sm = jnp.where(mask[:, None] > 0, sm, -jnp.inf)
+    mx = jnp.max(sm, axis=0)
+    return jnp.where(jnp.isfinite(mx), mx, 0.0)
+
+
+def _make_scale_chain(scaler_opts):
+    """``X_f (M, n, F) -> (stats_list, transformed)`` for the pipeline's
+    scaler chain: step i's stats are computed on step i-1's output
+    (pipeline semantics).  On NaN-padded rows the nan-aware stat
+    reductions exclude padding, and NaN propagates through apply so later
+    steps' stats exclude it too."""
+
+    def scale_chain(X_f):
+        stats_list = []
+        cur = X_f
+        for scaler_cls, opts in scaler_opts:
+            st = jax.vmap(
+                lambda xm: scaler_cls.compute_stats(xm, **dict(opts))
+            )(cur)
+            stats_list.append(st)
+            cur = jax.vmap(scaler_cls.apply)(st, cur)
+        return stats_list, cur
+
+    return scale_chain
+
+
+def _make_apply_chain(scaler_opts):
+    def apply_chain(stats_list, X_f):
+        cur = X_f
+        for (scaler_cls, _), st in zip(scaler_opts, stats_list):
+            cur = jax.vmap(scaler_cls.apply)(st, cur)
+        return cur
+
+    return apply_chain
+
+
+def _make_windowize(window_mode: str, lookback: int):
+    """Estimator windowing semantics on already-scaled inputs (see the
+    estimator classes: "none"=row-wise, "ae"=reconstruct window end,
+    "forecast"=t+1)."""
+    from gordo_tpu.ops.windows import make_windows
+
+    def windowize(Xt, y_f):
+        if window_mode == "none":
+            return Xt, y_f
+        if window_mode == "ae":
+            inputs = jax.vmap(lambda a: make_windows(a, lookback))(Xt)
+            return inputs, y_f[:, lookback - 1:]
+        if window_mode == "forecast":
+            inputs = jax.vmap(lambda a: make_windows(a[:-1], lookback))(Xt)
+            return inputs, y_f[:, lookback:]
+        raise ValueError(f"Unknown window_mode {window_mode!r}")
+
+    return windowize
+
+
+def _program_cache_get(key):
+    """LRU lookup in the shared jitted-program cache (touch on hit)."""
+    cached = _EXACT_PROGRAMS.pop(key, None)
+    if cached is not None:
+        _EXACT_PROGRAMS[key] = cached  # re-insert as newest
+    return cached
+
+
+def _program_cache_put(key, jitted):
+    if len(_EXACT_PROGRAMS) >= 128:  # bound growth across many-length fleets
+        _EXACT_PROGRAMS.pop(next(iter(_EXACT_PROGRAMS)))
+    _EXACT_PROGRAMS[key] = jitted
+    return jitted
 
 
 # ---------------------------------------------------------------------------
@@ -177,10 +264,23 @@ class FleetDiffBuilder:
     input order.
     """
 
-    def __init__(self, spec: FleetSpec, cv: Any = None, mesh: Optional[Mesh] = None):
+    def __init__(
+        self,
+        spec: FleetSpec,
+        cv: Any = None,
+        mesh: Optional[Mesh] = None,
+        pad_lengths: Optional[int] = None,
+    ):
         self.spec = spec
         self.splitter = build_splitter(cv)
         self.mesh = mesh
+        #: pad-up mode: machines grouped by row count rounded UP to a
+        #: multiple of this, padded with weight-masked rows — every real
+        #: row trains, and a ragged bucket needs one program per ALIGNED
+        #: length instead of one per distinct length.  See
+        #: :func:`_padded_fleet_program` for the (documented) CV-semantics
+        #: difference vs the exact per-length mode.
+        self.pad_lengths = int(pad_lengths) if pad_lengths else None
 
     # -- host-side orchestration --------------------------------------------
     def build(
@@ -206,6 +306,9 @@ class FleetDiffBuilder:
                         f"Target row count differs from input for machine {i}: "
                         f"{len(yy)} != {len(x)}"
                     )
+
+        if self.pad_lengths:
+            return self._build_padded(Xs, ys)
 
         groups: Dict[int, List[int]] = {}
         for i, x in enumerate(Xs):
@@ -236,10 +339,93 @@ class FleetDiffBuilder:
                 detectors[i] = det
         return detectors  # type: ignore[return-value]
 
-    def _build_group(
-        self, X: np.ndarray, y: np.ndarray
+    def _build_padded(
+        self,
+        Xs: Sequence[np.ndarray],
+        ys: Optional[Sequence[np.ndarray]],
     ) -> List[DiffBasedAnomalyDetector]:
-        """One length-homogeneous group as a single exact device program."""
+        """Pad-up mode: group by row count rounded UP to ``pad_lengths``,
+        NaN-pad each machine's rows to the group length (NaN rows fall out
+        of the nan-aware scaler stats; zero-weight rows fall out of the
+        loss), and run the masked program once per group.  Every real row
+        trains; a 16-length ragged bucket compiles O(1) programs."""
+        pad = self.pad_lengths
+        offset = int(self.spec.estimator_proto.offset)
+        groups: Dict[int, List[int]] = {}
+        exact_fallback: List[int] = []
+        for i, x in enumerate(Xs):
+            n_pad = -(-x.shape[0] // pad) * pad
+            groups.setdefault(n_pad, []).append(i)
+
+        detectors: List[Optional[DiffBasedAnomalyDetector]] = [None] * len(Xs)
+        for n_pad, idxs in list(groups.items()):
+            # Every fold's test block must contain real target rows for
+            # every machine, or its thresholds/metrics would be computed on
+            # nothing (0/0-guarded into silently-wrong zeros).  A machine
+            # shorter than the last fold's start (plus window context) can't
+            # satisfy that at this padded length — build it exactly instead.
+            min_len = (
+                max(
+                    int(te[0])
+                    for _, te in self.splitter.split(np.empty((n_pad, 1)))
+                )
+                + offset
+                + 1
+            )
+            short = [i for i in idxs if Xs[i].shape[0] < min_len]
+            if short:
+                logger.warning(
+                    "pad_lengths=%d: %d machine(s) are shorter than %d rows "
+                    "(their real rows would miss a CV test block at padded "
+                    "length %d) — building them through the exact per-length "
+                    "path instead",
+                    pad, len(short), min_len, n_pad,
+                )
+                exact_fallback.extend(short)
+                idxs = [i for i in idxs if i not in set(short)]
+                if not idxs:
+                    del groups[n_pad]
+                    continue
+                groups[n_pad] = idxs
+
+        by_len: Dict[int, List[int]] = {}
+        for i in exact_fallback:
+            by_len.setdefault(Xs[i].shape[0], []).append(i)
+        for idxs_ex in by_len.values():
+            X_g = np.stack([Xs[i] for i in idxs_ex])
+            y_g = (
+                X_g
+                if ys is None
+                else np.stack([np.asarray(ys[i], np.float32) for i in idxs_ex])
+            )
+            for i, det in zip(idxs_ex, self._build_group(X_g, y_g)):
+                detectors[i] = det
+
+        for n_pad, idxs in groups.items():
+            m = len(idxs)
+            n_feat = Xs[idxs[0]].shape[1]
+            n_out = (
+                n_feat if ys is None else np.asarray(ys[idxs[0]]).shape[1]
+            )
+            X = np.full((m, n_pad, n_feat), np.nan, np.float32)
+            y = np.full((m, n_pad, n_out), np.nan, np.float32)
+            lens = np.zeros((m,), np.int32)
+            for j, i in enumerate(idxs):
+                L = Xs[i].shape[0]
+                lens[j] = L
+                X[j, :L] = Xs[i]
+                y[j, :L] = Xs[i] if ys is None else np.asarray(
+                    ys[i], np.float32
+                )
+            for i, det in zip(idxs, self._build_group(X, y, lens=lens)):
+                detectors[i] = det
+        return detectors  # type: ignore[return-value]
+
+    def _build_group(
+        self, X: np.ndarray, y: np.ndarray, lens: Optional[np.ndarray] = None
+    ) -> List[DiffBasedAnomalyDetector]:
+        """One length-homogeneous group as a single exact device program
+        (``lens`` given: the masked pad-up program instead)."""
         spec = self.spec
         est_proto = spec.estimator_proto
         offset = int(est_proto.offset)
@@ -268,6 +454,8 @@ class FleetDiffBuilder:
         if m_pad != m:
             X = fleet_mod._pad_models(X, m_pad)
             y = fleet_mod._pad_models(y, m_pad)
+            if lens is not None:
+                lens = fleet_mod._pad_models(np.asarray(lens, np.int32), m_pad)
 
         scaler_opts = tuple(
             (type(s), tuple(sorted(s._stat_options().items())))
@@ -289,19 +477,36 @@ class FleetDiffBuilder:
         else:
             window_mode, lookback = "none", 1
 
-        program = _exact_fleet_program(
-            module,
-            scaler_opts,
-            det_scaler_opts,
-            window_mode,
-            int(lookback),
-            offset,
-            spec.train_cfg,
-            folds,
-            self.mesh,
-        )
         seeds = np.full((m_pad,), spec.seed, dtype=np.uint32)
-        out = program(jnp.asarray(X), jnp.asarray(y), jnp.asarray(seeds))
+        if lens is None:
+            program = _exact_fleet_program(
+                module,
+                scaler_opts,
+                det_scaler_opts,
+                window_mode,
+                int(lookback),
+                offset,
+                spec.train_cfg,
+                folds,
+                self.mesh,
+            )
+            out = program(jnp.asarray(X), jnp.asarray(y), jnp.asarray(seeds))
+        else:
+            program = _padded_fleet_program(
+                module,
+                scaler_opts,
+                det_scaler_opts,
+                window_mode,
+                int(lookback),
+                offset,
+                spec.train_cfg,
+                folds,
+                self.mesh,
+            )
+            out = program(
+                jnp.asarray(X), jnp.asarray(y), jnp.asarray(lens),
+                jnp.asarray(seeds),
+            )
         out = to_host(out)
         fleet_seconds = time.time() - t0
 
@@ -424,52 +629,20 @@ def _exact_fleet_program(
         folds_digest,
         mesh,
     )
-    cached = _EXACT_PROGRAMS.pop(key, None)
+    cached = _program_cache_get(key)
     if cached is not None:
-        _EXACT_PROGRAMS[key] = cached  # LRU touch: re-insert as newest
         return cached
-    if len(_EXACT_PROGRAMS) >= 128:  # bound growth across many-length fleets
-        _EXACT_PROGRAMS.pop(next(iter(_EXACT_PROGRAMS)))
 
     from gordo_tpu.ops import metrics as jmetrics
-    from gordo_tpu.ops.windows import make_windows
     from gordo_tpu.train.fit import batch_geometry
 
     det_cls, det_opts = det_scaler_opts
     fold_idx = [
         (np.asarray(tr, np.int32), np.asarray(te, np.int32)) for tr, te in folds
     ]
-
-    def scale_chain(X_f):
-        """Fit the pipeline scaler chain on (M, n, F) rows; step i's stats
-        are computed on step i-1's output (pipeline semantics)."""
-        stats_list = []
-        cur = X_f
-        for scaler_cls, opts in scaler_opts:
-            st = jax.vmap(
-                lambda xm: scaler_cls.compute_stats(xm, **dict(opts))
-            )(cur)
-            stats_list.append(st)
-            cur = jax.vmap(scaler_cls.apply)(st, cur)
-        return stats_list, cur
-
-    def apply_chain(stats_list, X_f):
-        cur = X_f
-        for (scaler_cls, _), st in zip(scaler_opts, stats_list):
-            cur = jax.vmap(scaler_cls.apply)(st, cur)
-        return cur
-
-    def windowize(Xt, y_f):
-        """Estimator windowing semantics on already-scaled inputs."""
-        if window_mode == "none":
-            return Xt, y_f
-        if window_mode == "ae":
-            inputs = jax.vmap(lambda a: make_windows(a, lookback))(Xt)
-            return inputs, y_f[:, lookback - 1:]
-        if window_mode == "forecast":
-            inputs = jax.vmap(lambda a: make_windows(a[:-1], lookback))(Xt)
-            return inputs, y_f[:, lookback:]
-        raise ValueError(f"Unknown window_mode {window_mode!r}")
+    scale_chain = _make_scale_chain(scaler_opts)
+    apply_chain = _make_apply_chain(scaler_opts)
+    windowize = _make_windowize(window_mode, lookback)
 
     def one_fit(params0, inputs, targets, fit_keys):
         """vmapped fit with THIS fold's true batch geometry (exactly
@@ -580,6 +753,206 @@ def _exact_fleet_program(
             out = jax.lax.with_sharding_constraint(out, model_sharding(mesh))
         return out
 
-    jitted = jax.jit(program)
-    _EXACT_PROGRAMS[key] = jitted
-    return jitted
+    return _program_cache_put(key, jax.jit(program))
+
+
+def _padded_fleet_program(
+    module,
+    scaler_opts,
+    det_scaler_opts,
+    window_mode: str,
+    lookback: int,
+    offset: int,
+    cfg: TrainConfig,
+    folds: Tuple[Tuple[Tuple[int, ...], Tuple[int, ...]], ...],
+    mesh,
+):
+    """The pad-up program ``(X, y, lens, seeds) -> out`` — ragged fleets
+    without data loss.
+
+    ``X``/``y`` arrive NaN-padded past each machine's true row count
+    (``lens``).  Row padding is handled by masking, never by dropping:
+
+    - scaler stats: computed on the NaN-padded series — every fleetable
+      scaler's stats are nan-aware reductions, so padding simply falls out;
+    - training: zero-filled padding rows carry zero loss weight (the
+      weight-mask machinery of ``train.fit.make_loss_fn``);
+    - CV metrics: row-weighted metric variants (``ops.metrics``);
+    - thresholds: rolling-smoothed errors at padded rows are masked to
+      ``-inf`` before the row-max (padding is a SUFFIX, so every window
+      ending on a real row contains only real rows).
+
+    Semantics difference vs the exact per-length mode (documented
+    contract, ``docs/fleet.md``): CV fold boundaries and minibatch
+    geometry derive from the PADDED length, so a machine whose true length
+    differs from the group length sees slightly different fold membership
+    and shuffle partitions than its single-machine build would.  For
+    machines already at the aligned length the program is the exact one
+    (all-ones masks) — ``tests/test_fleet.py`` pins that parity.  ``lens``
+    is a traced argument: machine-length variation never recompiles; only
+    the padded group length does.
+    """
+    folds_digest = hashlib.md5(repr(folds).encode()).hexdigest()
+    key = (
+        "padded",
+        module,
+        scaler_opts,
+        det_scaler_opts,
+        window_mode,
+        lookback,
+        offset,
+        cfg,
+        folds_digest,
+        mesh,
+    )
+    cached = _program_cache_get(key)
+    if cached is not None:
+        return cached
+
+    from gordo_tpu.ops.metrics import WEIGHTED_METRICS
+    from gordo_tpu.train.fit import batch_geometry, make_fit_fn
+
+    det_cls, det_opts = det_scaler_opts
+    fold_idx = [
+        (np.asarray(tr, np.int32), np.asarray(te, np.int32)) for tr, te in folds
+    ]
+    # the shared scale-chain on NaN-padded rows: nan-aware stat reductions
+    # exclude padding, and NaN propagates through apply so step i+1's
+    # stats exclude it too; the transformed output is discarded (training
+    # inputs are rebuilt from the zero-padded arrays)
+    scale_chain = _make_scale_chain(scaler_opts)
+    apply_chain = _make_apply_chain(scaler_opts)
+    windowize = _make_windowize(window_mode, lookback)
+
+    def one_fit(params0, inputs, targets, wv, fit_keys):
+        """vmapped fit with PER-MACHINE weights: fold batch geometry from
+        the padded length, real rows weighted 1, padding 0."""
+        m = inputs.shape[0]
+        na = inputs.shape[1]
+        steps, bs, n_pad = batch_geometry(na, cfg.batch_size)
+        if n_pad:
+            inputs = jnp.concatenate(
+                [inputs, jnp.zeros((m, n_pad) + inputs.shape[2:], inputs.dtype)],
+                axis=1,
+            )
+            targets = jnp.concatenate(
+                [targets, jnp.zeros((m, n_pad) + targets.shape[2:], targets.dtype)],
+                axis=1,
+            )
+            wv = jnp.concatenate(
+                [wv, jnp.zeros((m, n_pad), wv.dtype)], axis=1
+            )
+        fit_fn = make_fit_fn(module, cfg, steps, bs)
+        return jax.vmap(fit_fn)(params0, inputs, targets, wv, fit_keys)
+
+    vapply = jax.vmap(lambda p, x: module.apply({"params": p}, x))
+    masked_smoothed_max = _masked_smoothed_max
+
+    def program(X, y, lens, seeds):
+        # X: (M, N, F) NaN-padded, y: (M, N, Fout) NaN-padded, lens: (M,)
+        init_keys, fit_keys = fleet_mod.fleet_keys(seeds)
+        n = X.shape[1]
+        valid = (
+            jnp.arange(n, dtype=jnp.int32)[None, :] < lens[:, None]
+        ).astype(jnp.float32)                       # (M, N)
+        Xz = jnp.where(jnp.isnan(X), 0.0, X)
+        yz = jnp.where(jnp.isnan(y), 0.0, y)
+
+        det_stats = jax.vmap(
+            lambda ym: det_cls.compute_stats(ym, **dict(det_opts))
+        )(y)                                        # nan-aware: pads fall out
+
+        full_stats, _ = scale_chain(X)
+        Xt_full = jnp.where(
+            valid[..., None] > 0, apply_chain(full_stats, Xz), 0.0
+        )
+        inputs_full, targets_full = windowize(Xt_full, yz)
+        wv_full = valid[:, offset:] if offset else valid
+        params0 = fleet_mod.fleet_init(module, init_keys, inputs_full[0, :1])
+
+        per_step_stats: List[List[Any]] = [[] for _ in scaler_opts]
+        feat_maxes, feat_has = [], []
+        total_maxes = []
+        metric_vals: Dict[str, List[Any]] = {n_: [] for n_ in METRIC_NAMES}
+
+        for tr, te in fold_idx:
+            X_tr_nan = jnp.take(X, tr, axis=1)
+            stats_k, _ = scale_chain(X_tr_nan)
+            valid_tr = jnp.take(valid, tr, axis=1)
+            Xt = jnp.where(
+                valid_tr[..., None] > 0,
+                apply_chain(stats_k, jnp.take(Xz, tr, axis=1)),
+                0.0,
+            )
+            inputs, targets = windowize(Xt, jnp.take(yz, tr, axis=1))
+            wv = valid_tr[:, offset:] if offset else valid_tr
+            params_k, _ = one_fit(params0, inputs, targets, wv, fit_keys)
+
+            valid_te = jnp.take(valid, te, axis=1)
+            Xt_te = jnp.where(
+                valid_te[..., None] > 0,
+                apply_chain(stats_k, jnp.take(Xz, te, axis=1)),
+                0.0,
+            )
+            y_te = jnp.take(yz, te, axis=1)
+            te_inputs, _ = windowize(Xt_te, y_te)
+            pred = vapply(params_k, te_inputs)
+            y_true = y_te[:, offset:]
+            wv_te = valid_te[:, offset:] if offset else valid_te
+
+            for name in METRIC_NAMES:
+                metric_vals[name].append(
+                    jax.vmap(WEIGHTED_METRICS[name])(y_true, pred, wv_te)
+                )
+            y_s = jax.vmap(det_cls.apply, in_axes=(0, 0))(det_stats, y_true)
+            p_s = jax.vmap(det_cls.apply, in_axes=(0, 0))(det_stats, pred)
+            tag_err = jnp.abs(p_s - y_s)
+            total = jnp.linalg.norm(tag_err, axis=-1)
+            feat_maxes.append(jax.vmap(masked_smoothed_max)(tag_err, wv_te))
+            total_maxes.append(
+                jax.vmap(
+                    lambda t, w: masked_smoothed_max(t[:, None], w)[0]
+                )(total, wv_te)
+            )
+            feat_has.append((jnp.sum(wv_te, axis=1) > 0).astype(jnp.float32))
+            for j, st in enumerate(stats_k):
+                per_step_stats[j].append(st)
+
+        final_params, final_history = one_fit(
+            params0, inputs_full, targets_full, wv_full, fit_keys
+        )
+        for j, st in enumerate(full_stats):
+            per_step_stats[j].append(st)
+
+        # fold means weighted by "this machine had any valid test rows in
+        # this fold" — _build_padded demotes machines too short for the
+        # fold layout to the exact path, so this is belt-and-braces against
+        # a 0/0 NaN-ing the artifact
+        has = jnp.stack(feat_has, axis=1)            # (M, K)
+        denom = jnp.maximum(jnp.sum(has, axis=1), 1.0)
+        out = {
+            "scaler_stats": [
+                {
+                    stat: jnp.stack([s[stat] for s in fold_stats], axis=1)
+                    for stat in fold_stats[0]
+                }
+                for fold_stats in per_step_stats
+            ],
+            "det_scaler_stats": det_stats,
+            "final_params": final_params,
+            "final_history": final_history,
+            "feature_thresholds": jnp.sum(
+                jnp.stack(feat_maxes, axis=1) * has[:, :, None], axis=1
+            ) / denom[:, None],
+            "aggregate_threshold": jnp.sum(
+                jnp.stack(total_maxes, axis=1) * has, axis=1
+            ) / denom,
+            "metrics": {
+                name: jnp.stack(v, axis=1) for name, v in metric_vals.items()
+            },
+        }
+        if mesh is not None:
+            out = jax.lax.with_sharding_constraint(out, model_sharding(mesh))
+        return out
+
+    return _program_cache_put(key, jax.jit(program))

@@ -254,6 +254,96 @@ class TestFleetDiffBuilder:
         )
 
 
+def test_pad_lengths_parity_on_already_aligned_data(sine_tags):
+    """pad-up mode with machines ALREADY at the aligned length runs with
+    all-ones masks — results must match the exact per-length program
+    (same folds, same geometry, same RNG)."""
+    Xs = [sine_tags[:400], (sine_tags[:400] * 1.1).astype(np.float32)]
+    spec = analyze_definition(from_definition(DETECTOR_DEF))
+    exact = FleetDiffBuilder(spec).build(Xs)
+    padded = FleetDiffBuilder(spec, pad_lengths=100).build(Xs)
+
+    for Xi, de, dp in zip(Xs, exact, padded):
+        np.testing.assert_allclose(
+            dp.feature_thresholds_, de.feature_thresholds_,
+            rtol=1e-4, atol=1e-6,
+        )
+        assert dp.aggregate_threshold_ == pytest.approx(
+            de.aggregate_threshold_, rel=1e-4
+        )
+        for name, stats in de.cv_metadata_["scores"].items():
+            np.testing.assert_allclose(
+                dp.cv_metadata_["scores"][name]["folds"], stats["folds"],
+                rtol=1e-3, atol=1e-5,
+            )
+        np.testing.assert_allclose(
+            dp.anomaly(Xi)[("total-anomaly-score", "")].to_numpy(),
+            de.anomaly(Xi)[("total-anomaly-score", "")].to_numpy(),
+            rtol=1e-4, atol=1e-5,
+        )
+
+
+def test_pad_lengths_ragged_one_program_zero_rows_dropped(
+    sine_tags, monkeypatch
+):
+    """16 distinct row counts inside one pad boundary -> ONE masked
+    program (not 16 exact ones), with every real row trained and sane
+    finite thresholds for every machine."""
+    from gordo_tpu.parallel import anomaly as anomaly_mod
+
+    lengths = [400 - 6 * i for i in range(16)]       # 400..310, all -> 400
+    Xs = [sine_tags[:L] for L in lengths]
+    spec = analyze_definition(from_definition(DETECTOR_DEF))
+
+    calls = []
+    orig = FleetDiffBuilder._build_group
+
+    def counting(self, X, y, lens=None):
+        calls.append((X.shape, None if lens is None else tuple(lens)))
+        return orig(self, X, y, lens=lens)
+
+    monkeypatch.setattr(anomaly_mod.FleetDiffBuilder, "_build_group", counting)
+
+    detectors = FleetDiffBuilder(spec, pad_lengths=100).build(Xs)
+    assert len(calls) == 1                            # O(1) compiles
+    shape, lens = calls[0]
+    assert shape == (16, 400, sine_tags.shape[1])
+    assert sorted(lens) == sorted(lengths)            # zero rows dropped
+
+    for Xi, det in zip(Xs, detectors):
+        assert np.all(np.isfinite(det.feature_thresholds_))
+        assert det.feature_thresholds_.min() > 0
+        assert np.isfinite(det.aggregate_threshold_)
+        scores = det.anomaly(Xi)
+        assert len(scores) == len(Xi)                 # all rows score
+
+
+def test_pad_lengths_too_short_machine_demotes_to_exact(sine_tags, caplog):
+    """A machine whose real rows would miss an entire CV test block at the
+    padded length must NOT get silently-zero thresholds — it builds through
+    the exact per-length path instead (with a warning)."""
+    import logging
+
+    # 600-row pad boundary: TimeSeriesSplit(3) test blocks start at 150/
+    # 300/450 — an 80-row machine would contribute no real test rows
+    Xs = [sine_tags[:600], sine_tags[:80]]
+    spec = analyze_definition(from_definition(DETECTOR_DEF))
+    with caplog.at_level(logging.WARNING, logger="gordo_tpu.parallel.anomaly"):
+        detectors = FleetDiffBuilder(spec, pad_lengths=600).build(Xs)
+    assert any("exact per-length path" in r.message for r in caplog.records)
+
+    # the short machine matches its single-machine build exactly
+    single = from_definition(DETECTOR_DEF)
+    single.cross_validate(Xs[1])
+    single.fit(Xs[1])
+    np.testing.assert_allclose(
+        detectors[1].feature_thresholds_, single.feature_thresholds_,
+        rtol=1e-4, atol=1e-6,
+    )
+    assert detectors[1].feature_thresholds_.min() > 0
+    assert detectors[0].feature_thresholds_.min() > 0
+
+
 def test_fleet_build_ragged_lengths_exact(sine_tags):
     """Machines of DIFFERENT lengths in one bucket: each length-group runs
     its own exact program, so every machine (not just the longest) matches

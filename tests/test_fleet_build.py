@@ -332,6 +332,78 @@ def test_align_lengths_collapses_ragged_row_counts(tmp_path, monkeypatch):
     assert sorted(x for s in seen_lengths for x in s) == [122, 128, 134]
 
 
+def test_pad_lengths_keeps_rows_and_collapses_programs(tmp_path, monkeypatch):
+    """pad_lengths: ragged machines collapse into one padded group with NO
+    rows dropped; artifacts record the mode; mutually exclusive with
+    align_lengths; cache identity differs from an exact build."""
+    from gordo_tpu.builder import fleet_build as fb
+    from gordo_tpu.workflow.config import Machine
+
+    def machine(i, hours):
+        day = 25 + (6 + hours) // 24
+        hh = (6 + hours) % 24
+        return Machine.from_config({
+            "name": f"pad-{i}",
+            "dataset": {
+                "type": "RandomDataset",
+                "tag_list": ["a", "b", "c"],
+                "train_start_date": "2017-12-25T06:00:00Z",
+                "train_end_date": f"2017-12-{day}T{hh:02d}:10:00Z",
+            },
+        })
+
+    machines = [machine(i, h) for i, h in enumerate((20, 21, 22))]
+
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        build_project(
+            machines, str(tmp_path / "x"), align_lengths=60, pad_lengths=60,
+        )
+
+    # pad=72: rows 122/128/134 all round up to 144, and every machine
+    # still reaches the last CV test block (starts at row 108) — one group
+    pad = 72
+
+    seen = []
+    orig = fb.FleetDiffBuilder._build_group
+
+    def recording(self, X, y, lens=None):
+        seen.append((X.shape[1], None if lens is None else list(lens)))
+        return orig(self, X, y, lens=lens)
+
+    monkeypatch.setattr(fb.FleetDiffBuilder, "_build_group", recording)
+
+    reg = tmp_path / "reg"
+    result = build_project(
+        machines, str(tmp_path / "padded"), model_register_dir=str(reg),
+        pad_lengths=pad,
+    )
+    assert not result.failed and len(result.fleet_built) == 3
+    # one padded group: rows 122/128/134 all pad up to 144
+    assert len(seen) == 1 and seen[0][0] == 144
+    assert sorted(seen[0][1]) == [122, 128, 134]
+
+    meta = serializer.load_metadata(result.artifacts["pad-0"])
+    assert meta["model"]["pad_lengths"] == pad
+    assert meta["model"]["rows_trained"] == 122
+
+    # an exact re-run over the same register must MISS (different identity)
+    seen.clear()
+    rerun = build_project(
+        machines, str(tmp_path / "exact"), model_register_dir=str(reg),
+    )
+    assert not rerun.failed and rerun.cached == []
+    assert len(seen) == 3  # exact mode: one program per distinct length
+
+    # identical padded re-run: every machine is a cache hit
+    seen.clear()
+    again = build_project(
+        machines, str(tmp_path / "padded2"), model_register_dir=str(reg),
+        pad_lengths=pad,
+    )
+    assert sorted(again.cached) == ["pad-0", "pad-1", "pad-2"]
+    assert seen == []
+
+
 def test_align_lengths_changes_cache_identity(tmp_path):
     """An artifact built with alignment must not satisfy an exact-parity
     build's cache lookup (and vice versa) — alignment changes what data
