@@ -31,6 +31,7 @@ image:
 	docker build -t $(IMAGE):$(TAG) .
 
 docs:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu $(PYTHON) scripts/gen_api_docs.py
 	@ls docs/*.md
 
 clean:

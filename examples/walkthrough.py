@@ -78,6 +78,33 @@ def main():
                 rows = 0 if res.predictions is None else len(res.predictions)
                 print(res.name, "->", rows, "scored rows",
                       "(ok)" if res.ok else res.error_messages)
+
+            # 5. Watchman: the fleet-health poller that fronts a project —
+            # point it at the server, poll once, read the status document
+            from gordo_tpu.watchman import Watchman, build_watchman_app
+
+            watchman = Watchman(
+                project="demo",
+                machines=sorted(
+                    m["name"] for m in PROJECT["machines"]
+                ),
+                target_base_urls=[f"http://127.0.0.1:{port}"],
+                poll_interval=3600,  # we poll by hand below
+            )
+            wm_runner = web.AppRunner(build_watchman_app(watchman))
+            await wm_runner.setup()
+            wm_site = web.TCPSite(wm_runner, "127.0.0.1", 0)
+            await wm_site.start()
+            try:
+                await watchman.refresh()
+                doc = watchman.to_json()
+                healthy = sum(
+                    1 for e in doc["endpoints"] if e["healthy"]
+                )
+                print(f"watchman: {healthy}/{len(doc['endpoints'])} "
+                      "endpoints healthy")
+            finally:
+                await wm_runner.cleanup()
         finally:
             await runner.cleanup()
 
