@@ -71,7 +71,12 @@ class RandomDataProvider(GordoBaseDataProvider):
         # one shared grid for every tag (identical period/count) — building
         # it per tag made date_range the provider's dominant cost at fleet
         # scale (measured ~40% of load_series)
-        index = pd.date_range(start=from_ts, end=to_ts, periods=n, name="time")
+        # ns unit up front: tz-aware periods-based date_range yields a
+        # µs-resolution index, and every downstream resample would pay its
+        # own as_unit("ns") conversion per tag
+        index = pd.date_range(
+            start=from_ts, end=to_ts, periods=n, name="time"
+        ).as_unit("ns")
         for tag in tags:
             # Stable digest (Python's hash() is salted per process and would
             # break cross-process reproducibility / the build cache contract).

@@ -163,69 +163,150 @@ class TimeSeriesDataset(GordoBaseDataset):
         self._metadata: Dict[str, Any] = {}
 
     # -- assembly ------------------------------------------------------------
-    def _resample_one(self, series: pd.Series) -> pd.Series:
-        """Resample a single tag's series to ``self.resolution``.
+    _DAY_NS = 86_400_000_000_000
 
-        Mean aggregation of a UTC series over a fixed-width resolution takes
-        a vectorized O(n) path (``np.add.reduceat`` over bin boundaries) —
-        at fleet scale the per-tag pandas ``resample().mean()`` dominated
-        project-build wall time by ~10x.  The output is bin-for-bin
-        identical to pandas (origin = midnight of the first sample's day,
-        left-closed/left-labeled, empty bins NaN).  Non-mean aggregations,
-        non-fixed frequencies, and non-UTC/naive indexes (DST-dependent bin
-        labels) use pandas.
+    def _resample_one_arrays(self, series: pd.Series, _memo=None):
+        """Vectorized resample of one tag to ``(values, label_index)``, or
+        None when only the pandas path applies.
+
+        Mean aggregation of a UTC series over a fixed-width resolution is
+        O(n) ``np.add.reduceat`` over bin boundaries — at fleet scale the
+        per-tag pandas ``resample().mean()`` dominated project-build wall
+        time by ~10x.  Output is bin-for-bin identical to pandas (origin =
+        midnight of the first sample's day, left-closed/left-labeled,
+        empty bins NaN).  Returning raw arrays (not a Series) lets the
+        join build its matrix without ever materializing per-tag pandas
+        objects — the Series constructor itself was ~25% of assembly.
         """
         if (
             self.aggregation_methods != "mean"
             or len(series) == 0
             or str(series.index.tz) != "UTC"
         ):
-            return series.resample(self.resolution).agg(self.aggregation_methods)
+            return None
         try:
             nanos = pd.tseries.frequencies.to_offset(self.resolution).nanos
         except ValueError:  # non-fixed frequency (e.g. months) — pandas path
-            return series.resample(self.resolution).agg(self.aggregation_methods)
+            return None
 
         if not series.index.is_monotonic_increasing:
             series = series.sort_index()
-        # pandas 2.x indexes may be us/ms-resolution; do all math in ns
-        idx = series.index.as_unit("ns").asi8
+        # The binning geometry (ns timestamps, bin boundaries, scatter
+        # positions, label index) depends only on the index object — and a
+        # provider yields every tag of one machine on ONE shared index, so
+        # it is computed once per machine, not once per tag.
+        index = series.index
+        prep = _memo.get(id(index)) if _memo is not None else None
+        if prep is None:
+            # pandas 2.x indexes may be us/ms-resolution; do the math in ns
+            idx = (
+                index.asi8 if index.unit == "ns"
+                else index.as_unit("ns").asi8
+            )
+            # midnight UTC of the first sample as pure integer math
+            # (Timestamp.normalize() was a measurable per-tag cost)
+            origin = (idx[0] // self._DAY_NS) * self._DAY_NS
+            bins = (idx - origin) // nanos
+            starts = np.concatenate(
+                [[0], np.flatnonzero(np.diff(bins)) + 1]
+            )
+            grid_size = int(bins[-1] - bins[0]) + 1
+            scatter = (bins[starts] - bins[0]).astype(np.int64)
+            label_index = _bin_label_index(
+                origin, int(bins[0]), int(bins[-1]), nanos,
+                series.index.name,
+            )
+            # the entry holds the index object itself: the memo is keyed by
+            # id(), and letting the index be GC'd could recycle its id for
+            # a DIFFERENT index within the same join
+            prep = (index, starts, grid_size, scatter, label_index)
+            if _memo is not None:
+                _memo[id(index)] = prep
+        _, starts, grid_size, scatter, label_index = prep
         values = series.to_numpy(dtype=np.float64, copy=False)
-        origin = series.index[0].normalize().as_unit("ns").value
-        bins = (idx - origin) // nanos
-        starts = np.concatenate(
-            [[0], np.flatnonzero(np.diff(bins)) + 1]
-        )
         # NaN samples must not poison bucket means (pandas mean skips them)
         nan_mask = np.isnan(values)
         sums = np.add.reduceat(np.where(nan_mask, 0.0, values), starts)
         valid = np.add.reduceat((~nan_mask).astype(np.int64), starts)
-        with np.errstate(invalid="ignore"):
-            means = np.where(valid > 0, sums / np.maximum(valid, 1), np.nan)
+        # where= keeps the empty-bin lanes NaN without an errstate guard
+        means = np.divide(
+            sums, valid, out=np.full(sums.shape, np.nan), where=valid > 0
+        )
         # scatter onto the COMPLETE bin grid (empty bins NaN) so length,
         # labels, and metadata match the pandas path exactly
-        grid = np.full(int(bins[-1] - bins[0]) + 1, np.nan)
-        grid[(bins[starts] - bins[0]).astype(np.int64)] = means
-        index = _bin_label_index(
-            origin, int(bins[0]), int(bins[-1]), nanos, series.index.name
-        )
-        return pd.Series(grid, index=index, name=series.name)
+        grid = np.full(grid_size, np.nan)
+        grid[scatter] = means
+        return grid, label_index
+
+    def _resample_one(self, series: pd.Series) -> pd.Series:
+        """Resample a single tag's series to ``self.resolution`` (the
+        vectorized path when applicable, else pandas)."""
+        fast = self._resample_one_arrays(series)
+        if fast is None:
+            return series.resample(self.resolution).agg(
+                self.aggregation_methods
+            )
+        grid, label_index = fast
+        return pd.Series(grid, index=label_index, name=series.name)
 
     def _join_timeseries(self, series_iter) -> pd.DataFrame:
-        frames = []
+        entries = []            # ("fast", name, values, label_index) |
+        all_fast = True         # ("slow", aggregated pandas object)
         metadata = {}
+        idx_memo: Dict[int, Any] = {}
         for series in series_iter:
             raw_len = len(series)
-            agg = self._resample_one(series) if raw_len else series
-            if isinstance(agg, pd.DataFrame):  # multiple aggregation methods
-                agg.columns = [f"{series.name}_{m}" for m in agg.columns]
+            fast = (
+                self._resample_one_arrays(series, idx_memo)
+                if raw_len else None
+            )
+            if fast is not None:
+                grid, label_index = fast
+                entries.append(("fast", series.name, grid, label_index))
+                n_out = len(grid)
             else:
-                agg.name = series.name
-            frames.append(agg)
+                all_fast = False
+                agg = (
+                    series.resample(self.resolution).agg(
+                        self.aggregation_methods
+                    )
+                    if raw_len
+                    else series
+                )
+                if isinstance(agg, pd.DataFrame):  # multi-agg methods
+                    agg.columns = [f"{series.name}_{m}" for m in agg.columns]
+                else:
+                    agg.name = series.name
+                entries.append(("slow", agg))
+                n_out = len(agg)
             metadata[str(series.name)] = {
                 "original_length": int(raw_len),
-                "resampled_length": int(len(agg)),
+                "resampled_length": int(n_out),
             }
+        self._metadata["tag_loading_metadata"] = metadata
+
+        if all_fast and entries and all(
+            e[3] is entries[0][3] or e[3].equals(entries[0][3])
+            for e in entries[1:]
+        ):
+            # all-fast, identical label grids (guaranteed when tags share a
+            # provider period and the label-index cache hits): build the
+            # matrix directly and drop NaN rows with one vectorized mask —
+            # no per-tag Series, no concat alignment, no block dropna
+            mat = np.column_stack([e[2] for e in entries])
+            keep = ~np.isnan(mat).any(axis=1)
+            return pd.DataFrame(
+                mat[keep],
+                index=entries[0][3][keep],
+                columns=[e[1] for e in entries],
+            )
+        # mixed/slow path: materialize fast columns as Series (original
+        # iteration order preserved) and join through pandas
+        frames = [
+            e[1] if e[0] == "slow"
+            else pd.Series(e[2], index=e[3], name=e[1])
+            for e in entries
+        ]
         if (
             len(frames) > 1
             and all(
@@ -234,11 +315,6 @@ class TimeSeriesDataset(GordoBaseDataset):
             )
             and all(f.index.equals(frames[0].index) for f in frames[1:])
         ):
-            # identical indexes (regular-grid case — guaranteed when tags
-            # share a provider period and the label-index cache hits): skip
-            # concat's alignment machinery and build the matrix directly
-            # (measured ~4x on the fleet-build hot path; inner join over
-            # equal indexes is the identity)
             joined = pd.DataFrame(
                 np.column_stack([f.to_numpy() for f in frames]),
                 index=frames[0].index,
@@ -246,7 +322,6 @@ class TimeSeriesDataset(GordoBaseDataset):
             ).dropna()
         else:
             joined = pd.concat(frames, axis=1, join="inner").dropna()
-        self._metadata["tag_loading_metadata"] = metadata
         return joined
 
     def get_data(self) -> Tuple[pd.DataFrame, pd.DataFrame]:
