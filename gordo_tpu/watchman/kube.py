@@ -8,6 +8,12 @@ discovery here finds *server Services* by label and hands their URLs to
 discovery then rides each server's own project index
 (``endpoints_status.discover_machines``).
 
+Like the reference, discovery is event-driven AND polled: a background
+WATCH thread streams Service add/modify/delete events into a live
+target cache (fleet membership changes propagate within event latency,
+not at poll cadence), while the plain list path remains both the
+watch-seeding resync and the fallback when watching is off or broken.
+
 Import-gated on the ``kubernetes`` client package (not in the TPU image);
 tests fake the module in ``sys.modules`` — the reference mocked the k8s
 client the same way (SURVEY.md §5 watchman bullet).
@@ -16,7 +22,8 @@ client the same way (SURVEY.md §5 watchman bullet).
 from __future__ import annotations
 
 import logging
-from typing import List, Optional
+import threading
+from typing import Callable, Dict, List, Optional
 
 logger = logging.getLogger(__name__)
 
@@ -60,20 +67,127 @@ class KubeTargetDiscovery:
         )
         self.scheme = scheme
         self._core = client.CoreV1Api()
+        #: live Service-name -> URL cache maintained by the watch thread;
+        #: None means "not watching" and targets() falls back to listing
+        self._watch_cache: Optional[Dict[str, str]] = None
+        self._watch_lock = threading.Lock()
+        self._watch_stop = threading.Event()
+        self._watch_thread: Optional[threading.Thread] = None
+        #: thread-context callback fired when the watched target set
+        #: changes (Watchman bridges it onto its event loop to refresh
+        #: immediately instead of waiting out the poll interval)
+        self.on_change: Optional[Callable[[], None]] = None
 
-    def targets(self) -> List[str]:
-        """Current server base URLs (one per matching Service)."""
-        urls: List[str] = []
+    def _svc_url(self, svc) -> str:
+        ports = svc.spec.ports or []
+        port = ports[0].port if ports else 80
+        return f"{self.scheme}://{svc.metadata.name}.{self.namespace}:{port}"
+
+    def _list_urls(self) -> Dict[str, str]:
         services = self._core.list_namespaced_service(
             self.namespace, label_selector=self.label_selector
         )
-        for svc in services.items:
-            name = svc.metadata.name
-            ports = svc.spec.ports or []
-            port = ports[0].port if ports else 80
-            urls.append(f"{self.scheme}://{name}.{self.namespace}:{port}")
+        return {svc.metadata.name: self._svc_url(svc) for svc in services.items}
+
+    def targets(self) -> List[str]:
+        """Current server base URLs — from the live watch cache when the
+        watch thread is running, else one Service list call."""
+        with self._watch_lock:
+            if self._watch_cache is not None:
+                return sorted(self._watch_cache.values())
+        urls = sorted(self._list_urls().values())
         logger.debug(
             "k8s discovery (%s, %r): %d targets",
             self.namespace, self.label_selector, len(urls),
         )
         return urls
+
+    # -- watch-based discovery ----------------------------------------------
+    def start_watch(self) -> None:
+        """Start the background Service watch (idempotent).
+
+        The thread seeds the cache with a full list (resync), then applies
+        ADDED/MODIFIED/DELETED events as they stream.  Any stream error
+        drops the cache (``targets()`` falls back to listing), backs off,
+        and re-syncs — the poll path is never worse than without watching.
+        """
+        if self._watch_thread is not None:
+            return
+        self._watch_stop.clear()
+        self._watch_thread = threading.Thread(
+            target=self._watch_loop, name="gordo-kube-watch", daemon=True
+        )
+        self._watch_thread.start()
+
+    def stop_watch(self) -> None:
+        self._watch_stop.set()
+        thread, self._watch_thread = self._watch_thread, None
+        if thread is not None:
+            thread.join(timeout=5)
+        with self._watch_lock:
+            self._watch_cache = None
+
+    def _notify(self) -> None:
+        cb = self.on_change
+        if cb is not None:
+            try:
+                cb()
+            except Exception:
+                logger.exception("Discovery on_change callback failed")
+
+    def _watch_loop(self) -> None:
+        from kubernetes import watch
+
+        backoff = 1.0
+        while not self._watch_stop.is_set():
+            try:
+                seeded = self._list_urls()
+                with self._watch_lock:
+                    changed = seeded != self._watch_cache
+                    self._watch_cache = dict(seeded)
+                if changed:
+                    self._notify()
+                w = watch.Watch()
+                # bounded stream timeout: the loop re-lists (resync) after
+                # each window, so a silently-dead stream self-heals
+                for event in w.stream(
+                    self._core.list_namespaced_service,
+                    self.namespace,
+                    label_selector=self.label_selector,
+                    timeout_seconds=300,
+                ):
+                    if self._watch_stop.is_set():
+                        w.stop()
+                        break
+                    svc = event.get("object")
+                    etype = event.get("type")
+                    if svc is None or etype is None:
+                        continue
+                    name = svc.metadata.name
+                    with self._watch_lock:
+                        if self._watch_cache is None:
+                            self._watch_cache = {}
+                        if etype == "DELETED":
+                            changed = (
+                                self._watch_cache.pop(name, None) is not None
+                            )
+                        else:  # ADDED / MODIFIED
+                            url = self._svc_url(svc)
+                            changed = self._watch_cache.get(name) != url
+                            self._watch_cache[name] = url
+                    if changed:
+                        logger.info(
+                            "k8s watch: %s %s", etype, name
+                        )
+                        self._notify()
+                backoff = 1.0
+            except Exception:
+                logger.exception(
+                    "Service watch stream failed; falling back to list "
+                    "for %.0fs", backoff,
+                )
+                with self._watch_lock:
+                    self._watch_cache = None  # poll path lists directly
+                if self._watch_stop.wait(backoff):
+                    return
+                backoff = min(backoff * 2, 60.0)

@@ -69,6 +69,8 @@ class Watchman:
         self.started_at = time.time()
         self.statuses: Dict[str, EndpointStatus] = {}
         self._task: Optional[asyncio.Task] = None
+        self._loop_ref: Optional[asyncio.AbstractEventLoop] = None
+        self._wake: Optional[asyncio.Event] = None
 
     async def _current_targets(self) -> List[str]:
         targets = list(self.target_base_urls)
@@ -130,19 +132,57 @@ class Watchman:
             self.statuses[status.machine] = status
         return statuses
 
+    def notify_change(self) -> None:
+        """Thread-safe nudge: refresh on the next loop tick instead of
+        waiting out ``poll_interval`` (wired to watch-based discovery's
+        ``on_change`` so fleet membership changes propagate at event
+        latency)."""
+        loop, event = self._loop_ref, self._wake
+        if loop is not None and event is not None:
+            try:
+                loop.call_soon_threadsafe(event.set)
+            except RuntimeError:
+                pass  # loop already closed
+
     async def _loop(self) -> None:
+        self._loop_ref = asyncio.get_running_loop()
+        self._wake = asyncio.Event()
         while True:
             try:
                 await self.refresh()
             except Exception:
                 logger.exception("Watchman poll cycle failed")
-            await asyncio.sleep(self.poll_interval)
+            try:
+                await asyncio.wait_for(
+                    self._wake.wait(), timeout=self.poll_interval
+                )
+                self._wake.clear()
+            except asyncio.TimeoutError:
+                pass  # normal poll-cadence tick
 
     def start(self) -> None:
         if self._task is None:
             self._task = asyncio.get_running_loop().create_task(self._loop())
+            # watch-capable discovery: stream events and nudge the loop
+            disc = self.target_discovery
+            if disc is not None and hasattr(disc, "start_watch"):
+                disc.on_change = self.notify_change
+                try:
+                    disc.start_watch()
+                except Exception:
+                    logger.exception(
+                        "Watch-based discovery failed to start; polling only"
+                    )
 
     async def stop(self) -> None:
+        disc = self.target_discovery
+        if disc is not None and hasattr(disc, "stop_watch"):
+            try:
+                await asyncio.get_running_loop().run_in_executor(
+                    None, disc.stop_watch
+                )
+            except Exception:
+                logger.exception("Stopping watch-based discovery failed")
         if self._task is not None:
             self._task.cancel()
             try:

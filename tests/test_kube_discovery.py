@@ -9,8 +9,18 @@ from unittest import mock
 import pytest
 
 
-def _fake_kubernetes(services):
-    """Build a fake `kubernetes` package exposing the surface kube.py uses."""
+def _svc(name, port):
+    return types.SimpleNamespace(
+        metadata=types.SimpleNamespace(name=name),
+        spec=types.SimpleNamespace(
+            ports=[types.SimpleNamespace(port=port)] if port else []
+        ),
+    )
+
+
+def _fake_kubernetes(services, watch_events=None):
+    """Build a fake `kubernetes` package exposing the surface kube.py uses
+    (CoreV1Api list + watch.Watch event stream)."""
     module = types.ModuleType("kubernetes")
 
     class FakeCoreV1Api:
@@ -21,24 +31,39 @@ def _fake_kubernetes(services):
                 "namespace": namespace,
                 "label_selector": label_selector,
             }
-            items = []
-            for name, port in services:
-                svc = types.SimpleNamespace(
-                    metadata=types.SimpleNamespace(name=name),
-                    spec=types.SimpleNamespace(
-                        ports=[types.SimpleNamespace(port=port)] if port else []
-                    ),
-                )
-                items.append(svc)
-            return types.SimpleNamespace(items=items)
+            return types.SimpleNamespace(
+                items=[_svc(name, port) for name, port in services]
+            )
+
+    class FakeWatch:
+        def __init__(self):
+            self._stopped = False
+
+        def stream(self, fn, namespace, label_selector=None,
+                   timeout_seconds=None):
+            for event in (watch_events or []):
+                if self._stopped:
+                    return
+                yield event
+            # keep the stream open until stop() so the thread idles
+            # instead of hot-resyncing
+            import time as _t
+            while not self._stopped:
+                _t.sleep(0.01)
+
+        def stop(self):
+            self._stopped = True
 
     client = types.ModuleType("kubernetes.client")
     client.CoreV1Api = FakeCoreV1Api
     config = types.ModuleType("kubernetes.config")
     config.load_incluster_config = lambda: None
     config.load_kube_config = lambda: None
+    watch = types.ModuleType("kubernetes.watch")
+    watch.Watch = FakeWatch
     module.client = client
     module.config = config
+    module.watch = watch
     return module, FakeCoreV1Api
 
 
@@ -77,6 +102,97 @@ def test_import_gated_without_package():
     with mock.patch.dict(sys.modules, {"kubernetes": None}):
         with pytest.raises(ImportError, match="kubernetes"):
             KubeTargetDiscovery("ns")
+
+
+def _install(monkeypatch, module):
+    monkeypatch.setitem(sys.modules, "kubernetes", module)
+    monkeypatch.setitem(sys.modules, "kubernetes.client", module.client)
+    monkeypatch.setitem(sys.modules, "kubernetes.config", module.config)
+    monkeypatch.setitem(sys.modules, "kubernetes.watch", module.watch)
+
+
+def test_watch_stream_updates_targets_and_fires_on_change(monkeypatch):
+    """Service ADDED/DELETED events mutate the live target cache without
+    re-listing, and each change fires the on_change callback — fleet
+    membership propagates at event latency, not poll cadence."""
+    import time
+
+    events = [
+        {"type": "ADDED", "object": _svc("svc-new", 5555)},
+        {"type": "DELETED", "object": _svc("svc-old", 5555)},
+    ]
+    module, _ = _fake_kubernetes([("svc-old", 5555)], watch_events=events)
+    _install(monkeypatch, module)
+
+    from gordo_tpu.watchman.kube import KubeTargetDiscovery
+
+    disc = KubeTargetDiscovery("ns", in_cluster=False)
+    changes = []
+    disc.on_change = lambda: changes.append(disc.targets())
+    disc.start_watch()
+    try:
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if disc.targets() == ["http://svc-new.ns:5555"]:
+                break
+            time.sleep(0.01)
+        # seed list had svc-old; ADDED added svc-new; DELETED removed svc-old
+        assert disc.targets() == ["http://svc-new.ns:5555"]
+        assert len(changes) >= 2  # add + delete each notified
+    finally:
+        disc.stop_watch()
+    # after stop the cache is dropped: targets() lists again (svc-old)
+    assert disc.targets() == ["http://svc-old.ns:5555"]
+
+
+def test_watchman_start_wires_watch_and_nudges_loop(monkeypatch):
+    """Watchman.start() starts a watch-capable discovery and a change
+    notification wakes the poll loop immediately (no poll_interval wait)."""
+    import asyncio
+
+    from gordo_tpu.watchman.server import Watchman
+
+    class StubWatchDiscovery:
+        def __init__(self):
+            self.on_change = None
+            self.watching = False
+            self.stopped = False
+
+        def start_watch(self):
+            self.watching = True
+
+        def stop_watch(self):
+            self.stopped = True
+
+        def targets(self):
+            return []
+
+    disc = StubWatchDiscovery()
+    refreshes = []
+
+    async def main():
+        watchman = Watchman(
+            "p", [], [], target_discovery=disc, discover=False,
+            poll_interval=3600,  # only a nudge can trigger a 2nd refresh
+        )
+
+        async def fake_refresh():
+            refreshes.append(asyncio.get_running_loop().time())
+            return []
+
+        watchman.refresh = fake_refresh
+        watchman.start()
+        assert disc.on_change is not None
+        await asyncio.sleep(0.05)  # first cycle
+        assert disc.watching
+        n0 = len(refreshes)
+        disc.on_change()  # simulate a watch event (thread-safe path)
+        await asyncio.sleep(0.05)
+        assert len(refreshes) > n0  # woke before the 1h poll interval
+        await watchman.stop()
+        assert disc.stopped
+
+    asyncio.run(main())
 
 
 def test_watchman_merges_discovered_targets(monkeypatch):
