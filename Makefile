@@ -13,7 +13,7 @@ install:
 test:
 	$(PYTHON) -m pytest tests/ -q
 
-# marker-gated fast lane (CI's per-push gate; target < 2 min)
+# marker-gated fast lane (CI's per-push gate; measured ~3 min)
 test-fast:
 	$(PYTHON) -m pytest tests/ -q -m "not slow"
 
