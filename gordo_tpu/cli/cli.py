@@ -144,11 +144,15 @@ def build(output_dir, name, model_config, data_config, metadata,
                    "per aligned length, at the cost of fold/batch geometry "
                    "deriving from the padded length. Mutually exclusive "
                    "with --align-lengths.")
+@click.option("--machines", "machines_filter", default=None,
+              help="Comma-separated machine names: build only this subset "
+                   "of the project (partial rebuilds; the unit of work in "
+                   "the generated Argo DAG).")
 @click.option("--replace-cache", is_flag=True)
 def build_project_cmd(machine_config, project_name, output_dir,
                       model_register_dir, max_bucket_size, data_parallel,
                       data_workers, align_lengths, pad_lengths,
-                      replace_cache):
+                      machines_filter, replace_cache):
     """Build EVERY machine in the project config — homogeneous machines
     train as single mesh-sharded fleet programs (the TPU-native
     replacement for the reference's one-pod-per-machine Argo DAG)."""
@@ -159,6 +163,15 @@ def build_project_cmd(machine_config, project_name, output_dir,
     from gordo_tpu.workflow.config import NormalizedConfig, load_machine_config
 
     config = NormalizedConfig(load_machine_config(machine_config), project_name)
+    machines = config.machines
+    if machines_filter:
+        wanted = {n.strip() for n in machines_filter.split(",") if n.strip()}
+        machines = [m for m in machines if m.name in wanted]
+        missing = wanted - {m.name for m in machines}
+        if missing:
+            raise click.BadParameter(
+                f"--machines names not in the project: {sorted(missing)}"
+            )
     devices = jax.devices()
     mesh = (
         fleet_mesh(devices, data_parallel=data_parallel)
@@ -166,7 +179,7 @@ def build_project_cmd(machine_config, project_name, output_dir,
         else None
     )
     result = build_project(
-        config.machines,
+        machines,
         output_dir,
         model_register_dir=model_register_dir,
         mesh=mesh,
@@ -387,9 +400,15 @@ def workflow_group():
               help="Extra 'gordo run-server' flag for the ml-server "
                    "Deployment; repeatable (e.g. --server-arg=--coalesce-ms "
                    "--server-arg=2 --server-arg=--model-parallel).")
+@click.option("--format", "fmt", type=click.Choice(["k8s", "argo"]),
+              default="k8s", show_default=True,
+              help="k8s: builder Job + server/watchman Deployments. argo: "
+                   "an argoproj Workflow DAG (one task per fleet chunk) "
+                   "plus the serving manifests — for clusters whose "
+                   "tooling consumes Argo documents.")
 @click.option("--output-file", type=click.File("w"), default="-")
 def workflow_generate(machine_config, project_name, image, server_replicas,
-                      server_args, output_file):
+                      server_args, fmt, output_file):
     """Render the kubernetes manifests + fleet build plan (reference:
     the Argo workflow template render)."""
     from gordo_tpu.workflow import (
@@ -404,6 +423,14 @@ def workflow_generate(machine_config, project_name, image, server_replicas,
         config, image=image, server_replicas=server_replicas,
         server_args=list(server_args),
     )
+    if fmt == "argo":
+        from gordo_tpu.workflow.generator import generate_argo_workflow
+
+        # the Argo Workflow replaces the builder Job; serving manifests
+        # (Deployments/Services/Mappings/plan ConfigMap) stay as-is
+        docs = [generate_argo_workflow(config, image=image)] + [
+            d for d in docs if d.get("kind") != "Job"
+        ]
     output_file.write(workflow_to_yaml(docs))
 
 

@@ -357,3 +357,103 @@ def generate_workflow(
 
 def workflow_to_yaml(docs: List[Dict[str, Any]]) -> str:
     return yaml.safe_dump_all(docs, sort_keys=False)
+
+
+# ---------------------------------------------------------------------------
+# Argo shim
+# ---------------------------------------------------------------------------
+
+def generate_argo_workflow(
+    config: NormalizedConfig,
+    image: str = DEFAULT_IMAGE,
+    max_bucket_size: int = 512,
+    tpu_resources: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Project config → one ``argoproj.io/v1alpha1 Workflow`` document.
+
+    Reference equivalent: ``gordo_components/workflow`` rendered an Argo
+    Workflow with one pod per machine.  The TPU-native build is the
+    bucketed fleet program (one Job), so this shim exists for clusters
+    whose tooling consumes Argo documents: a DAG with ONE task per fleet
+    chunk (not per machine — a chunk is the unit that shares a stacked
+    XLA program), each running ``gordo build-project --machines <chunk>``
+    against the shared project ConfigMap and models PVC.  Chunk tasks are
+    independent (no DAG edges): Argo schedules them with whatever
+    parallelism the cluster allows, and the config-hash registry makes
+    retries idempotent.
+    """
+    project = config.project_name
+    plan = build_plan(config, max_bucket_size=max_bucket_size)
+    tpu_resources = tpu_resources or {
+        "limits": {"google.com/tpu": 8},
+        "requests": {"google.com/tpu": 8},
+    }
+    tasks = [
+        {
+            "name": bucket["bucket"],
+            "template": "build-chunk",
+            "arguments": {
+                "parameters": [
+                    {
+                        "name": "machines",
+                        "value": ",".join(bucket["machines"]),
+                    }
+                ]
+            },
+        }
+        for bucket in plan["buckets"]
+    ]
+    return {
+        "apiVersion": "argoproj.io/v1alpha1",
+        "kind": "Workflow",
+        "metadata": {
+            "generateName": f"gordo-build-{project}-",
+            "labels": _labels(project, "model-builder"),
+        },
+        "spec": {
+            "entrypoint": "build",
+            "templates": [
+                {"name": "build", "dag": {"tasks": tasks}},
+                {
+                    "name": "build-chunk",
+                    "inputs": {"parameters": [{"name": "machines"}]},
+                    "container": {
+                        "name": "model-builder",
+                        "image": image,
+                        "command": ["gordo", "build-project"],
+                        "args": [
+                            "--machine-config", "/config/project.yaml",
+                            "--output-dir", "/models",
+                            "--model-register-dir", "/models/.register",
+                            "--max-bucket-size", str(max_bucket_size),
+                            "--machines",
+                            "{{inputs.parameters.machines}}",
+                        ],
+                        "env": [
+                            {"name": "PROJECT_NAME", "value": project},
+                        ],
+                        "resources": tpu_resources,
+                        "volumeMounts": [
+                            {"name": "models", "mountPath": "/models"},
+                            {
+                                "name": "project-config",
+                                "mountPath": "/config",
+                            },
+                        ],
+                    },
+                },
+            ],
+            "volumes": [
+                {
+                    "name": "models",
+                    "persistentVolumeClaim": {
+                        "claimName": f"gordo-models-{project}"
+                    },
+                },
+                {
+                    "name": "project-config",
+                    "configMap": {"name": f"gordo-config-{project}"},
+                },
+            ],
+        },
+    }

@@ -127,6 +127,51 @@ def test_workflow_generate_and_unique_tags(tmp_path):
     assert plan.exit_code == 0
     assert yaml.safe_load(plan.output)["n_buckets"] == 1
 
+    argo = runner.invoke(
+        gordo,
+        ["workflow", "generate", "--machine-config", str(cfg),
+         "--project-name", "wfproj", "--format", "argo"],
+    )
+    assert argo.exit_code == 0, argo.output
+    argo_docs = list(yaml.safe_load_all(argo.output))
+    kinds = [d["kind"] for d in argo_docs]
+    assert "Workflow" in kinds and "Job" not in kinds
+    assert "Deployment" in kinds  # serving manifests still emitted
+
+
+def test_build_project_machines_filter(tmp_path):
+    """--machines restricts the build to the named subset; unknown names
+    error loudly instead of silently building nothing."""
+    project = {
+        "machines": [
+            dict(PROJECT_YAML["machines"][0], name=f"flt-{i}")
+            for i in range(3)
+        ],
+        "globals": PROJECT_YAML.get("globals", {}),
+    }
+    cfg = tmp_path / "project.yaml"
+    cfg.write_text(yaml.safe_dump(project))
+    out = tmp_path / "models"
+    runner = CliRunner()
+    result = runner.invoke(
+        gordo,
+        ["build-project", "--machine-config", str(cfg),
+         "--output-dir", str(out), "--machines", "flt-0,flt-2"],
+    )
+    assert result.exit_code == 0, result.output
+    summary = json.loads(result.output.strip().splitlines()[-1])
+    assert summary["n_machines"] == 2
+    assert os.path.isdir(out / "flt-0") and os.path.isdir(out / "flt-2")
+    assert not os.path.isdir(out / "flt-1")
+
+    bad = runner.invoke(
+        gordo,
+        ["build-project", "--machine-config", str(cfg),
+         "--output-dir", str(out), "--machines", "flt-0,nope"],
+    )
+    assert bad.exit_code != 0
+    assert "nope" in bad.output
+
 
 def test_help_lists_all_verbs():
     runner = CliRunner()

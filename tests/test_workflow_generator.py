@@ -79,6 +79,38 @@ def test_generate_workflow_documents():
     assert embedded["n_machines"] == 3
 
 
+def test_generate_argo_workflow_dag_per_chunk():
+    """The Argo shim: one Workflow doc, a DAG task per fleet chunk, each
+    parameterized with its chunk's machine list and running the
+    --machines-filtered build-project."""
+    from gordo_tpu.workflow.generator import generate_argo_workflow
+
+    wf = generate_argo_workflow(_config(), image="img:1", max_bucket_size=1)
+    assert wf["apiVersion"] == "argoproj.io/v1alpha1"
+    assert wf["kind"] == "Workflow"
+    templates = {t["name"]: t for t in wf["spec"]["templates"]}
+    tasks = templates["build"]["dag"]["tasks"]
+    assert len(tasks) == 3  # max_bucket_size=1 -> one chunk per machine
+    machine_params = sorted(
+        t["arguments"]["parameters"][0]["value"] for t in tasks
+    )
+    assert machine_params == ["gen-a", "gen-b", "gen-c"]
+    container = templates["build-chunk"]["container"]
+    assert container["image"] == "img:1"
+    assert container["command"] == ["gordo", "build-project"]
+    assert "--machines" in container["args"]
+    # chunk tasks are independent — Argo parallelizes them
+    assert all("dependencies" not in t for t in tasks)
+
+    # multi-machine chunks carry comma-joined names
+    wf2 = generate_argo_workflow(_config(), max_bucket_size=512)
+    tasks2 = {
+        t["arguments"]["parameters"][0]["value"]
+        for t in wf2["spec"]["templates"][0]["dag"]["tasks"]
+    }
+    assert "gen-a,gen-b" in tasks2
+
+
 def test_workflow_yaml_roundtrip():
     docs = generate_workflow(_config())
     parsed = list(yaml.safe_load_all(workflow_to_yaml(docs)))
