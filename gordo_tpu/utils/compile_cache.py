@@ -9,9 +9,18 @@ persistent compilation cache writes executables to disk keyed by program
 fingerprint, so a process-cold build of an already-seen program shape
 loads in milliseconds instead.
 
-Enabled by default at the CLI/builder/server entry points; opt out with
-``GORDO_COMPILE_CACHE=0`` or point the location via
-``GORDO_COMPILE_CACHE_DIR`` (default ``~/.cache/gordo_tpu/xla``).
+Enabled by default at the CLI/builder/server entry points — on TPU (and
+GPU) backends only.  **XLA:CPU is excluded**: its cached AOT executables
+embed the compiling process's detected machine features, and loading an
+entry whose feature set disagrees with the current detection crashed the
+process in this container (SIGILL-class segfault inside
+``compilation_cache.get_executable_and_time`` — the loader itself warns
+"could lead to execution errors such as SIGILL").  On CPU the cold
+compiles are also far cheaper, so the trade is not worth the risk;
+``GORDO_COMPILE_CACHE=force`` overrides for a trusted single-machine
+setup.  Opt out entirely with ``GORDO_COMPILE_CACHE=0`` or point the
+location via ``GORDO_COMPILE_CACHE_DIR`` (default
+``~/.cache/gordo_tpu/xla``).
 """
 
 from __future__ import annotations
@@ -25,7 +34,8 @@ _ENABLED = False
 
 
 def enable_persistent_compile_cache(cache_dir: str | None = None) -> bool:
-    """Turn on jax's on-disk compilation cache (idempotent).
+    """Turn on jax's on-disk compilation cache (idempotent; TPU/GPU only
+    unless forced — see module docstring for the XLA:CPU hazard).
 
     Returns True when the cache is active.  Never raises: a read-only
     filesystem or an old jax falls back to in-memory-only compiles.
@@ -33,19 +43,27 @@ def enable_persistent_compile_cache(cache_dir: str | None = None) -> bool:
     global _ENABLED
     if _ENABLED:
         return True
-    if os.environ.get("GORDO_COMPILE_CACHE", "1") in ("0", "false", "no"):
+    flag = os.environ.get("GORDO_COMPILE_CACHE", "1")
+    if flag in ("0", "false", "no"):
         return False
-    cache_dir = (
-        cache_dir
-        or os.environ.get("GORDO_COMPILE_CACHE_DIR")
-        or os.path.join(
-            os.path.expanduser("~"), ".cache", "gordo_tpu", "xla"
-        )
-    )
     try:
-        os.makedirs(cache_dir, exist_ok=True)
         import jax
 
+        if flag != "force" and jax.default_backend() == "cpu":
+            logger.debug(
+                "Persistent compile cache skipped on CPU backend "
+                "(AOT feature-mismatch hazard; GORDO_COMPILE_CACHE=force "
+                "overrides)"
+            )
+            return False
+        cache_dir = (
+            cache_dir
+            or os.environ.get("GORDO_COMPILE_CACHE_DIR")
+            or os.path.join(
+                os.path.expanduser("~"), ".cache", "gordo_tpu", "xla"
+            )
+        )
+        os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         # default min-compile-time (1s) keeps tiny programs out of the
         # cache; the fleet fit/CV programs are seconds-to-minutes

@@ -1,0 +1,43 @@
+"""Persistent-compile-cache gating: TPU/GPU-only by default.
+
+XLA:CPU cached AOT executables embed the compiling process's detected
+machine features; loading a mismatched entry segfaulted this container
+(see utils/compile_cache.py module docstring).  These tests pin the gate:
+no disk cache on the CPU backend unless forced.
+"""
+
+import pytest
+
+from gordo_tpu.utils import compile_cache
+
+
+@pytest.fixture(autouse=True)
+def _reset_enabled(monkeypatch):
+    monkeypatch.setattr(compile_cache, "_ENABLED", False)
+
+
+def test_cpu_backend_skips_cache(monkeypatch, tmp_path):
+    monkeypatch.delenv("GORDO_COMPILE_CACHE", raising=False)
+    monkeypatch.setenv("GORDO_COMPILE_CACHE_DIR", str(tmp_path / "x"))
+    # conftest pins the cpu backend for the whole suite
+    assert compile_cache.enable_persistent_compile_cache() is False
+    assert not (tmp_path / "x").exists()
+
+
+def test_force_enables_on_cpu(monkeypatch, tmp_path):
+    import jax
+
+    monkeypatch.setenv("GORDO_COMPILE_CACHE", "force")
+    monkeypatch.setenv("GORDO_COMPILE_CACHE_DIR", str(tmp_path / "y"))
+    try:
+        assert compile_cache.enable_persistent_compile_cache() is True
+        assert (tmp_path / "y").exists()
+    finally:
+        # never leave a disk cache pointed at a tmp dir for later tests
+        jax.config.update("jax_compilation_cache_dir", None)
+        monkeypatch.setattr(compile_cache, "_ENABLED", False)
+
+
+def test_opt_out(monkeypatch):
+    monkeypatch.setenv("GORDO_COMPILE_CACHE", "0")
+    assert compile_cache.enable_persistent_compile_cache() is False
