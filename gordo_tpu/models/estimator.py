@@ -16,6 +16,7 @@ batch many estimators into single device programs.
 
 from __future__ import annotations
 
+import functools
 import time
 from typing import Any, Dict, Optional
 
@@ -31,6 +32,13 @@ from gordo_tpu.registry import lookup_factory
 from gordo_tpu.train.fit import TrainConfig, fit as fit_model
 from gordo_tpu.utils.args import ParamsMixin, capture_args
 from gordo_tpu.utils.trees import param_count, to_host
+
+
+@functools.lru_cache(maxsize=256)
+def _predict_jit_for(module):
+    """One jitted apply per structurally-distinct module (flax modules are
+    frozen dataclasses: equal factory output hashes equal)."""
+    return jax.jit(module.apply)
 
 
 class BaseJaxEstimator(ParamsMixin, GordoBase):
@@ -114,7 +122,13 @@ class BaseJaxEstimator(ParamsMixin, GordoBase):
             self._rebuild_module()
         inputs = self._make_inputs(as_float2d(X))
         if self._predict_jit is None:
-            self._predict_jit = jax.jit(self.module_.apply)
+            # shared across instances, keyed on the (hashable, structurally
+            # equal) flax module — same reasoning as _fit_jit: a fleet of
+            # same-architecture estimators must hit ONE traced program, not
+            # re-trace and re-compile per instance (the Nth identical
+            # XLA:CPU recompile also segfaulted jax 0.9 under accumulated
+            # compile state)
+            self._predict_jit = _predict_jit_for(self.module_)
         return np.asarray(self._predict_jit({"params": self.params_}, inputs))
 
     def score(self, X, y=None, sample_weight=None) -> float:
