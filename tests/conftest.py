@@ -32,6 +32,23 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _bound_xla_compile_state():
+    """Clear jax's compiled-program caches after every test module.
+
+    jax 0.9.0's XLA:CPU backend segfaults inside ``backend_compile_and_
+    load`` when a fresh program compiles late in a long single-process
+    run (~150+ tests of accumulated compile state; the same compile
+    passes in isolation — reproduced repeatedly in this container, crash
+    point moving with the suite's total compile pressure).  Dropping the
+    caches per module bounds that state; modules that share program
+    shapes pay one extra compile each, which is noise next to a crashed
+    suite.  TPU is unaffected — this is purely a test-harness guard.
+    """
+    yield
+    jax.clear_caches()
+
+
 @pytest.fixture(scope="session")
 def sine_tags():
     """Synthetic multi-tag sine matrix (the RandomDataProvider-style backbone
