@@ -473,9 +473,11 @@ def _dump_machine(
         # one: record the alignment and the row count actually trained on
         metadata["model"]["align_lengths"] = int(align_lengths)
         metadata["model"]["rows_trained"] = int(X.shape[0])
-    if pad_lengths:
+    if pad_lengths and getattr(detector, "pad_built_", False):
         # padded-mode artifact: every real row trained, but fold/batch
-        # geometry came from the padded group length
+        # geometry came from the padded group length.  Machines the
+        # builder demoted to the exact path (too short / exotic splitter)
+        # do NOT get the stamp — their artifacts are full-parity builds.
         metadata["model"]["pad_lengths"] = int(pad_lengths)
         metadata["model"]["rows_trained"] = int(X.shape[0])
     # the artifact stamps its own cache identity so a later lookup can

@@ -310,10 +310,8 @@ class FleetDiffBuilder:
         if self.pad_lengths:
             return self._build_padded(Xs, ys)
 
-        groups: Dict[int, List[int]] = {}
-        for i, x in enumerate(Xs):
-            groups.setdefault(int(x.shape[0]), []).append(i)
-        if len(groups) > 1 and len(groups) > len(Xs) // 2:
+        n_lengths = len({int(x.shape[0]) for x in Xs})
+        if n_lengths > 1 and n_lengths > len(Xs) // 2:
             # Exact parity requires one program per distinct row count; a
             # bucket where most machines differ in length loses the fleet
             # vmap win and pays one XLA compile per length (still no worse
@@ -322,22 +320,32 @@ class FleetDiffBuilder:
                 "Fleet bucket of %d machines has %d distinct row counts; "
                 "each length compiles its own program — consider aligning "
                 "train windows for fleet efficiency",
-                len(Xs), len(groups),
+                len(Xs), n_lengths,
             )
 
         detectors: List[Optional[DiffBasedAnomalyDetector]] = [None] * len(Xs)
-        for idxs in groups.values():
-            X_g = np.stack([Xs[i] for i in idxs])
+        self._build_exact_length_groups(Xs, ys, range(len(Xs)), detectors)
+        return detectors  # type: ignore[return-value]
+
+    def _build_exact_length_groups(
+        self, Xs, ys, idxs, detectors: List
+    ) -> None:
+        """Group ``idxs`` by row count and run the exact program per
+        length-group, scattering results into ``detectors``."""
+        by_len: Dict[int, List[int]] = {}
+        for i in idxs:
+            by_len.setdefault(int(Xs[i].shape[0]), []).append(i)
+        for group in by_len.values():
+            X_g = np.stack([Xs[i] for i in group])
             y_g = (
                 X_g
                 if ys is None
                 else np.stack(
-                    [np.asarray(ys[i], np.float32) for i in idxs]
+                    [np.asarray(ys[i], np.float32) for i in group]
                 )
             )
-            for i, det in zip(idxs, self._build_group(X_g, y_g)):
+            for i, det in zip(group, self._build_group(X_g, y_g)):
                 detectors[i] = det
-        return detectors  # type: ignore[return-value]
 
     def _build_padded(
         self,
@@ -359,19 +367,35 @@ class FleetDiffBuilder:
 
         detectors: List[Optional[DiffBasedAnomalyDetector]] = [None] * len(Xs)
         for n_pad, idxs in list(groups.items()):
+            folds = list(self.splitter.split(np.empty((n_pad, 1))))
+            # The masked program's exactness rests on padding being a
+            # SUFFIX after every fold gather — i.e. fold indices must be
+            # sorted contiguous blocks (true for TimeSeriesSplit and
+            # unshuffled KFold).  A shuffled/exotic splitter would
+            # silently interleave pad rows into training windows, so the
+            # whole group demotes to the exact path instead.
+            contiguous = all(
+                np.array_equal(idx, np.arange(idx[0], idx[-1] + 1))
+                for tr, te in folds
+                for idx in (np.asarray(tr), np.asarray(te))
+            )
+            if not contiguous:
+                logger.warning(
+                    "pad_lengths=%d: CV splitter %s yields non-contiguous "
+                    "fold indices — pad-up mode requires contiguous blocks; "
+                    "building the %d machine(s) at padded length %d through "
+                    "the exact per-length path",
+                    pad, type(self.splitter).__name__, len(idxs), n_pad,
+                )
+                exact_fallback.extend(idxs)
+                del groups[n_pad]
+                continue
             # Every fold's test block must contain real target rows for
             # every machine, or its thresholds/metrics would be computed on
             # nothing (0/0-guarded into silently-wrong zeros).  A machine
             # shorter than the last fold's start (plus window context) can't
             # satisfy that at this padded length — build it exactly instead.
-            min_len = (
-                max(
-                    int(te[0])
-                    for _, te in self.splitter.split(np.empty((n_pad, 1)))
-                )
-                + offset
-                + 1
-            )
+            min_len = max(int(te[0]) for _, te in folds) + offset + 1
             short = [i for i in idxs if Xs[i].shape[0] < min_len]
             if short:
                 logger.warning(
@@ -388,18 +412,7 @@ class FleetDiffBuilder:
                     continue
                 groups[n_pad] = idxs
 
-        by_len: Dict[int, List[int]] = {}
-        for i in exact_fallback:
-            by_len.setdefault(Xs[i].shape[0], []).append(i)
-        for idxs_ex in by_len.values():
-            X_g = np.stack([Xs[i] for i in idxs_ex])
-            y_g = (
-                X_g
-                if ys is None
-                else np.stack([np.asarray(ys[i], np.float32) for i in idxs_ex])
-            )
-            for i, det in zip(idxs_ex, self._build_group(X_g, y_g)):
-                detectors[i] = det
+        self._build_exact_length_groups(Xs, ys, exact_fallback, detectors)
 
         for n_pad, idxs in groups.items():
             m = len(idxs)
@@ -418,6 +431,10 @@ class FleetDiffBuilder:
                     ys[i], np.float32
                 )
             for i, det in zip(idxs, self._build_group(X, y, lens=lens)):
+                # distinguishes genuinely pad-built artifacts from the
+                # exact-fallback ones above (fleet_build stamps metadata
+                # from this marker, not from the request flag)
+                det.pad_built_ = True
                 detectors[i] = det
         return detectors  # type: ignore[return-value]
 

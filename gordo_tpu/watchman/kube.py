@@ -71,6 +71,10 @@ class KubeTargetDiscovery:
         #: None means "not watching" and targets() falls back to listing
         self._watch_cache: Optional[Dict[str, str]] = None
         self._watch_lock = threading.Lock()
+        #: per-GENERATION stop event: each start_watch() gets a fresh one,
+        #: so an abandoned thread (join timed out while it idled inside a
+        #: long watch stream) stays permanently stopped instead of being
+        #: resurrected by the next start clearing a shared flag
         self._watch_stop = threading.Event()
         self._watch_thread: Optional[threading.Thread] = None
         #: thread-context callback fired when the watched target set
@@ -113,9 +117,12 @@ class KubeTargetDiscovery:
         """
         if self._watch_thread is not None:
             return
-        self._watch_stop.clear()
+        self._watch_stop = threading.Event()  # new generation, see __init__
         self._watch_thread = threading.Thread(
-            target=self._watch_loop, name="gordo-kube-watch", daemon=True
+            target=self._watch_loop,
+            args=(self._watch_stop,),
+            name="gordo-kube-watch",
+            daemon=True,
         )
         self._watch_thread.start()
 
@@ -135,11 +142,11 @@ class KubeTargetDiscovery:
             except Exception:
                 logger.exception("Discovery on_change callback failed")
 
-    def _watch_loop(self) -> None:
+    def _watch_loop(self, stop: threading.Event) -> None:
         from kubernetes import watch
 
         backoff = 1.0
-        while not self._watch_stop.is_set():
+        while not stop.is_set():
             try:
                 seeded = self._list_urls()
                 with self._watch_lock:
@@ -156,7 +163,7 @@ class KubeTargetDiscovery:
                     label_selector=self.label_selector,
                     timeout_seconds=300,
                 ):
-                    if self._watch_stop.is_set():
+                    if stop.is_set():
                         w.stop()
                         break
                     svc = event.get("object")
@@ -188,6 +195,6 @@ class KubeTargetDiscovery:
                 )
                 with self._watch_lock:
                     self._watch_cache = None  # poll path lists directly
-                if self._watch_stop.wait(backoff):
+                if stop.wait(backoff):
                     return
                 backoff = min(backoff * 2, 60.0)

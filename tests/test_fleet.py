@@ -344,6 +344,28 @@ def test_pad_lengths_too_short_machine_demotes_to_exact(sine_tags, caplog):
     assert detectors[0].feature_thresholds_.min() > 0
 
 
+def test_pad_lengths_shuffled_splitter_demotes_to_exact(sine_tags, caplog):
+    """Pad-up exactness requires contiguous fold blocks; a shuffled
+    splitter must demote the group to the exact path, not silently train
+    on windows interleaved with padding."""
+    import logging
+
+    from sklearn.model_selection import KFold
+
+    Xs = [sine_tags[:350], sine_tags[:400]]
+    spec = analyze_definition(from_definition(DETECTOR_DEF))
+    builder = FleetDiffBuilder(
+        spec, cv=KFold(n_splits=3, shuffle=True, random_state=0),
+        pad_lengths=100,
+    )
+    with caplog.at_level(logging.WARNING, logger="gordo_tpu.parallel.anomaly"):
+        detectors = builder.build(Xs)
+    assert any("non-contiguous" in r.message for r in caplog.records)
+    for det in detectors:
+        assert np.all(np.isfinite(det.feature_thresholds_))
+        assert not getattr(det, "pad_built_", False)  # exact-path builds
+
+
 def test_fleet_build_ragged_lengths_exact(sine_tags):
     """Machines of DIFFERENT lengths in one bucket: each length-group runs
     its own exact program, so every machine (not just the longest) matches
