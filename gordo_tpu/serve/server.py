@@ -19,6 +19,7 @@ Differences by design:
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 import os
 import threading
@@ -225,16 +226,62 @@ def parse_X(payload: Any, tags: List[str]) -> np.ndarray:
     return arr
 
 
+#: bodies above this decode+parse in the executor: a 3 MB JSON request
+#: costs ~20-30ms of json.loads + np.asarray — enough that at 64-way
+#: concurrency the event loop itself was the serving bottleneck
+_OFFLOAD_BYTES = 64 * 1024
+
+
+def _decode_payload(raw: bytes, is_msgpack: bool) -> Any:
+    """Bytes → payload dict; ValueError on malformed input (→ 400).
+    Pure function so handlers can run it on or off the event loop."""
+    if is_msgpack:
+        try:
+            return codec.unpackb(raw)
+        except Exception as exc:
+            raise ValueError(f"Invalid msgpack body: {exc}")
+    # json.JSONDecodeError is a ValueError — same 400 surface as before
+    return json.loads(raw)
+
+
 async def _read_payload(request: web.Request) -> Any:
     """Request body → payload dict; msgpack bodies (the bundled client's
     bulk fast path) decode through the binary codec, anything else parses
-    as JSON.  Decode errors surface as ValueError → 400."""
-    if request.content_type == codec.MSGPACK_CONTENT_TYPE:
-        try:
-            return codec.unpackb(await request.read())
-        except Exception as exc:
-            raise ValueError(f"Invalid msgpack body: {exc}")
-    return await request.json()
+    as JSON.  Large bodies decode in the executor so the accept loop
+    stays responsive under concurrent load."""
+    raw = await request.read()
+    is_msgpack = request.content_type == codec.MSGPACK_CONTENT_TYPE
+    if len(raw) > _OFFLOAD_BYTES:
+        return await asyncio.get_running_loop().run_in_executor(
+            None, _decode_payload, raw, is_msgpack
+        )
+    return _decode_payload(raw, is_msgpack)
+
+
+async def _read_and_parse_single(request: web.Request, entry: "ModelEntry"):
+    """Read → decode → parse for the single-machine routes, off-loop for
+    large bodies (one executor hop covers decode AND the list→ndarray
+    conversion, both loop-hostile at 2048-row request sizes).
+
+    Returns ``(X, index, y)``; raises ValueError for client errors."""
+    raw = await request.read()
+    is_msgpack = request.content_type == codec.MSGPACK_CONTENT_TYPE
+
+    def work():
+        payload = _decode_payload(raw, is_msgpack)
+        X = parse_X(payload, entry.tags)
+        _validate_width(X, entry)
+        index = parse_index(payload, X.shape[0])
+        y = (
+            parse_X({"X": payload["y"]}, entry.tags)
+            if isinstance(payload, dict) and payload.get("y") is not None
+            else None
+        )
+        return X, index, y
+
+    if len(raw) > _OFFLOAD_BYTES:
+        return await asyncio.get_running_loop().run_in_executor(None, work)
+    return work()
 
 
 async def _respond(
@@ -341,10 +388,7 @@ async def prediction(request: web.Request) -> web.Response:
     entry = _entry_or_404(request)
     t0 = time.perf_counter()
     try:
-        payload = await _read_payload(request)
-        X = parse_X(payload, entry.tags)
-        _validate_width(X, entry)
-        index = parse_index(payload, X.shape[0])
+        X, index, _ = await _read_and_parse_single(request, entry)
     except ValueError as exc:
         return web.json_response({"error": str(exc)}, status=400)
     loop = asyncio.get_running_loop()
@@ -378,15 +422,7 @@ async def anomaly_prediction(request: web.Request) -> web.Response:
         )
     t0 = time.perf_counter()
     try:
-        payload = await _read_payload(request)
-        X = parse_X(payload, entry.tags)
-        _validate_width(X, entry)
-        index = parse_index(payload, X.shape[0])
-        y = (
-            parse_X({"X": payload["y"]}, entry.tags)
-            if isinstance(payload, dict) and payload.get("y") is not None
-            else None
-        )
+        X, index, y = await _read_and_parse_single(request, entry)
     except ValueError as exc:
         return web.json_response({"error": str(exc)}, status=400)
     loop = asyncio.get_running_loop()
@@ -449,32 +485,43 @@ async def bulk_anomaly_prediction(request: web.Request) -> web.Response:
     except ValueError as exc:
         return web.json_response({"error": str(exc)}, status=400)
     # per-machine validation: one bad machine reports in ITS result slot and
-    # must not 400 the rest of the fleet
+    # must not 400 the rest of the fleet.  The whole parse loop (dozens of
+    # list->ndarray conversions) runs in the executor — at fleet request
+    # sizes it is far too much work for the event loop.
     indices = payload.get("index") or {}
-    X_by_name: Dict[str, np.ndarray] = {}
-    index_by_name: Dict[str, pd.DatetimeIndex] = {}
-    machine_errors: Dict[str, Dict[str, str]] = {}
-    for name, rows in payload["X"].items():
-        entry = collection.get(name)
-        try:
-            if entry is None:
-                raise ValueError(f"Unknown machine {name!r}")
-            X = parse_X({"X": rows}, entry.tags)
-            _validate_width(X, entry)
-            if isinstance(indices, dict) and name in indices:
-                index = parse_index({"index": indices[name]}, X.shape[0])
-                if index is not None:
-                    index_by_name[name] = index
-            X_by_name[name] = X
-        except ValueError as exc:
-            machine_errors[name] = {"error": str(exc)}
+
+    def _parse_machines():
+        X_by: Dict[str, np.ndarray] = {}
+        idx_by: Dict[str, pd.DatetimeIndex] = {}
+        errors: Dict[str, Dict[str, str]] = {}
+        for name, rows in payload["X"].items():
+            entry = collection.get(name)
+            try:
+                if entry is None:
+                    raise ValueError(f"Unknown machine {name!r}")
+                X = parse_X({"X": rows}, entry.tags)
+                _validate_width(X, entry)
+                if isinstance(indices, dict) and name in indices:
+                    index = parse_index(
+                        {"index": indices[name]}, X.shape[0]
+                    )
+                    if index is not None:
+                        idx_by[name] = index
+                X_by[name] = X
+            except ValueError as exc:
+                errors[name] = {"error": str(exc)}
+        return X_by, idx_by, errors
+
+    loop = asyncio.get_running_loop()
+    X_by_name, index_by_name, machine_errors = await loop.run_in_executor(
+        None, _parse_machines
+    )
     if not X_by_name and machine_errors:
         return web.json_response(
             {"error": "No valid machines in payload",
              "data": machine_errors},
             status=400,
         )
-    loop = asyncio.get_running_loop()
     try:
         # resolve the lazy scorer inside the executor too: first-call param
         # stacking for a large project must not stall the accept loop
