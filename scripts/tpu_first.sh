@@ -1,0 +1,26 @@
+#!/bin/bash
+# Run the pending TPU measurements, FIRST THING on a healthy tunnel.
+# (docs/perf.md "Pending TPU re-measurements" — the r4 wedge queue.)
+#
+# Discipline (see .claude/skills/verify/SKILL.md): one TPU process at a
+# time, never timeout-kill a TPU client, keep the machine idle while a
+# bench runs, each step sized well under 10 minutes.
+set -u
+cd "$(dirname "$0")/.."
+
+echo "== probe =="
+timeout 75 python -c "import jax; print(jax.devices())" || {
+  echo "tunnel not healthy (rc=$?) — aborting before anything can wedge"
+  exit 1
+}
+
+echo "== 1/3 full bench (persists per-stage to BENCH_partial_tpu.json) =="
+python bench.py | tee /tmp/bench_tpu.json
+
+echo "== 2/3 bf16-vs-fp32 LSTM sweep =="
+python scripts/sweep_constants.py lstmdtype 32
+
+echo "== 3/3 record =="
+git add BENCH_partial_tpu.json 2>/dev/null
+echo "Done. Update docs/perf.md headline tables from the output above,"
+echo "then commit (git add BENCH_partial_tpu.json docs/perf.md)."
