@@ -254,6 +254,19 @@ class TestFleetDiffBuilder:
         )
 
 
+def test_model_axis_pad_targets():
+    """Machine-axis padding collapses counts onto log-many compiled
+    shapes (pow2, then the mesh 'models'-axis multiple)."""
+    from gordo_tpu.parallel.anomaly import _model_axis_pad
+
+    assert [_model_axis_pad(m, None) for m in (1, 2, 3, 5, 272, 512)] == [
+        1, 2, 4, 8, 512, 512,
+    ]
+    mesh = fleet_mesh()  # 8 virtual devices
+    assert _model_axis_pad(3, mesh) == 8   # pow2 4, then mesh multiple 8
+    assert _model_axis_pad(12, mesh) == 16
+
+
 def test_pad_lengths_parity_on_already_aligned_data(sine_tags):
     """pad-up mode with machines ALREADY at the aligned length runs with
     all-ones masks — results must match the exact per-length program
