@@ -57,6 +57,29 @@ logger = logging.getLogger(__name__)
 #: stands (fewer chunks per big project at the same rate).
 DEFAULT_MAX_BUCKET = 512
 
+#: recurrent (lookback-windowed) signatures chunk smaller: the r6
+#: machines-per-bucket sweep (`scripts/sweep_constants.py lstmbucket`,
+#: CPU jax, docs/perf.md) measured the warm CV+fit rate DECLINING with
+#: bucket size (5,019 models/h at 64 → 3,895 at 512 — wider vmap, more
+#: cache pressure) while the cold rate peaks mid-table (compile
+#: amortization).  128 sits within 10% of the best warm rate, builds
+#: cold 18% faster than 64, and keeps 4x headroom vs 512 on the windows
+#: tensors (∝ machines × rows × lookback × tags) that bound LSTM
+#: dispatches.  Re-sweep on TPU when the tunnel allows: tunnel dispatch
+#: overhead (~230ms/chunk) favors bigger buckets than CPU does.
+DEFAULT_MAX_BUCKET_LSTM = 128
+
+
+def default_bucket_size(spec) -> int:
+    """Per-signature ``max_bucket_size`` default: recurrent estimators
+    (``lookback_window > 1`` — LSTM family) chunk at
+    ``DEFAULT_MAX_BUCKET_LSTM``, everything else at
+    ``DEFAULT_MAX_BUCKET``."""
+    est = getattr(spec, "estimator_proto", None)
+    if getattr(est, "lookback_window", 1) > 1:
+        return DEFAULT_MAX_BUCKET_LSTM
+    return DEFAULT_MAX_BUCKET
+
 
 class ProjectBuildResult:
     """Per-machine artifact dirs + build accounting for one project build."""
@@ -146,7 +169,7 @@ def build_project(
     model_register_dir: Optional[str] = None,
     mesh: Optional[Mesh] = None,
     replace_cache: bool = False,
-    max_bucket_size: int = DEFAULT_MAX_BUCKET,
+    max_bucket_size: Optional[int] = None,
     data_workers: int = 8,
     align_lengths: Optional[int] = None,
     pad_lengths: Optional[int] = None,
@@ -154,8 +177,15 @@ def build_project(
     """Build every machine; fleet-bucket the homogeneous ones.
 
     Streaming and memory-bounded: at most TWO chunks of machines
-    (2 x ``max_bucket_size``) have arrays resident — the one training on
-    device and the one the loader pool is prefetching behind it.
+    (2 x the effective bucket size) have arrays resident — the one
+    training on device and the one the loader pool is prefetching behind
+    it.
+
+    ``max_bucket_size=None`` (the default) picks a per-signature chunk
+    size: ``DEFAULT_MAX_BUCKET`` (512) for dense signatures,
+    ``DEFAULT_MAX_BUCKET_LSTM`` for recurrent ones (see
+    :func:`default_bucket_size`); an explicit value applies to every
+    bucket.
 
     ``align_lengths``: truncate each fleet-bucketed machine's train rows
     DOWN to a multiple of this (dropping the oldest rows) before training.
@@ -295,8 +325,9 @@ def build_project(
     #    pool while chunk k trains; free arrays as artifacts dump.
     chunks: List[Tuple[Tuple, List[Machine]]] = []
     for key, bucket in buckets.items():
-        for start in range(0, len(bucket), max_bucket_size):
-            chunks.append((key, bucket[start : start + max_bucket_size]))
+        size = max_bucket_size or default_bucket_size(specs[key])
+        for start in range(0, len(bucket), size):
+            chunks.append((key, bucket[start : start + size]))
 
     def _load(m: Machine):
         t0 = time.time()

@@ -124,8 +124,10 @@ def build(output_dir, name, model_config, data_config, metadata,
 @click.option("--project-name", envvar="PROJECT_NAME", default="project")
 @click.option("--output-dir", envvar="OUTPUT_DIR", default="./models")
 @click.option("--model-register-dir", envvar="MODEL_REGISTER_DIR", default=None)
-@click.option("--max-bucket-size", default=512, show_default=True,
-              help="Max machines per stacked XLA program.")
+@click.option("--max-bucket-size", default=None, type=int,
+              help="Max machines per stacked XLA program. Default: "
+                   "per-model-family (512 dense, 256 recurrent — see "
+                   "builder.fleet_build.default_bucket_size).")
 @click.option("--data-parallel", default=1, show_default=True,
               help="Mesh 'data' axis size (chips per model shard).")
 @click.option("--data-workers", default=8, show_default=True,
@@ -209,14 +211,21 @@ def build_project_cmd(machine_config, project_name, output_dir,
                    "built machines (0 disables).")
 @click.option("--coalesce-ms", default=0.0, show_default=True,
               help="Micro-batch concurrent single-machine anomaly requests "
-                   "into stacked fleet dispatches, waiting up to this many "
-                   "ms per request (0 disables). Big win under concurrent "
-                   "load; requests below --coalesce-min-concurrency "
-                   "bypass the window and dispatch directly.")
+                   "into stacked fleet dispatches (0 disables). The drain "
+                   "is continuous; this bounds only the single-rider grace "
+                   "wait. Big win under concurrent load; requests below "
+                   "--coalesce-min-concurrency bypass and dispatch "
+                   "directly, and the coalescer stands down to direct "
+                   "dispatch when its saturation signal says batching is "
+                   "losing.")
 @click.option("--coalesce-min-concurrency", default=2, show_default=True,
               help="Coalesce only when at least this many single-machine "
                    "anomaly requests are in flight; below it requests "
                    "score directly (adaptive bypass).")
+@click.option("--coalesce-knee", default=0, show_default=True,
+              help="Cap coalesced dispatches at this many machines (the "
+                   "throughput knee). 0 = auto-estimate from a short "
+                   "warmup sweep on first use.")
 @click.option("--model-parallel/--no-model-parallel", default=False,
               show_default=True,
               help="Shard stacked serving dispatches over ALL visible "
@@ -227,8 +236,8 @@ def build_project_cmd(machine_config, project_name, output_dir,
                    "startup so the first request doesn't pay jit "
                    "compilation (~20-40s cold on TPU).")
 def run_server_cmd(model_dir, host, port, project, rescan_interval,
-                   coalesce_ms, coalesce_min_concurrency, model_parallel,
-                   warmup):
+                   coalesce_ms, coalesce_min_concurrency, coalesce_knee,
+                   model_parallel, warmup):
     """Serve model(s) over the /gordo/v0/<project>/<machine>/ routes."""
     from gordo_tpu.serve.server import run_server
 
@@ -237,6 +246,7 @@ def run_server_cmd(model_dir, host, port, project, rescan_interval,
         rescan_interval=rescan_interval,
         coalesce_window_ms=coalesce_ms,
         coalesce_min_concurrency=coalesce_min_concurrency,
+        coalesce_knee_batch=coalesce_knee,
         model_parallel=model_parallel,
         warmup=warmup,
     )
@@ -438,12 +448,44 @@ def workflow_generate(machine_config, project_name, image, server_replicas,
 @click.option("--machine-config", required=True, envvar="MACHINE_CONFIG")
 @click.option("--project-name", envvar="PROJECT_NAME", default="project")
 @click.option("--max-bucket-size", default=512, show_default=True)
-def workflow_plan(machine_config, project_name, max_bucket_size):
-    """Print the bucketed fleet build plan as YAML."""
+@click.option("--align-lengths", default=None, type=click.IntRange(min=2),
+              help="Plan for a build run with this --align-lengths value "
+                   "(cache keys include it; silences the ragged-compile "
+                   "warning).")
+@click.option("--pad-lengths", default=None, type=click.IntRange(min=2),
+              help="Plan for a build run with this --pad-lengths value "
+                   "(cache keys include it; silences the ragged-compile "
+                   "warning).")
+def workflow_plan(machine_config, project_name, max_bucket_size,
+                  align_lengths, pad_lengths):
+    """Print the bucketed fleet build plan as YAML.
+
+    When the configs predict a ragged fleet (multiple distinct train
+    lengths per bucket) and neither --align-lengths nor --pad-lengths is
+    planned, prints the estimated per-distinct-length compile bill to
+    stderr — the dry run is where that cost should surface, not an hour
+    into the build."""
     from gordo_tpu.workflow import NormalizedConfig, build_plan, load_machine_config
 
     config = NormalizedConfig(load_machine_config(machine_config), project_name)
-    click.echo(yaml.safe_dump(build_plan(config, max_bucket_size=max_bucket_size)))
+    plan = build_plan(
+        config, max_bucket_size=max_bucket_size,
+        align_lengths=align_lengths, pad_lengths=pad_lengths,
+    )
+    click.echo(yaml.safe_dump(plan))
+    warning = plan.get("ragged_compile_warning")
+    if warning:
+        click.echo(
+            "WARNING: ragged fleet — ~{n} distinct train lengths predicted "
+            "→ ~{extra} extra XLA compiles ≈ {secs}s of compile time. "
+            "{hint}".format(
+                n=warning["estimated_distinct_lengths"],
+                extra=warning["estimated_extra_compiles"],
+                secs=warning["estimated_extra_compile_seconds"],
+                hint=warning["hint"],
+            ),
+            err=True,
+        )
 
 
 @workflow_group.command("unique-tags")

@@ -8,14 +8,37 @@ and the per-request cost is DISPATCH (tiny program launch + transfer
 latency), not compute: the measured single-machine HTTP route sustains
 ~600k samples/s while the stacked bulk route moves 3.1M on the same
 hardware.  The coalescer closes that gap for clients that can't use the
-bulk route: requests arriving within a small window are grouped and scored
-through the SAME vmapped fleet program the ``_bulk`` route uses, then
-sliced back per request.
+bulk route: queued requests are grouped and scored through the SAME
+vmapped fleet program the ``_bulk`` route uses, then sliced back per
+request.
+
+Batching policy (r6 — the r5 windowed drain lost 15% throughput and +48%
+p99 at 64-way concurrency, BENCH_r05):
+
+- **Continuous drain.**  The worker pulls the queue the moment it is free
+  instead of idling through a fixed window; the previous dispatch's own
+  service time is the accumulation window.  Under light load a lone
+  request waits at most ``max_wait_s`` for a second rider; under heavy
+  load nothing ever waits idle.
+- **Knee cap.**  Effective batch size is capped at the measured
+  throughput knee — the batch size past which a bigger dispatch no longer
+  improves per-request amortization (it only stretches service time and
+  p99).  ``knee_batch`` sets it explicitly; by default a short warmup
+  sweep (:func:`estimate_knee`) measures it against the live fleet
+  scorer, exercising the same gathered-subset and full-bucket dispatch
+  paths production rounds use.
+- **Assembly off the drain thread.**  The drain thread runs only the
+  device dispatch (``FleetScorer.dispatch_all``); per-request result
+  assembly and future resolution run on a separate finish pool, so
+  response fan-out never serializes behind the next batch's gather.
+- **Saturation stand-down.**  When queue wait runs away from service time
+  (p99 wait > ``standdown_ratio`` × median service), batching is losing —
+  new arrivals dispatch directly for ``standdown_cooldown_s`` while the
+  queue drains, then coalescing resumes.  The combined path is never
+  worse than direct for longer than one cooldown.
 
 Semantics are identical to the per-machine path (same fused program
-family, same padding rules, same per-machine error isolation); only
-latency changes — by at most ``max_wait_s`` under light load, negative
-under heavy load (queueing beats serial dispatch).
+family, same padding rules, same per-machine error isolation).
 
 Enabled via ``build_app(collection, coalesce_window_ms=...)`` /
 ``gordo run-server --coalesce-ms ...``; off by default.
@@ -26,6 +49,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -33,10 +57,76 @@ import numpy as np
 
 logger = logging.getLogger(__name__)
 
+#: knee sweep acceptance: doubling the batch must improve throughput by at
+#: least this factor to keep doubling (1.1 = 10% — below that the bigger
+#: dispatch only stretches p99 for no amortization gain)
+KNEE_MIN_GAIN = 1.1
+
+
+def estimate_knee(
+    fleet: Any,
+    rows: int = 1024,
+    max_batch: int = 512,
+    min_gain: float = KNEE_MIN_GAIN,
+) -> Optional[Dict[str, float]]:
+    """Short warmup sweep for the batch-size throughput knee.
+
+    Doubles the dispatch size (1, 2, 4, …) against the fleet scorer's
+    largest bucket — subset-gather dispatches below the bucket size, the
+    full stacked program at it — and stops when throughput(b) <
+    ``min_gain`` × throughput(b/2), i.e. when a bigger batch stops paying
+    for its longer service time.  Each size is timed as the MIN of two
+    warm repetitions: a single noisy rep once mis-measured the knee at 1
+    and strangled the coalescer into serialized micro-batches (r6 bench,
+    −20% at 8-way).
+
+    Returns ``{"knee": b, "amortization": t(1)·b / t(b)}`` — the
+    amortization factor is how many single-dispatch service times b
+    batched requests cost; ~b on a dispatch-dominated device (TPU tunnel:
+    flat service curve), ~1 when service scales linearly with batch (CPU
+    compute-bound), where batching cannot pay at ANY size.  None when the
+    fleet has no stacked bucket (nothing to batch into).
+
+    Cost: ~3 dispatches per size, log2(max_batch) sizes — seconds, and
+    every dispatch doubles as program warmup for the sizes coalesced
+    rounds will actually run at.
+    """
+    buckets = getattr(fleet, "buckets", None)
+    if not buckets:
+        return None
+    bucket = max(buckets, key=lambda b: len(b.names))
+    names = bucket.names
+    n_feat = bucket.n_features or 1
+    rows = max(int(rows), bucket.lookback + 1)
+    X = np.zeros((rows, n_feat), np.float32)
+    knee = 1
+    t1: Optional[float] = None
+    prev_t: Optional[float] = None
+    size = 1
+    limit = min(int(max_batch), len(names))
+    while size <= limit:
+        sub = {n: X for n in names[:size]}
+        fleet.score_all(sub)  # compile/warm — excluded from the timing
+        t = float("inf")
+        for _ in range(2):  # min-of-2: timing noise only ever ADDS
+            t0 = time.perf_counter()
+            fleet.score_all(sub)
+            t = min(t, time.perf_counter() - t0)
+        if size == 1:
+            t1 = t
+        if prev_t is not None and t * min_gain > 2.0 * prev_t:
+            break  # throughput gain from doubling fell under min_gain
+        knee, prev_t = size, t
+        size *= 2
+    return {
+        "knee": knee,
+        "amortization": (t1 * knee / prev_t) if prev_t else 1.0,
+    }
+
 
 class CoalescingScorer:
-    """Queue single-machine anomaly requests; a worker drains them in
-    windows and runs one ``FleetScorer.score_all`` per drained batch.
+    """Queue single-machine anomaly requests; a worker drains them
+    continuously and runs one ``FleetScorer`` dispatch per drained batch.
 
     ``fleet_provider`` is called per batch (not cached) so a collection
     rescan's scorer reset takes effect on the next dispatch.
@@ -48,47 +138,221 @@ class CoalescingScorer:
         max_wait_s: float = 0.002,
         max_batch: int = 512,
         min_concurrency: int = 2,
+        knee_batch: int = 0,
+        min_amortization: float = 2.0,
+        standdown_ratio: float = 4.0,
+        standdown_cooldown_s: float = 0.5,
+        standdown_max_s: float = 8.0,
+        signal_window: int = 64,
     ):
         self._provider = fleet_provider
+        #: single-rider grace: a batch of 1 gains nothing from the stacked
+        #: gather, so when peers are in flight the drain waits up to this
+        #: long for a second rider.  This is the ONLY wait left from the
+        #: r5 windowed design — a queue with >=2 entries dispatches
+        #: immediately.
         self.max_wait_s = float(max_wait_s)
         self.max_batch = int(max_batch)
         #: adaptive bypass: coalescing only ever wins when requests overlap
         #: (≥2 riders share a dispatch); below this many in-flight
         #: single-machine requests the route scores directly, so an idle or
-        #: lightly-loaded server pays neither the window wait nor the
+        #: lightly-loaded server pays neither the rider wait nor the
         #: gather-dispatch overhead (r4 driver bench: coalescing at low
         #: concurrency cost 23% throughput / +66% p99)
         self.min_concurrency = int(min_concurrency)
+        #: explicit batch cap (0 = auto-estimate the knee on first use)
+        self.knee_batch = int(knee_batch)
+        #: batching must amortize at least this many single-dispatch
+        #: service times at the knee, or the sweep DISABLES coalescing
+        #: outright: an amortization of ~1 (service linear in batch — the
+        #: CPU compute-bound regime) means sharing a dispatch saves
+        #: nothing and queueing can only add latency.  An explicit
+        #: ``knee_batch`` skips the sweep and this check.
+        self.min_amortization = float(min_amortization)
+        self._knee_no_gain = False
+        self.standdown_ratio = float(standdown_ratio)
+        #: first stand-down lasts this long; CONSECUTIVE ones double it up
+        #: to ``standdown_max_s`` — a regime where batching structurally
+        #: loses converges to ~all-direct with rare short probes, instead
+        #: of spending half its time in losing re-probes
+        self.standdown_cooldown_s = float(standdown_cooldown_s)
+        self.standdown_max_s = float(standdown_max_s)
+        self._standdown_streak = 0
+        self.signal_window = int(signal_window)
         #: in-flight single-machine anomaly requests, maintained by the
         #: route handler on the event loop (single-threaded increments)
         self.inflight = 0
         self.n_bypassed = 0
+        self.n_queue_full = 0
+        self.n_standdowns = 0
+        self._standdown_until = 0.0
+        self._knee: Optional[int] = None
+        self._knee_started = False
         self._cv = threading.Condition()
-        self._queue: List[Tuple[str, np.ndarray, Future]] = []
+        self._queue: List[Tuple[str, np.ndarray, Future, float]] = []
         self._closed = False
         self.n_dispatches = 0
         self.n_requests = 0
         self.n_fallback = 0
+        #: saturation signal state (drain-thread writes, stats reads)
+        self._waits: deque = deque(maxlen=self.signal_window)
+        self._services: deque = deque(maxlen=32)
         # machines the fleet scorer can't stack run its slow host-side
         # fallback; they score HERE instead, so one slow machine can't
         # head-of-line-block the stacked batches on the worker thread
         self._fallback_pool = ThreadPoolExecutor(
             max_workers=4, thread_name_prefix="gordo-coalesce-fb"
         )
+        #: result assembly + future resolution run here, NOT on the drain
+        #: thread — the drain thread starts gathering the next batch the
+        #: moment the device dispatch returns
+        self._finish_pool = ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="gordo-coalesce-fin"
+        )
         self._thread = threading.Thread(
             target=self._run, name="gordo-coalescer", daemon=True
         )
         self._thread.start()
 
+    #: pre-knee batch cap: until the sweep lands, dispatches are bounded
+    #: here rather than at max_batch — the r5 64-way loss was exactly
+    #: uncapped saturated dispatches, and the estimate arrives within the
+    #: first seconds of load
+    PRE_KNEE_CAP = 64
+
+    # -- batching policy -----------------------------------------------------
+    @property
+    def batch_cap(self) -> int:
+        """Effective per-dispatch batch bound: the explicit ``knee_batch``,
+        else the estimated knee, else a conservative pre-knee cap."""
+        cap = (
+            self.knee_batch
+            or self._knee
+            or min(self.max_batch, self.PRE_KNEE_CAP)
+        )
+        return max(1, min(cap, self.max_batch))
+
+    def ensure_knee(self, rows: int = 1024) -> Optional[int]:
+        """Estimate the knee once (idempotent; safe from any thread).
+        Called from the server's warmup task when warmup is enabled, from
+        the replay harness's warmup phase, and lazily (in the background)
+        on the first live dispatch otherwise.
+
+        When the sweep finds no amortization (service time ~linear in
+        batch size), coalescing is DISABLED for this scorer's lifetime:
+        batching that saves nothing can only add queueing latency, so the
+        honest adaptive answer is to get out of the way entirely."""
+        if self.knee_batch or self._knee is not None or self._knee_no_gain:
+            return self._knee
+        self._knee_started = True
+        try:
+            est = estimate_knee(
+                self._provider(), rows=rows, max_batch=self.max_batch
+            )
+        except Exception:
+            logger.exception(
+                "Knee estimation failed; batch cap stays at the pre-knee "
+                "bound"
+            )
+            return None
+        if est is None:
+            return None
+        if est["amortization"] < self.min_amortization:
+            self._knee_no_gain = True
+            logger.warning(
+                "Coalescing disabled: batching amortizes only %.2fx a "
+                "single dispatch at the knee (< %.1fx) — sharing a "
+                "dispatch saves nothing on this backend, requests route "
+                "direct",
+                est["amortization"], self.min_amortization,
+            )
+            return None
+        self._knee = int(est["knee"])
+        logger.info(
+            "Coalescer batch knee estimated: %d (amortization %.1fx)",
+            self._knee, est["amortization"],
+        )
+        return self._knee
+
+    def _note_dispatch_signal(self, waits: List[float], service: float) -> None:
+        """Record queue waits + service time; stand down when p99 wait says
+        batching is losing (requests queue faster than dispatches clear)."""
+        self._waits.extend(waits)
+        self._services.append(service)
+        if (
+            len(self._waits) < max(4, self.signal_window // 4)
+            or len(self._services) < 4
+        ):
+            return
+        wait_p99 = float(np.percentile(np.asarray(self._waits), 99))
+        med_service = float(np.median(np.asarray(self._services)))
+        if wait_p99 > self.standdown_ratio * max(med_service, 1e-6):
+            cooldown = min(
+                self.standdown_cooldown_s * (2 ** self._standdown_streak),
+                self.standdown_max_s,
+            )
+            self._standdown_streak += 1
+            self._standdown_until = time.monotonic() + cooldown
+            self.n_standdowns += 1
+            # waits reset (they describe the regime we just left); service
+            # times stay — they remain valid and let a post-cooldown probe
+            # re-evaluate after only ~signal_window/4 fresh waits
+            self._waits.clear()
+            logger.warning(
+                "Coalescer standing down for %.2fs: queue wait p99 %.1fms "
+                "vs service median %.1fms (batching is losing; routing "
+                "direct)",
+                cooldown,
+                wait_p99 * 1e3,
+                med_service * 1e3,
+            )
+        else:
+            # a healthy evaluation ends the escalation: the next
+            # stand-down (if any) starts from the base cooldown again
+            self._standdown_streak = 0
+
+    @property
+    def standing_down(self) -> bool:
+        return time.monotonic() < self._standdown_until
+
     # -- producer side -------------------------------------------------------
     def should_coalesce(self) -> bool:
         """True when enough requests are in flight for a shared dispatch to
-        pay for its window wait; callers score directly otherwise (and count
-        the bypass for the stats endpoint)."""
-        if self.inflight >= self.min_concurrency:
-            return True
-        self.n_bypassed += 1
-        return False
+        pay for itself, the saturation signal isn't standing the coalescer
+        down, AND the queue isn't already saturated; callers score
+        directly otherwise (and count the bypass for the stats endpoint).
+
+        The queue-depth backpressure is the per-request loss bound: once
+        the queue holds two knee-capped dispatches' worth, a new rider
+        would wait >= 2 service times with no amortization gain, so it
+        dispatches direct instead — under saturation the combined path
+        degrades to ~direct continuously, without waiting for the
+        stand-down signal to accumulate."""
+        if self._knee_no_gain or self.standing_down:
+            self.n_bypassed += 1
+            return False
+        if self.inflight < self.min_concurrency:
+            self.n_bypassed += 1
+            return False
+        # len() on the queue list is GIL-atomic; a stale read only shifts
+        # one request between two correct paths
+        if len(self._queue) >= 2 * self.batch_cap:
+            self.n_queue_full += 1
+            self.n_bypassed += 1
+            return False
+        return True
+
+    def reset_stats(self) -> None:
+        """Zero the counters (requests/dispatches/bypasses) without
+        touching the learned policy state (knee, no-gain flag, stand-down
+        escalation) — benches call this after their warmup phase so the
+        reported stats describe only the measured window."""
+        self.n_requests = 0
+        self.n_dispatches = 0
+        self.n_fallback = 0
+        self.n_bypassed = 0
+        self.n_queue_full = 0
+        self.n_standdowns = 0
 
     def submit(self, name: str, X: np.ndarray) -> Future:
         """Enqueue one machine's rows; the Future resolves to the same
@@ -97,7 +361,7 @@ class CoalescingScorer:
         with self._cv:
             if self._closed:
                 raise RuntimeError("CoalescingScorer is closed")
-            self._queue.append((name, X, fut))
+            self._queue.append((name, X, fut, time.monotonic()))
             self._cv.notify()
         return fut
 
@@ -106,31 +370,38 @@ class CoalescingScorer:
             self._closed = True
             self._cv.notify()
         self._thread.join(timeout=5)
+        # drain thread no longer submits; let in-flight assemblies resolve
+        # their futures before the pool dies
+        self._finish_pool.shutdown(wait=True)
         self._fallback_pool.shutdown(wait=False)
 
     # -- worker side ---------------------------------------------------------
-    def _drain(self) -> List[Tuple[str, np.ndarray, Future]]:
-        """Block for work, then collect arrivals for up to ``max_wait_s``."""
+    def _drain(self) -> List[Tuple[str, np.ndarray, Future, float]]:
+        """Continuous drain: block for work, take what's queued (up to the
+        knee cap) NOW.  The only wait is the single-rider grace — one
+        queued request with peers still in flight holds ``max_wait_s`` for
+        a second rider, because a batch of 1 cannot amortize anything."""
         with self._cv:
             while not self._queue and not self._closed:
                 self._cv.wait()
             if not self._queue:
                 return []
-            if len(self._queue) < self.max_batch:
-                # normal operation: gather arrivals for one window.  Under
-                # overload (a full batch already queued) dispatch NOW —
-                # the leftovers of a burst must not sit through an extra
-                # idle window each round.
+            if (
+                len(self._queue) == 1
+                and self.inflight > 1
+                and self.max_wait_s > 0
+            ):
                 deadline = time.monotonic() + self.max_wait_s
-                while len(self._queue) < self.max_batch:
+                while len(self._queue) == 1 and not self._closed:
                     remaining = deadline - time.monotonic()
-                    if remaining <= 0 or self._closed:
+                    if remaining <= 0:
                         break
                     self._cv.wait(remaining)
-            # hand over at most max_batch; the rest stays queued for the
-            # next iteration instead of one unbounded mega-batch
-            batch = self._queue[: self.max_batch]
-            self._queue = self._queue[self.max_batch:]
+            # hand over at most batch_cap; the rest stays queued for the
+            # IMMEDIATE next iteration (no idle window between dispatches)
+            cap = self.batch_cap
+            batch = self._queue[:cap]
+            self._queue = self._queue[cap:]
             return batch
 
     def _run(self) -> None:
@@ -141,18 +412,23 @@ class CoalescingScorer:
                     if self._closed:
                         return
                     continue
+                t_dispatch = time.monotonic()
+                waits = [t_dispatch - t_enq for _, _, _, t_enq in batch]
                 # score_all keys by machine name, so duplicate-name requests
                 # split into successive rounds (each round has unique names)
                 rounds: List[Dict[str, Tuple[np.ndarray, Future]]] = []
-                for name, X, fut in batch:
+                for name, X, fut, _ in batch:
                     for rnd in rounds:
                         if name not in rnd:
                             rnd[name] = (X, fut)
                             break
                     else:
                         rounds.append({name: (X, fut)})
+                service = 0.0
                 for rnd in rounds:
-                    self._score_round(rnd)
+                    service += self._score_round(rnd)
+                if service > 0:
+                    self._note_dispatch_signal(waits, service)
             except Exception:
                 # the worker must be unkillable: a dead worker would leave
                 # every future unresolved and the route hanging forever
@@ -183,14 +459,23 @@ class CoalescingScorer:
             return
         self._finish(name, fut, out)
 
-    def _score_round(self, rnd: Dict[str, Tuple[np.ndarray, Future]]) -> None:
+    def _score_round(self, rnd: Dict[str, Tuple[np.ndarray, Future]]) -> float:
+        """Dispatch one unique-name round; returns the device service time
+        (0.0 when nothing reached a stacked dispatch)."""
         self.n_requests += len(rnd)
         try:
             scorer = self._provider()
         except Exception as exc:
             for _, fut in rnd.values():
                 self._resolve(fut, exc=exc)
-            return
+            return 0.0
+        if not self._knee_started and not self.knee_batch:
+            # lazy knee estimation off the drain thread: until it lands the
+            # cap is max_batch (the r5 behavior); the sweep doubles as
+            # subset-program warmup.  Row hint: this round's request shape.
+            self._knee_started = True
+            rows = max(x.shape[0] for x, _ in rnd.values())
+            self._fallback_pool.submit(self.ensure_knee, rows)
         # machines outside the stacked buckets run FleetScorer's host-side
         # fallback (potentially 100s of ms each) — push those off the
         # worker so they can't head-of-line-block the fast stacked batch
@@ -204,13 +489,40 @@ class CoalescingScorer:
                     self._score_one, scorer, name, X, fut
                 )
         if not stacked:
-            return
+            return 0.0
         rnd = stacked
         self.n_dispatches += 1
+        t0 = time.monotonic()
         try:
-            out = scorer.score_all({n: x for n, (x, _) in rnd.items()})
+            # dispatch_all runs the device work (stack → dispatch →
+            # device_get) and defers per-machine assembly; scorers without
+            # the split API (tests, exotic providers) do both here
+            dispatch = getattr(scorer, "dispatch_all", None)
+            X_map = {n: x for n, (x, _) in rnd.items()}
+            pending = dispatch(X_map) if dispatch is not None else (
+                scorer.score_all(X_map)
+            )
         except Exception as exc:  # whole-dispatch failure: fail each future
             logger.exception("Coalesced dispatch failed")
+            for _, fut in rnd.values():
+                self._resolve(fut, exc=exc)
+            return time.monotonic() - t0
+        service = time.monotonic() - t0
+        # per-request result assembly + future resolution run on the
+        # finish pool: the drain thread is free to gather the next batch
+        self._finish_pool.submit(self._finish_round, rnd, pending)
+        return service
+
+    def _finish_round(
+        self, rnd: Dict[str, Tuple[np.ndarray, Future]], pending: Any
+    ) -> None:
+        """Assemble per-machine results (host-side numpy slicing) and
+        resolve the round's futures — off the drain thread."""
+        try:
+            assemble = getattr(pending, "assemble", None)
+            out = assemble() if assemble is not None else pending
+        except Exception as exc:
+            logger.exception("Coalesced result assembly failed")
             for _, fut in rnd.values():
                 self._resolve(fut, exc=exc)
             return
@@ -253,4 +565,12 @@ def stats(coalescer: Optional[CoalescingScorer]) -> Dict[str, Any]:
             if coalescer.n_dispatches
             else None
         ),
+        # r6 adaptive policy state
+        "batch_cap": coalescer.batch_cap,
+        "knee_batch": coalescer.knee_batch or None,
+        "knee_estimated": coalescer._knee,
+        "knee_no_gain": coalescer._knee_no_gain,
+        "queue_full_bypassed": coalescer.n_queue_full,
+        "standdowns": coalescer.n_standdowns,
+        "standing_down": coalescer.standing_down,
     }

@@ -20,13 +20,25 @@ from __future__ import annotations
 
 import ctypes
 import json
-from typing import Any, List, Optional
+from typing import Any, Callable, List, Optional, Tuple
 
 import numpy as np
 
 from gordo_tpu._native import load_fastjson
 
 MSGPACK_CONTENT_TYPE = "application/x-msgpack"
+
+
+def negotiate(accept: Optional[str]) -> Tuple[Callable[[Any], bytes], str]:
+    """Pick the response encoder for an ``Accept`` header value: msgpack
+    when the client asks for it, JSON (native-kernel ndarray leaves)
+    otherwise.  The ONE content-negotiation rule every response path
+    (server handlers, the coalescer's pre-encoded results, benches) must
+    share — divergence would make the same request encode differently
+    depending on which path served it."""
+    if MSGPACK_CONTENT_TYPE in (accept or ""):
+        return packb, MSGPACK_CONTENT_TYPE
+    return dumps_bytes, "application/json"
 
 try:
     import msgpack

@@ -352,6 +352,49 @@ def _signature(chain: Dict[str, Any]) -> Optional[Tuple]:
     )
 
 
+class FleetDispatch:
+    """Completed device dispatches whose per-machine result assembly is
+    deferred.
+
+    ``FleetScorer.dispatch_all`` returns one of these after the device
+    work (stacking, dispatch, device→host transfer) is done;
+    :meth:`assemble` performs the remaining host-side numpy slicing and
+    dict building.  The split exists for the coalescer's drain thread:
+    assembly of round N must not delay the gather of round N+1, so the
+    drain thread calls ``dispatch_all`` and hands the ``FleetDispatch``
+    to a finish pool.  ``assemble`` touches only host arrays already
+    fetched from the device, so it is safe on any thread and needs no
+    bucket lock.
+    """
+
+    def __init__(self):
+        #: results already final at dispatch time: per-machine validation
+        #: errors, fallback-path machines, windows-bound per-machine scores
+        self.results: Dict[str, Dict[str, Any]] = {}
+        #: (host outputs, bucket, [(name, slot, stack_pos, n_valid), ...])
+        self._pending: List[Tuple[Dict[str, np.ndarray], Any, List[Tuple]]] = []
+
+    def assemble(self) -> Dict[str, Dict[str, np.ndarray]]:
+        """Slice each machine's rows out of the stacked host outputs and
+        attach its thresholds; idempotent-safe (pending entries drain)."""
+        pending, self._pending = self._pending, []
+        for out, bucket, slots in pending:
+            for name, slot, stack_pos, n_valid in slots:
+                res = {
+                    k: np.asarray(v[slot])[:n_valid]
+                    for k, v in out.items()
+                }
+                if bucket.with_thresholds:
+                    res["tag-anomaly-thresholds"] = bucket.thresholds_np[
+                        stack_pos
+                    ].copy()
+                    res["total-anomaly-threshold"] = float(
+                        bucket.agg_thresholds_np[stack_pos]
+                    )
+                self.results[name] = res
+        return self.results
+
+
 class FleetScorer:
     """Serve MANY machines' anomaly scoring as stacked device programs.
 
@@ -413,7 +456,14 @@ class FleetScorer:
         Rows are padded (repeat-last) to a shared power-of-two bucket per
         program; outputs are sliced back per machine.
         """
-        results: Dict[str, Dict[str, np.ndarray]] = {}
+        return self.dispatch_all(X_by_name).assemble()
+
+    def dispatch_all(self, X_by_name: Dict[str, np.ndarray]) -> FleetDispatch:
+        """The device half of :meth:`score_all`: run every stacked (and
+        fallback) dispatch, defer the per-machine host-side slicing to the
+        returned :class:`FleetDispatch` — callable from another thread."""
+        dispatch = FleetDispatch()
+        results = dispatch.results
         for bucket in self.buckets:
             wanted = [n for n in bucket.names if n in X_by_name]
             if not wanted:
@@ -577,22 +627,15 @@ class FleetScorer:
                         out = jax.device_get(bucket.score(stacked))
                         # full dispatch: output slots ARE stack positions
                         slot_of = None
+                # device work done (out is host numpy after device_get);
+                # record the slicing plan and defer the copies to assemble()
+                slots = []
                 for name in chunk:
                     stack_pos = self.machine_bucket[name][1]
                     slot = stack_pos if slot_of is None else slot_of[name]
                     n_valid = arrays[name].shape[0] - offset_rows
-                    res = {
-                        k: np.asarray(v[slot])[:n_valid]
-                        for k, v in out.items()
-                    }
-                    if bucket.with_thresholds:
-                        res["tag-anomaly-thresholds"] = bucket.thresholds_np[
-                            stack_pos
-                        ].copy()
-                        res["total-anomaly-threshold"] = float(
-                            bucket.agg_thresholds_np[stack_pos]
-                        )
-                    results[name] = res
+                    slots.append((name, slot, stack_pos, n_valid))
+                dispatch._pending.append((out, bucket, slots))
 
         for name, scorer in self.fallbacks.items():
             if name in X_by_name:
@@ -620,4 +663,4 @@ class FleetScorer:
                         "error": str(exc),
                         "client-error": isinstance(exc, ValueError),
                     }
-        return results
+        return dispatch

@@ -8,6 +8,20 @@ parsing, executor handoff, device dispatch, and the response codec all
 included (the in-process scorer numbers in ``bench.py`` deliberately
 exclude those, which is why both are reported).
 
+Two load models, because they answer different questions:
+
+- **Closed loop** (default): ``parallelism`` requests in flight, each new
+  request fired the moment one completes.  Measures saturation
+  throughput; its latency percentiles are saturation artifacts (queueing
+  behind the in-flight window) — honest about capacity, useless for SLOs.
+- **Open loop** (``arrival_rate_hz > 0``): requests fire on a fixed
+  schedule regardless of completions, the way real independent clients
+  arrive.  Latency is measured from each request's SCHEDULED start, so a
+  server falling behind accumulates the backlog into its tail — the p99
+  an SLO would actually use.  :func:`openloop_bench` runs the standard
+  protocol: measure saturation closed-loop, then report p50/p99 at fixed
+  fractions (0.5×, 0.8×) of it.
+
 Request bodies are pre-serialized outside the timed loop: the subject
 under test is the server, not the load generator.
 """
@@ -17,7 +31,7 @@ from __future__ import annotations
 import asyncio
 import json
 import time
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import aiohttp
 import numpy as np
@@ -59,14 +73,17 @@ async def _replay(
     timeout_s: float,
     coalesce_window_ms: float = 0.0,
     coalesce_min_concurrency: int = 2,
+    coalesce_knee_batch: int = 0,
+    arrival_rate_hz: float = 0.0,
+    openloop_duration_s: float = 5.0,
 ) -> Dict[str, Any]:
-    runner = web.AppRunner(
-        build_app(
-            collection,
-            coalesce_window_ms=coalesce_window_ms,
-            coalesce_min_concurrency=coalesce_min_concurrency,
-        )
+    app = build_app(
+        collection,
+        coalesce_window_ms=coalesce_window_ms,
+        coalesce_min_concurrency=coalesce_min_concurrency,
+        coalesce_knee_batch=coalesce_knee_batch,
     )
+    runner = web.AppRunner(app)
     await runner.setup()
     site = web.TCPSite(runner, "127.0.0.1", 0)
     await site.start()
@@ -96,16 +113,20 @@ async def _replay(
             }
         ).encode()
 
-    # pre-serialized request bodies, one per (round, request)
+    # pre-serialized request bodies, one (url, body, n_samples) per
+    # (round, request) — the sample count rides along so the open-loop
+    # schedule can account for what it actually sent
     if mode == "bulk":
         bodies = [
             [(f"{base}/_bulk/anomaly/prediction",
-              enc({"X": {m: stream[m][r] for m in names}}))]
+              enc({"X": {m: stream[m][r] for m in names}}),
+              n_samples_round)]
             for r in range(n_rounds + 1)
         ]
     else:
         bodies = [
-            [(f"{base}/{m}/anomaly/prediction", enc({"X": stream[m][r]}))
+            [(f"{base}/{m}/anomaly/prediction", enc({"X": stream[m][r]}),
+              stream[m][r].size)
              for m in names]
             for r in range(n_rounds + 1)
         ]
@@ -117,58 +138,127 @@ async def _replay(
 
         latencies: List[float] = []
 
-        async def post(url: str, body: bytes) -> int:
-            t_req = time.perf_counter()  # before the semaphore: queueing
-            # behind in-flight peers is part of what a real client sees
-            async with sem:
+        async def post(
+            url: str, body: bytes, t_sched: Optional[float] = None
+        ) -> int:
+            """One measured request.  Closed loop: latency from submission
+            (queueing behind the in-flight window included).  Open loop
+            (``t_sched``): latency from the SCHEDULED start — when the
+            server falls behind the arrival schedule, the backlog lands in
+            the tail instead of silently throttling the load."""
+            t_req = time.perf_counter() if t_sched is None else t_sched
+            if t_sched is None:
+                async with sem:
+                    async with session.post(
+                        url, data=body, headers=headers
+                    ) as resp:
+                        raw = await resp.read()
+            else:  # open loop: no semaphore — arrivals don't wait for peers
                 async with session.post(
                     url, data=body, headers=headers
                 ) as resp:
                     raw = await resp.read()
-                    latencies.append(time.perf_counter() - t_req)
-                    if resp.status != 200:
-                        errors.append(
-                            f"{resp.status}: {raw[:200]!r}"
-                        )
-                    return len(raw)
+            latencies.append(time.perf_counter() - t_req)
+            if resp.status != 200:
+                errors.append(f"{resp.status}: {raw[:200]!r}")
+            return len(raw)
 
         # warm-up round: jit compiles, scorer stacking, codec caches
-        await asyncio.gather(*(post(u, b) for u, b in bodies[0]))
+        await asyncio.gather(*(post(u, b) for u, b, _ in bodies[0]))
         if errors:
             raise RuntimeError(f"Replay warm-up failed: {errors[:3]}")
+        if coalesce_window_ms > 0:
+            # warm the coalescer's knee estimate like production warmup
+            # (`run-server --warmup`) would — otherwise the sweep runs
+            # lazily INSIDE the measured rounds, contending with them,
+            # and the batch cap stays at its pre-knee bound throughout.
+            # Counters reset afterwards so the reported stats attest the
+            # MEASURED window only (e.g. "routed 100% direct" is visible
+            # when the sweep found no amortization).
+            from gordo_tpu.serve.server import COALESCER_KEY
+
+            coalescer = app[COALESCER_KEY]
+            await asyncio.get_running_loop().run_in_executor(
+                None, lambda: coalescer.ensure_knee(rows)
+            )
+            coalescer.reset_stats()
         latencies.clear()  # warm-up requests are not part of the measurement
 
-        t0 = time.perf_counter()
         response_bytes = 0
-        for round_bodies in bodies[1:]:
-            sizes = await asyncio.gather(
-                *(post(u, b) for u, b in round_bodies)
+        if arrival_rate_hz > 0:
+            # ---- open loop: fixed-rate schedule over the measured bodies
+            flat = [req for rnd in bodies[1:] for req in rnd]
+            n_requests = max(
+                int(arrival_rate_hz * openloop_duration_s), 20
             )
-            response_bytes += sum(sizes)
-        dt = time.perf_counter() - t0
+            schedule = [flat[i % len(flat)] for i in range(n_requests)]
+            total_samples = sum(n for _, _, n in schedule)
+            tasks = []
+            t0 = time.perf_counter()
+            for i, (u, b, _) in enumerate(schedule):
+                target = t0 + i / arrival_rate_hz
+                delay = target - time.perf_counter()
+                if delay > 0:
+                    await asyncio.sleep(delay)
+                tasks.append(
+                    asyncio.create_task(post(u, b, t_sched=target))
+                )
+            sizes = await asyncio.gather(*tasks)
+            dt = time.perf_counter() - t0
+            response_bytes = sum(sizes)
+            n_measured = n_requests
+        else:
+            # ---- closed loop: rounds at fixed in-flight parallelism
+            total_samples = n_rounds * n_samples_round
+            t0 = time.perf_counter()
+            for round_bodies in bodies[1:]:
+                sizes = await asyncio.gather(
+                    *(post(u, b) for u, b, _ in round_bodies)
+                )
+                response_bytes += sum(sizes)
+            dt = time.perf_counter() - t0
+            n_measured = sum(len(rnd) for rnd in bodies[1:])
+    coalescer_stats = None
+    if coalesce_window_ms > 0:
+        from gordo_tpu.serve import coalesce as coalesce_mod
+        from gordo_tpu.serve.server import COALESCER_KEY
+
+        coalescer_stats = coalesce_mod.stats(app[COALESCER_KEY])
     await runner.cleanup()
     if errors:
         raise RuntimeError(f"Replay had {len(errors)} errors: {errors[:3]}")
     p50, p99 = (
         np.percentile(latencies, [50, 99]) if latencies else (float("nan"),) * 2
     )
-    return {
+    out = {
         "mode": mode,
         "wire": wire,
         "n_machines": len(names),
         "rows_per_request": rows,
         "n_rounds": n_rounds,
         "seconds": dt,
-        "samples_per_sec": n_rounds * n_samples_round / dt,
+        "samples_per_sec": total_samples / dt,
+        "requests_per_sec": n_measured / dt,
         "response_mb_per_sec": response_bytes / dt / 1e6,
-        # under-load request latency, timed from submission (queueing
-        # behind the in-flight window included — what a client experiences).
+        # request latency, timed from submission (closed loop: queueing
+        # behind the in-flight window included) or from the scheduled
+        # arrival (open loop: schedule backlog included — what an
+        # independent client experiences at that rate).
         # latency_n is the sample count: with few requests (bulk mode runs
         # one per round) the "p99" is really a near-max — read it with n.
         "latency_n": len(latencies),
         "latency_p50_ms": float(p50 * 1e3),
         "latency_p99_ms": float(p99 * 1e3),
     }
+    if arrival_rate_hz > 0:
+        out["open_loop"] = True
+        out["arrival_rate_hz"] = float(arrival_rate_hz)
+        out["n_requests"] = n_measured
+    if coalescer_stats is not None:
+        # how the adaptive policy actually behaved during the run
+        # (mean_batch, batch_cap/knee, standdowns, queue_full_bypassed)
+        out["coalescer"] = coalescer_stats
+    return out
 
 
 def replay_bench(
@@ -182,6 +272,9 @@ def replay_bench(
     timeout_s: float = 600.0,
     coalesce_window_ms: float = 0.0,
     coalesce_min_concurrency: int = 2,
+    coalesce_knee_batch: int = 0,
+    arrival_rate_hz: float = 0.0,
+    openloop_duration_s: float = 5.0,
 ) -> Dict[str, Any]:
     """Measure end-to-end HTTP anomaly-scoring throughput.
 
@@ -189,11 +282,79 @@ def replay_bench(
     machine's chunk) or ``"single"`` (one request per machine per round,
     ``parallelism`` in flight).  ``wire``: ``"json"`` or ``"msgpack"``.
     ``coalesce_window_ms``: enable the server's cross-request coalescer
-    (requests below ``coalesce_min_concurrency`` in flight bypass it).
+    (requests below ``coalesce_min_concurrency`` in flight bypass it;
+    ``coalesce_knee_batch`` pins its dispatch cap, 0 = auto).
+    ``arrival_rate_hz > 0``: OPEN-LOOP mode — fire requests on a fixed
+    schedule for ``openloop_duration_s`` (cycling the pre-serialized
+    bodies) instead of closed-loop rounds; latency percentiles are then
+    measured from scheduled arrival times.
     """
     return asyncio.run(
         _replay(
             collection, mode, wire, n_rounds, rows, parallelism, machines,
             timeout_s, coalesce_window_ms, coalesce_min_concurrency,
+            coalesce_knee_batch, arrival_rate_hz, openloop_duration_s,
         )
     )
+
+
+def openloop_bench(
+    collection: ModelCollection,
+    mode: str = "bulk",
+    wire: str = "msgpack",
+    rows: int = 2048,
+    machines: Optional[Sequence[str]] = None,
+    parallelism: int = 8,
+    sat_rounds: int = 3,
+    fractions: Sequence[float] = (0.5, 0.8),
+    duration_s: float = 5.0,
+    timeout_s: float = 600.0,
+    coalesce_window_ms: float = 0.0,
+    coalesce_min_concurrency: int = 2,
+    coalesce_knee_batch: int = 0,
+) -> Dict[str, Any]:
+    """Open-loop latency protocol: measure saturation closed-loop, then
+    p50/p99 at fixed fractions of it.
+
+    Returns ``saturation_requests_per_sec`` plus one entry per fraction
+    under ``points`` (keys like ``"0.5x"``, ``"0.8x"``) carrying
+    ``latency_p50_ms`` / ``latency_p99_ms`` / ``latency_n`` at that
+    arrival rate.  Each run spins its own server; the jit/program caches
+    are process-wide, so the saturation run doubles as warmup.
+    """
+    common = dict(
+        mode=mode, wire=wire, rows=rows, machines=machines,
+        timeout_s=timeout_s, coalesce_window_ms=coalesce_window_ms,
+        coalesce_min_concurrency=coalesce_min_concurrency,
+        coalesce_knee_batch=coalesce_knee_batch,
+    )
+    sat = replay_bench(
+        collection, n_rounds=sat_rounds, parallelism=parallelism, **common
+    )
+    sat_rps = sat["requests_per_sec"]
+    out: Dict[str, Any] = {
+        "mode": mode,
+        "wire": wire,
+        "coalesced": coalesce_window_ms > 0,
+        "saturation_requests_per_sec": sat_rps,
+        "saturation_samples_per_sec": sat["samples_per_sec"],
+        "saturation_parallelism": parallelism,
+        "points": {},
+    }
+    for frac in fractions:
+        res = replay_bench(
+            collection,
+            n_rounds=sat_rounds,
+            parallelism=parallelism,
+            arrival_rate_hz=frac * sat_rps,
+            openloop_duration_s=duration_s,
+            **common,
+        )
+        out["points"][f"{frac:g}x"] = {
+            "arrival_rate_hz": res["arrival_rate_hz"],
+            "latency_p50_ms": res["latency_p50_ms"],
+            "latency_p99_ms": res["latency_p99_ms"],
+            "latency_n": res["latency_n"],
+            "samples_per_sec": res["samples_per_sec"],
+        }
+    return out

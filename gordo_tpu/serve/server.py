@@ -294,10 +294,7 @@ async def _respond(
     was the measured HTTP serving ceiling — see ``serve/codec.py``).
     Encoding runs in the executor: a large bulk body takes ~100ms even
     natively, which must not stall the accept loop."""
-    if codec.MSGPACK_CONTENT_TYPE in request.headers.get("Accept", ""):
-        encode, content_type = codec.packb, codec.MSGPACK_CONTENT_TYPE
-    else:
-        encode, content_type = codec.dumps_bytes, "application/json"
+    encode, content_type = codec.negotiate(request.headers.get("Accept", ""))
     body = await asyncio.get_running_loop().run_in_executor(
         None, encode, obj
     )
@@ -701,15 +698,21 @@ def build_app(
     coalesce_window_ms: float = 0.0,
     warmup: bool = False,
     coalesce_min_concurrency: int = 2,
+    coalesce_knee_batch: int = 0,
 ) -> web.Application:
     """``rescan_interval > 0`` starts a background artifact-dir rescan so
     machines built after startup begin serving without a restart.
     ``coalesce_window_ms > 0`` micro-batches concurrent single-machine
-    anomaly requests into stacked fleet dispatches (``serve/coalesce.py``)
-    at the cost of up to that much added latency per request — but only
-    once at least ``coalesce_min_concurrency`` such requests are in
-    flight; below that the route dispatches directly (adaptive bypass), so
-    an idle or lightly-loaded server keeps uncoalesced latency.
+    anomaly requests into stacked fleet dispatches (``serve/coalesce.py``):
+    a continuous drain groups whatever is queued, capping each dispatch at
+    the measured throughput knee and standing down to direct dispatch when
+    the saturation signal says batching is losing.  ``coalesce_window_ms``
+    bounds only the single-rider grace wait (one queued request holding
+    for a second rider); requests below ``coalesce_min_concurrency`` in
+    flight dispatch directly (adaptive bypass), so an idle or
+    lightly-loaded server keeps uncoalesced latency.
+    ``coalesce_knee_batch`` pins the batch cap explicitly (0 = estimate
+    it from a short warmup sweep on first use).
     ``warmup`` precompiles the serving programs in a background executor
     task at startup (``warmup_scorers``) — the server accepts traffic
     immediately; an early request races the warmup at worst."""
@@ -746,6 +749,14 @@ def build_app(
             def runner():
                 try:
                     res = warmup_scorers(collection)
+                    coalescer = app.get(COALESCER_KEY)
+                    if coalescer is not None:
+                        # the knee sweep rides the warmup thread: it warms
+                        # the subset programs coalesced rounds run at AND
+                        # fixes the batch cap before real traffic arrives
+                        res["coalesce_knee"] = coalescer.ensure_knee(
+                            rows=2048
+                        )
                 except Exception as exc:  # warmup_scorers logs details
                     # bind now: CPython deletes the except-bound name when
                     # the block exits, before the scheduled callback runs
@@ -765,6 +776,7 @@ def build_app(
             lambda: collection.fleet_scorer,
             max_wait_s=coalesce_window_ms / 1000.0,
             min_concurrency=coalesce_min_concurrency,
+            knee_batch=coalesce_knee_batch,
         )
         app[COALESCER_KEY] = coalescer
 
@@ -827,6 +839,7 @@ def run_server(
     rescan_interval: float = 30.0,
     coalesce_window_ms: float = 0.0,
     coalesce_min_concurrency: int = 2,
+    coalesce_knee_batch: int = 0,
     model_parallel: bool = False,
     warmup: bool = False,
 ) -> None:
@@ -871,6 +884,7 @@ def run_server(
             rescan_interval=rescan_interval,
             coalesce_window_ms=coalesce_window_ms,
             coalesce_min_concurrency=coalesce_min_concurrency,
+            coalesce_knee_batch=coalesce_knee_batch,
             warmup=warmup,
         ),
         host=host,

@@ -63,21 +63,67 @@ def _fleet_signature(machine: Machine) -> str:
     return json.dumps({"model": machine.model, "n_tags": n_tags}, sort_keys=True)
 
 
+#: measured per-distinct-row-count XLA compile cost of the fleet CV+fit
+#: program (docs/perf.md "Ragged-length fleets": 218.9s cold for 16
+#: lengths ≈ 13.7s each, CPU jax; TPU compiles are comparable)
+COMPILE_SECONDS_PER_LENGTH = 13.7
+
+
+def _ragged_length_estimate(members: List[Machine]) -> int:
+    """Config-level upper estimate of DISTINCT train-row-counts in one
+    bucket — each distinct length compiles its own fleet program.
+
+    Machines without a row filter share a length whenever their
+    (train window, resolution) agree; a machine WITH a ``row_filter``
+    drops an unpredictable number of rows, so each one must be assumed a
+    distinct length (that unpredictability is exactly why raggedness is
+    the production norm)."""
+    windows = set()
+    filtered = 0
+    for m in members:
+        ds = m.dataset
+        if ds.get("row_filter"):
+            filtered += 1
+        else:
+            windows.add((
+                str(ds.get("train_start_date")),
+                str(ds.get("train_end_date")),
+                str(ds.get("resolution")),
+            ))
+    return len(windows) + filtered
+
+
 def build_plan(
     config: NormalizedConfig,
     max_bucket_size: int = 512,
     mesh: Optional[Dict[str, int]] = None,
     align_lengths: Optional[int] = None,
+    pad_lengths: Optional[int] = None,
 ) -> Dict[str, Any]:
     """Bucketed fleet build plan for the project.
 
-    ``align_lengths`` must match the value the build will run with: it is
-    part of fleet-built machines' cache identity, so plan keys computed
-    without it would never match the registry entries an aligned
-    ``build_project`` writes.  (Like the bucketing itself, the keys are
-    the fleet-path prediction: a machine the builder demotes to the
-    single path at run time keys without the alignment component there.)"""
-    key_extra = {"align_lengths": align_lengths} if align_lengths else None
+    ``align_lengths`` / ``pad_lengths`` must match the value the build
+    will run with: they are part of fleet-built machines' cache identity,
+    so plan keys computed without them would never match the registry
+    entries an aligned/padded ``build_project`` writes.  (Like the
+    bucketing itself, the keys are the fleet-path prediction: a machine
+    the builder demotes to the single path at run time keys without the
+    component there.)
+
+    When NEITHER is set and the configs predict multiple distinct train
+    lengths per bucket, the plan carries a ``ragged_compile_warning``
+    with the estimated per-distinct-length compile bill — explicit, not
+    silent: a 1000-machine filtered project that forgets the flag would
+    otherwise discover the cost an hour into its build."""
+    if align_lengths and pad_lengths:
+        raise ValueError(
+            "align_lengths and pad_lengths are mutually exclusive"
+        )
+    key_extra = None
+    if align_lengths:
+        key_extra = {"align_lengths": align_lengths}
+    elif pad_lengths:
+        key_extra = {"pad_lengths": pad_lengths}
     buckets: Dict[str, List[Machine]] = {}
     for machine in config.machines:
         buckets.setdefault(_fleet_signature(machine), []).append(machine)
@@ -110,6 +156,29 @@ def build_plan(
     }
     if align_lengths:
         plan["align_lengths"] = int(align_lengths)
+    if pad_lengths:
+        plan["pad_lengths"] = int(pad_lengths)
+    if key_extra is None:
+        est_lengths = sum(
+            _ragged_length_estimate(members) for members in buckets.values()
+        )
+        extra = est_lengths - len(buckets)  # 1 compile/bucket is the floor
+        if extra > 0:
+            plan["ragged_compile_warning"] = {
+                "estimated_distinct_lengths": est_lengths,
+                "estimated_extra_compiles": extra,
+                "estimated_extra_compile_seconds": round(
+                    extra * COMPILE_SECONDS_PER_LENGTH, 1
+                ),
+                "hint": (
+                    "Exact mode compiles one fleet program per distinct "
+                    "train-row-count (~"
+                    f"{COMPILE_SECONDS_PER_LENGTH:g}s each, measured). "
+                    "Set align_lengths (truncate down, exact parity on "
+                    "the truncated data) or pad_lengths (zero data loss, "
+                    "padded fold geometry) to collapse them."
+                ),
+            }
     return plan
 
 

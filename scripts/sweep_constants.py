@@ -14,9 +14,11 @@ timeout-killed — a killed client can wedge the relay):
   compile/dispatch overhead)
 - ``sustained``: one 4096-machine memory-bounded project build
 - ``lstmdtype``: LSTM fleet build rate, bfloat16 vs float32 compute
+- ``lstmbucket``: LSTM fleet build rate vs machines-per-bucket, 64→512
+  (→ ``builder/fleet_build.py::DEFAULT_MAX_BUCKET_LSTM``)
 
 Usage: python scripts/sweep_constants.py
-           {minbucket|bucket|smooth|multibucket|sustained|lstmdtype} [n]
+           {minbucket|bucket|smooth|multibucket|sustained|lstmdtype|lstmbucket} [n]
 (``n`` — machine count — applies to bucket/sustained/lstmdtype only.)
 """
 
@@ -231,6 +233,58 @@ def sweep_lstmdtype(n_machines: int = 32) -> None:
         _timed_build(machines, f"compute_dtype={dtype}")
 
 
+def sweep_lstmbucket(n_unused: int = 0, epochs: int = 2) -> None:
+    """Machines-per-bucket sweep for the LSTM fleet CV+fit program
+    (→ ``builder/fleet_build.py::DEFAULT_MAX_BUCKET_LSTM``).
+
+    Per bucket size b in 64→512: build exactly b machines as ONE chunk
+    (``max_bucket_size=b``) — a big project's steady-state rate IS its
+    per-chunk rate, since chunks run sequentially — cold then warm, so
+    the table carries both the per-size compile cost and the amortized
+    rate.  ``epochs=2`` (vs the bench's 10) keeps the 512-point tractable
+    on CPU; dispatch-amortization differences between bucket sizes only
+    get MORE visible with less compute per machine, so the knee the sweep
+    finds is conservative.  Peak host/device memory scales with b via the
+    stacked (b, rows, 50) arrays and the windows tensors — the smoothing
+    bound (`docs/perf.md`) is the other half of the decision."""
+    from gordo_tpu.workflow.config import Machine
+
+    for b in (64, 128, 256, 512):
+        machines = [
+            Machine.from_config(
+                {
+                    "name": f"lb-{b}-{i:03d}",
+                    "dataset": {
+                        "type": "RandomDataset",
+                        "tag_list": [f"t-{i}-{j}" for j in range(50)],
+                    },
+                    "model": {
+                        "gordo_tpu.anomaly.diff.DiffBasedAnomalyDetector": {
+                            "base_estimator": {
+                                "gordo_tpu.pipeline.Pipeline": {
+                                    "steps": [
+                                        "gordo_tpu.ops.scalers.MinMaxScaler",
+                                        {
+                                            "gordo_tpu.models.estimator"
+                                            ".LSTMAutoEncoder": {
+                                                "kind": "lstm_hourglass",
+                                                "lookback_window": 12,
+                                                "epochs": epochs,
+                                                "batch_size": 64,
+                                            }
+                                        },
+                                    ]
+                                }
+                            }
+                        }
+                    },
+                }
+            )
+            for i in range(b)
+        ]
+        _timed_build(machines, f"lstm_bucket={b:4d}", max_bucket_size=b)
+
+
 def sweep_smooth() -> None:
     """Probe the smoothing windows-tensor guard: disable it and drive
     stacked scoring at sizes spanning the current 2^27-element bound."""
@@ -277,6 +331,7 @@ if __name__ == "__main__":
         "multibucket": sweep_multibucket,
         "sustained": sweep_sustained,
         "lstmdtype": sweep_lstmdtype,
+        "lstmbucket": sweep_lstmbucket,
     }
     which = sys.argv[1] if len(sys.argv) > 1 else ""
     if which not in sweeps:

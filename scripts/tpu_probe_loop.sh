@@ -1,12 +1,37 @@
 #!/bin/bash
-# Probe the axon TPU tunnel every ~4 minutes; log results. Stop when healthy.
+# Probe the axon TPU tunnel every ~4 minutes; log every probe BOTH to
+# stdout and to TUNNEL_LOG.md at the repo root, so a tunnel that stays
+# wedged for a whole round is itself driver-attested (VERDICT r5 "Next
+# round" item 1: if the tunnel stays dead, the wedge must be evidence,
+# not an excuse).  Stop when healthy.
 # Usage: nohup bash scripts/tpu_probe_loop.sh >/tmp/tpu_probe.log 2>&1 &
+cd "$(dirname "$0")/.."
+LOG=TUNNEL_LOG.md
+if [ ! -f "$LOG" ]; then
+  {
+    echo "# TPU tunnel probe log"
+    echo
+    echo "One row per probe of the axon TPU tunnel, appended by"
+    echo '`scripts/tpu_probe_loop.sh` (the probe is `timeout 70 python -c'
+    echo '"import jax; print(jax.devices())"`).  rc=0 with TpuDevice ='
+    echo "healthy; rc=124 = backend init blocked for 70s (the wedged-tunnel"
+    echo "signature); anything else = init error (see result column)."
+    echo
+    echo "| timestamp (UTC) | rc | result |"
+    echo "|---|---|---|"
+  } > "$LOG"
+fi
 while true; do
-  ts=$(date -u +%H:%M:%S)
+  ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
   out=$(timeout 70 python -c "import jax; print(jax.devices())" 2>&1)
   rc=$?
-  echo "[$ts] rc=$rc $(echo "$out" | tail -1)"
+  # last line, pipe-safe, bounded — enough to distinguish wedge vs error
+  last=$(echo "$out" | tail -1 | tr -d '|' | cut -c1-120)
+  [ $rc -eq 124 ] && [ -z "$last" ] && last="(timeout: init blocked 70s)"
+  echo "| $ts | $rc | $last |" >> "$LOG"
+  echo "[$ts] rc=$rc $last"
   if [ $rc -eq 0 ] && echo "$out" | grep -q "TpuDevice"; then
+    echo "| $ts | 0 | TUNNEL HEALTHY — run scripts/tpu_first.sh NOW |" >> "$LOG"
     echo "[$ts] TUNNEL HEALTHY"
     break
   fi

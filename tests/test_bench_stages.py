@@ -1,0 +1,33 @@
+"""bench.py stage selection (``--stage``): the CLI surface that lets an
+operator (or scripts/tpu_first.sh on a freshly healed tunnel) run ONE
+stage — e.g. serving_openloop — without paying for the rest.  Parsing
+only; the stages themselves run in the driver bench."""
+
+import pytest
+
+import bench
+
+
+def test_default_runs_every_stage_in_priority_order():
+    assert bench.parse_stages([]) == [
+        "build", "serving", "serving_openloop", "lstm",
+    ]
+
+
+def test_single_stage_selection():
+    assert bench.parse_stages(["--stage", "serving_openloop"]) == [
+        "serving_openloop"
+    ]
+
+
+def test_multi_stage_selection_is_canonically_ordered():
+    # selection order must not reorder execution: build always precedes
+    # lstm regardless of flag order
+    assert bench.parse_stages(
+        ["--stage", "lstm", "--stage", "build"]
+    ) == ["build", "lstm"]
+
+
+def test_unknown_stage_rejected():
+    with pytest.raises(SystemExit):
+        bench.parse_stages(["--stage", "nope"])

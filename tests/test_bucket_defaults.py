@@ -1,0 +1,52 @@
+"""Per-signature fleet chunk-size defaults (``builder/fleet_build.py``):
+recurrent signatures chunk at the LSTM sweep's knee, dense ones at the
+r4 hardware-swept 512 — cheap spec-level tests, no training."""
+
+from gordo_tpu.builder.fleet_build import (
+    DEFAULT_MAX_BUCKET,
+    DEFAULT_MAX_BUCKET_LSTM,
+    default_bucket_size,
+)
+from gordo_tpu.parallel.anomaly import analyze_definition
+from gordo_tpu import serializer
+
+
+def _spec(estimator_cfg):
+    model = serializer.from_definition({
+        "gordo_tpu.anomaly.diff.DiffBasedAnomalyDetector": {
+            "base_estimator": {
+                "gordo_tpu.pipeline.Pipeline": {
+                    "steps": [
+                        "gordo_tpu.ops.scalers.MinMaxScaler",
+                        estimator_cfg,
+                    ]
+                }
+            }
+        }
+    })
+    spec = analyze_definition(model)
+    assert spec is not None
+    return spec
+
+
+def test_dense_signature_gets_512():
+    spec = _spec({
+        "gordo_tpu.models.estimator.AutoEncoder": {
+            "kind": "feedforward_hourglass", "epochs": 1,
+        }
+    })
+    assert default_bucket_size(spec) == DEFAULT_MAX_BUCKET == 512
+
+
+def test_lstm_signature_gets_the_swept_default():
+    spec = _spec({
+        "gordo_tpu.models.estimator.LSTMAutoEncoder": {
+            "kind": "lstm_hourglass", "lookback_window": 12, "epochs": 1,
+        }
+    })
+    assert default_bucket_size(spec) == DEFAULT_MAX_BUCKET_LSTM
+    assert DEFAULT_MAX_BUCKET_LSTM < DEFAULT_MAX_BUCKET
+
+
+def test_unknown_spec_degrades_to_dense_default():
+    assert default_bucket_size(object()) == DEFAULT_MAX_BUCKET

@@ -1,0 +1,366 @@
+"""Unit tests for the continuous-drain adaptive coalescer
+(``serve/coalesce.py``) against fake fleet scorers — the batching POLICY
+(drain cadence, knee cap, stand-down, off-thread assembly) isolated from
+real device dispatch, which the server integration tests cover."""
+
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from gordo_tpu.serve.coalesce import CoalescingScorer, estimate_knee, stats
+
+
+class FakeFleet:
+    """Minimal FleetScorer stand-in: every machine 'stacked', score_all
+    returns a result derived from each machine's own input (so a swapped
+    result is detectable), with a configurable service-time sleep."""
+
+    def __init__(self, names, service_s=0.0):
+        self.machine_bucket = {n: (0, i) for i, n in enumerate(names)}
+        self.models = {n: object() for n in names}
+        self.service_s = service_s
+        self.batch_sizes = []
+        self._lock = threading.Lock()
+
+    def score_all(self, X_by):
+        with self._lock:
+            self.batch_sizes.append(len(X_by))
+        if self.service_s:
+            time.sleep(self.service_s)
+        return {
+            n: {"model-output": np.asarray(X) * 2.0}
+            for n, X in X_by.items()
+        }
+
+
+class FakeDispatchFleet(FakeFleet):
+    """A fleet exposing the dispatch_all/assemble split; assemble records
+    which thread ran it (the drain thread must never be it)."""
+
+    def __init__(self, names, service_s=0.0):
+        super().__init__(names, service_s)
+        self.assemble_threads = []
+
+    def dispatch_all(self, X_by):
+        with self._lock:
+            self.batch_sizes.append(len(X_by))
+        if self.service_s:
+            time.sleep(self.service_s)
+        fleet = self
+
+        class _Pending:
+            def assemble(self):
+                with fleet._lock:
+                    fleet.assemble_threads.append(
+                        threading.current_thread().name
+                    )
+                return {
+                    n: {"model-output": np.asarray(X) * 2.0}
+                    for n, X in X_by.items()
+                }
+
+        return _Pending()
+
+
+def _mk(fleet, **kw):
+    kw.setdefault("max_wait_s", 0.0)
+    return CoalescingScorer(lambda: fleet, **kw)
+
+
+def test_continuous_drain_ignores_the_window():
+    """A queue holding >=2 requests dispatches IMMEDIATELY — with the r5
+    windowed drain a huge max_wait_s would stall every batch; now it only
+    bounds the single-rider grace (inflight==0 here, so not even that)."""
+    names = [f"m-{i:02d}" for i in range(8)]
+    fleet = FakeFleet(names, service_s=0.02)
+    co = _mk(fleet, max_wait_s=30.0)  # would deadlock the old design
+    try:
+        t0 = time.monotonic()
+        futs = [co.submit(n, np.full((4, 2), i, np.float32))
+                for i, n in enumerate(names)]
+        for i, fut in enumerate(futs):
+            out = fut.result(timeout=5)
+            np.testing.assert_allclose(
+                out["model-output"], np.full((4, 2), 2.0 * i)
+            )
+        assert time.monotonic() - t0 < 5.0
+        # burst coalesced: strictly fewer dispatches than requests
+        assert co.n_dispatches < len(names)
+        assert co.n_requests == len(names)
+    finally:
+        co.close()
+
+
+def test_knee_cap_bounds_every_dispatch():
+    names = [f"k-{i:02d}" for i in range(32)]
+    fleet = FakeFleet(names, service_s=0.01)
+    co = _mk(fleet, knee_batch=4)
+    try:
+        futs = [co.submit(n, np.ones((2, 2), np.float32)) for n in names]
+        for fut in futs:
+            fut.result(timeout=10)
+        assert max(fleet.batch_sizes) <= 4
+        assert co.batch_cap == 4
+        assert stats(co)["batch_cap"] == 4
+    finally:
+        co.close()
+
+
+def test_standdown_triggers_and_recovers():
+    """When queue wait runs away from service time the coalescer stands
+    down (should_coalesce -> False) for the cooldown, then resumes."""
+    names = [f"s-{i:02d}" for i in range(4)]
+    fleet = FakeFleet(names, service_s=0.005)
+    co = _mk(
+        fleet,
+        min_concurrency=1,
+        standdown_ratio=1e-6,  # any measurable wait triggers
+        standdown_cooldown_s=0.3,
+        standdown_max_s=0.3,  # no escalation: recovery timing stays fixed
+        signal_window=16,
+    )
+    try:
+        # several sequential rounds so >=4 service samples accumulate
+        for _ in range(6):
+            futs = [co.submit(n, np.ones((2, 2), np.float32))
+                    for n in names]
+            for fut in futs:
+                fut.result(timeout=5)
+        assert co.n_standdowns >= 1
+        assert co.standing_down
+        co.inflight = 5
+        assert not co.should_coalesce()  # standing down: route direct
+        assert stats(co)["standing_down"]
+
+        time.sleep(0.35)  # cooldown expires -> coalescing resumes
+        assert not co.standing_down
+        assert co.should_coalesce()
+    finally:
+        co.close()
+
+
+def test_standdown_cooldown_escalates_then_resets():
+    """Consecutive stand-downs double the cooldown (bounded); a healthy
+    evaluation resets the escalation — a structurally-losing regime must
+    converge to ~all-direct instead of thrashing losing re-probes."""
+    co = _mk(FakeFleet(["x"]), standdown_ratio=1e9,
+             standdown_cooldown_s=0.1, standdown_max_s=0.4,
+             signal_window=16)
+    try:
+        # prime 4 service samples through HEALTHY evaluations (huge ratio)
+        for _ in range(4):
+            co._note_dispatch_signal([1e-9] * 4, 0.001)
+        assert co.n_standdowns == 0
+        # each call below adds exactly the threshold of waits -> exactly
+        # one evaluation -> one trigger; cooldown must double, bounded
+        co.standdown_ratio = 1e-6
+        for i, expect_cd in enumerate((0.1, 0.2, 0.4, 0.4)):
+            t0 = time.monotonic()
+            co._note_dispatch_signal([0.05] * 4, 0.001)
+            assert co.n_standdowns == i + 1
+            delta = co._standdown_until - t0
+            assert expect_cd - 0.02 <= delta <= expect_cd + 0.05, (i, delta)
+        # healthy evaluation resets the escalation
+        co.standdown_ratio = 1e9
+        co._note_dispatch_signal([1e-9] * 4, 0.001)
+        assert co._standdown_streak == 0
+    finally:
+        co.close()
+
+
+def test_queue_backpressure_bypasses_when_saturated():
+    """Once the queue holds 2 knee-capped dispatches' worth, new arrivals
+    must route direct (a rider there would wait >=2 service times for no
+    gain) — and coalescing resumes as the queue drains."""
+    names = [f"q-{i}" for i in range(8)]
+    gate = threading.Event()
+
+    class BlockingFleet(FakeFleet):
+        def score_all(self, X_by):
+            gate.wait(5)
+            return super().score_all(X_by)
+
+    fleet = BlockingFleet(names)
+    co = _mk(fleet, knee_batch=1, min_concurrency=1)
+    try:
+        co.inflight = 4
+        futs = [co.submit(n, np.ones((2, 2), np.float32))
+                for n in names[:4]]
+        # drain thread holds one request inside the blocked dispatch; the
+        # other three sit queued >= 2 * batch_cap(=1)
+        deadline = time.monotonic() + 2
+        while len(co._queue) < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert len(co._queue) >= 2
+        assert not co.should_coalesce()
+        assert co.n_queue_full >= 1
+        assert stats(co)["queue_full_bypassed"] >= 1
+
+        gate.set()
+        for fut in futs:
+            fut.result(timeout=5)
+        deadline = time.monotonic() + 2
+        while co._queue and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert co.should_coalesce()  # drained queue admits riders again
+    finally:
+        co.close()
+
+
+def test_healthy_load_never_stands_down():
+    """Waits comparable to service time must NOT trip the stand-down —
+    the signal fires on runaway queues, not on normal batching."""
+    names = [f"h-{i:02d}" for i in range(4)]
+    fleet = FakeFleet(names, service_s=0.02)
+    co = _mk(fleet, min_concurrency=1, standdown_ratio=50.0,
+             signal_window=4)
+    try:
+        for _ in range(6):
+            futs = [co.submit(n, np.ones((2, 2), np.float32))
+                    for n in names]
+            for fut in futs:
+                fut.result(timeout=5)
+        assert co.n_standdowns == 0
+        assert not co.standing_down
+    finally:
+        co.close()
+
+
+def test_assembly_runs_off_the_drain_thread_with_correct_results():
+    """dispatch_all's deferred assembly must run on the finish pool (the
+    drain thread is gathering the next batch) and every future must get
+    the result derived from ITS OWN input — no cross-request mixups."""
+    names = [f"d-{i:02d}" for i in range(16)]
+    fleet = FakeDispatchFleet(names, service_s=0.005)
+    co = _mk(fleet)
+    try:
+        futs = {}
+        for i, n in enumerate(names):
+            futs[n] = (i, co.submit(n, np.full((3, 2), i, np.float32)))
+        for n, (i, fut) in futs.items():
+            out = fut.result(timeout=5)
+            np.testing.assert_allclose(
+                out["model-output"], np.full((3, 2), 2.0 * i)
+            )
+        assert fleet.assemble_threads, "dispatch_all path not exercised"
+        for tname in fleet.assemble_threads:
+            assert tname.startswith("gordo-coalesce-fin"), tname
+            assert tname != "gordo-coalescer"
+    finally:
+        co.close()
+
+
+def test_estimate_knee_finds_the_amortization_cliff():
+    """Service time flat to batch=8, linear past it -> throughput stops
+    improving at 8, so the sweep must cap there."""
+
+    class KneeFleet:
+        def __init__(self):
+            self.buckets = [SimpleNamespace(
+                names=[f"b-{i:02d}" for i in range(32)],
+                n_features=3, lookback=0,
+            )]
+
+        def score_all(self, X_by):
+            b = len(X_by)
+            # flat to 8, then a 2x-per-doubling cliff: sleep-timer noise
+            # under CPU contention cannot blur the knee
+            time.sleep(0.004 if b <= 8 else 0.008 * b / 8)
+            return {n: {} for n in X_by}
+
+    est = estimate_knee(KneeFleet(), rows=8, max_batch=32)
+    assert est["knee"] == 8
+    # flat service to the knee: 8 requests cost ~1 single-dispatch time
+    assert est["amortization"] > 4
+
+
+def test_estimate_knee_no_buckets_is_none():
+    assert estimate_knee(SimpleNamespace(buckets=[]), rows=8) is None
+    co = _mk(FakeFleet(["x"]))
+    try:
+        # FakeFleet has no .buckets -> estimation degrades to None and the
+        # cap stays at the conservative pre-knee bound
+        assert co.ensure_knee() is None
+        assert co.batch_cap == min(co.max_batch, co.PRE_KNEE_CAP)
+    finally:
+        co.close()
+
+
+def test_ensure_knee_sets_batch_cap():
+    class KneeFleet(FakeFleet):
+        def __init__(self, names):
+            super().__init__(names)
+            self.buckets = [SimpleNamespace(
+                names=list(names), n_features=2, lookback=0,
+            )]
+
+        def score_all(self, X_by):
+            b = len(X_by)
+            time.sleep(0.003 if b <= 4 else 0.006 * b / 4)
+            return super().score_all(X_by)
+
+    fleet = KneeFleet([f"e-{i:02d}" for i in range(16)])
+    co = _mk(fleet)
+    try:
+        assert co.ensure_knee(rows=4) == 4
+        assert co.batch_cap == 4
+        assert stats(co)["knee_estimated"] == 4
+        # idempotent: a second call doesn't re-sweep
+        n_calls = len(fleet.batch_sizes)
+        assert co.ensure_knee(rows=4) == 4
+        assert len(fleet.batch_sizes) == n_calls
+    finally:
+        co.close()
+
+
+def test_no_amortization_disables_coalescing():
+    """Service time linear in batch size (the CPU compute-bound regime):
+    sharing a dispatch saves nothing, so the sweep must DISABLE
+    coalescing outright instead of batching at a size that can't pay."""
+
+    class LinearFleet(FakeFleet):
+        def __init__(self, names):
+            super().__init__(names)
+            self.buckets = [SimpleNamespace(
+                names=list(names), n_features=2, lookback=0,
+            )]
+
+        def score_all(self, X_by):
+            time.sleep(0.003 * len(X_by))
+            return super().score_all(X_by)
+
+    co = _mk(LinearFleet([f"l-{i}" for i in range(8)]), min_concurrency=1)
+    try:
+        assert co.ensure_knee(rows=4) is None
+        assert co._knee_no_gain
+        co.inflight = 64
+        assert not co.should_coalesce()  # permanently out of the way
+        assert stats(co)["knee_no_gain"]
+        # an explicit knee_batch is the operator escape hatch: no sweep,
+        # no auto-disable
+        co2 = _mk(LinearFleet(["a", "b"]), min_concurrency=1, knee_batch=2)
+        try:
+            co2.inflight = 2
+            assert co2.should_coalesce()
+        finally:
+            co2.close()
+    finally:
+        co.close()
+
+
+def test_bypass_counting_and_stats_shape():
+    co = _mk(FakeFleet(["m"]), min_concurrency=2)
+    try:
+        co.inflight = 1
+        assert not co.should_coalesce()
+        co.inflight = 2
+        assert co.should_coalesce()
+        s = stats(co)
+        assert s["enabled"] and s["bypassed_requests"] == 1
+        assert s["standdowns"] == 0 and s["knee_batch"] is None
+    finally:
+        co.close()
+    assert stats(None) == {"enabled": False}

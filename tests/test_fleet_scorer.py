@@ -137,6 +137,67 @@ class TestFleetScorer:
             )
 
 
+def test_dispatch_all_assemble_matches_score_all(models):
+    """The dispatch/assemble split (the coalescer's finish-pool contract)
+    must produce byte-identical results to score_all — on another thread,
+    for BOTH the gathered-subset and the full-bucket dispatch paths, and
+    for mixed valid/invalid machine sets."""
+    import threading
+
+    scorer = FleetScorer.from_models(models[0])
+    rng = np.random.default_rng(11)
+    names = sorted(models[0])
+    cases = {
+        "subset": {names[0]: rng.standard_normal((40, 3)).astype(np.float32)},
+        "full": {
+            n: rng.standard_normal((40 + 3 * i, 3)).astype(np.float32)
+            for i, n in enumerate(names)
+        },
+        "mixed": {
+            names[0]: rng.standard_normal((40, 3)).astype(np.float32),
+            names[1]: rng.standard_normal((40, 2)).astype(np.float32),  # bad width
+        },
+    }
+    for label, X_by in cases.items():
+        expected = scorer.score_all(X_by)
+        pending = scorer.dispatch_all(X_by)
+        box = {}
+
+        def worker():
+            box["out"] = pending.assemble()
+            box["thread_ok"] = threading.current_thread().name == "asm"
+
+        t = threading.Thread(target=worker, name="asm")
+        t.start()
+        t.join(timeout=30)
+        assert box.get("thread_ok"), label
+        out = box["out"]
+        assert sorted(out) == sorted(expected), label
+        for n in expected:
+            for key, val in expected[n].items():
+                if isinstance(val, np.ndarray):
+                    np.testing.assert_array_equal(
+                        out[n][key], val, err_msg=f"{label}/{n}/{key}"
+                    )
+                else:
+                    assert out[n][key] == val, (label, n, key)
+        # assemble is drain-once: a second call returns the same dict
+        # without re-slicing
+        assert pending.assemble() is out
+
+
+def test_estimate_knee_against_real_dispatch_paths(models):
+    """The coalescer's knee sweep must run against the REAL fleet scorer —
+    gathered-subset dispatches below the bucket size (1, 2) and the full
+    stacked program at it (4) — and land on a valid pow2 cap."""
+    from gordo_tpu.serve.coalesce import estimate_knee
+
+    scorer = FleetScorer.from_models(models[0])
+    est = estimate_knee(scorer, rows=32, max_batch=4)
+    assert est["knee"] in (1, 2, 4)
+    assert est["amortization"] > 0
+
+
 def test_bulk_route(models):
     model_dir = models[1]
 

@@ -541,6 +541,33 @@ def test_replay_bench_smoke(model_dir):
             assert out["n_machines"] == 2
 
 
+def test_replay_openloop_mode(model_dir):
+    """Open-loop replay fires requests on a fixed arrival schedule and
+    reports p50/p99 measured from the SCHEDULED start — the SLO-grade
+    latency mode; the full protocol helper reports per-fraction points."""
+    from gordo_tpu.serve.replay import openloop_bench, replay_bench
+
+    collection = ModelCollection.from_directory(model_dir, project="testproj")
+    out = replay_bench(
+        collection, mode="single", wire="json", n_rounds=2, rows=64,
+        arrival_rate_hz=40.0, openloop_duration_s=0.5,
+    )
+    assert out["open_loop"] and out["arrival_rate_hz"] == 40.0
+    assert out["n_requests"] >= 20  # floor: enough samples for a p99
+    assert out["latency_n"] == out["n_requests"]
+    assert out["latency_p99_ms"] >= out["latency_p50_ms"] > 0
+
+    proto = openloop_bench(
+        collection, mode="single", wire="json", rows=64, sat_rounds=2,
+        fractions=(0.5, 0.8), duration_s=0.5,
+    )
+    assert proto["saturation_requests_per_sec"] > 0
+    assert sorted(proto["points"]) == ["0.5x", "0.8x"]
+    for point in proto["points"].values():
+        assert point["latency_p99_ms"] >= point["latency_p50_ms"] > 0
+        assert point["latency_n"] >= 20
+
+
 def test_coalesced_requests_match_direct_path(model_dir):
     """serve/coalesce.py: concurrent single-machine anomaly requests ride
     one stacked dispatch and must return the same scores as the
@@ -579,7 +606,7 @@ def test_coalesced_requests_match_direct_path(model_dir):
         # bypass has its own test)
         client = TestClient(TestServer(
             build_app(collection, coalesce_window_ms=coalesce_ms,
-                      coalesce_min_concurrency=1)
+                      coalesce_min_concurrency=1, coalesce_knee_batch=8)
         ))
         await client.start_server()
         try:
@@ -602,6 +629,47 @@ def test_coalesced_requests_match_direct_path(model_dir):
         )
 
 
+def test_coalescer_knee_cap_over_real_dispatches(model_dir):
+    """An explicit knee cap bounds every stacked dispatch through the
+    real server route: a burst wider than the cap splits into capped
+    rounds instead of one mega-batch (stats must show it)."""
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    X = rng.standard_normal((40, 3)).astype(np.float32).tolist()
+
+    async def run():
+        collection = ModelCollection.from_directory(
+            model_dir, project="testproj"
+        )
+        client = TestClient(TestServer(
+            build_app(collection, coalesce_window_ms=5.0,
+                      coalesce_min_concurrency=1, coalesce_knee_batch=1)
+        ))
+        await client.start_server()
+        try:
+            async def one(name):
+                resp = await client.post(
+                    f"/gordo/v0/testproj/{name}/anomaly/prediction",
+                    json={"X": X},
+                )
+                assert resp.status == 200, await resp.text()
+
+            await asyncio.gather(
+                *(one(n) for n in ["machine-a", "machine-b"] * 3)
+            )
+            idx = await client.get("/gordo/v0/testproj/")
+            return (await idx.json())["coalescer"]
+        finally:
+            await client.close()
+
+    st = asyncio.run(run())
+    assert st["batch_cap"] == 1 and st["knee_batch"] == 1
+    # every request rode its own capped dispatch
+    assert st["dispatches"] == st["requests"] > 0
+    assert st["mean_batch"] == 1.0
+
+
 def test_coalescer_adaptive_bypass(model_dir):
     """Below ``coalesce_min_concurrency`` in-flight requests the route
     dispatches directly (no window wait, no coalescer dispatch); a
@@ -618,7 +686,7 @@ def test_coalescer_adaptive_bypass(model_dir):
         )
         client = TestClient(TestServer(
             build_app(collection, coalesce_window_ms=5.0,
-                      coalesce_min_concurrency=2)
+                      coalesce_min_concurrency=2, coalesce_knee_batch=8)
         ))
         await client.start_server()
         try:
@@ -687,7 +755,7 @@ def test_short_rows_are_400_on_both_paths(model_dir, tmp_path):
         )
         client = TestClient(TestServer(
             build_app(collection, coalesce_window_ms=coalesce_ms,
-                      coalesce_min_concurrency=1)
+                      coalesce_min_concurrency=1, coalesce_knee_batch=8)
         ))
         await client.start_server()
         try:
@@ -773,7 +841,7 @@ def test_coalescer_routes_fallback_machines_off_worker(model_dir, tmp_path):
         assert "machine-a" in fs.machine_bucket
         client = TestClient(TestServer(
             build_app(collection, coalesce_window_ms=5.0,
-                      coalesce_min_concurrency=1)
+                      coalesce_min_concurrency=1, coalesce_knee_batch=8)
         ))
         await client.start_server()
         try:

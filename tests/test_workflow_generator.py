@@ -56,6 +56,64 @@ def test_build_plan_respects_max_bucket_size():
     assert all(b["n_machines"] == 1 for b in plan["buckets"])
 
 
+def _ragged_project(n_filtered=3, n_plain=2):
+    """A bucket whose configs predict multiple distinct train lengths:
+    row-filtered machines (each an unpredictable length) riding with
+    uniform-window plain ones."""
+    return {
+        "machines": [
+            {"name": f"rg-f-{i}", "dataset": {
+                "type": "RandomDataset", "tags": ["t1", "t2"],
+                "train_start_date": "2017-01-01T00:00:00Z",
+                "train_end_date": "2017-01-02T00:00:00Z",
+                "row_filter": f"`t1` > 0.{i}"}}
+            for i in range(n_filtered)
+        ] + [
+            {"name": f"rg-p-{i}", "dataset": {
+                "type": "RandomDataset", "tags": ["t1", "t2"],
+                "train_start_date": "2017-01-01T00:00:00Z",
+                "train_end_date": "2017-01-02T00:00:00Z"}}
+            for i in range(n_plain)
+        ],
+    }
+
+
+def test_build_plan_warns_on_predicted_ragged_compiles():
+    """Neither align_lengths nor pad_lengths + length-diverse configs →
+    the plan must carry the estimated compile bill (ADVICE r5 item 5,
+    warning-only slice: explicit, not silent)."""
+    plan = build_plan(NormalizedConfig(_ragged_project(), "rgproj"))
+    warning = plan["ragged_compile_warning"]
+    # 3 row-filtered (one predicted length each) + 1 shared plain window
+    # = 4 predicted lengths in 1 bucket → 3 compiles beyond the floor
+    assert warning["estimated_distinct_lengths"] == 4
+    assert warning["estimated_extra_compiles"] == 3
+    assert warning["estimated_extra_compile_seconds"] > 0
+    assert "align_lengths" in warning["hint"]
+
+
+def test_build_plan_warning_silenced_by_length_strategy():
+    cfg = NormalizedConfig(_ragged_project(), "rgproj")
+    aligned = build_plan(cfg, align_lengths=256)
+    assert "ragged_compile_warning" not in aligned
+    assert aligned["align_lengths"] == 256
+    padded = build_plan(cfg, pad_lengths=128)
+    assert "ragged_compile_warning" not in padded
+    assert padded["pad_lengths"] == 128
+    # pad_lengths is part of the planned cache identity: keys must differ
+    # from an exact-mode plan's (they'd never match the registry entries
+    # a padded build writes)
+    exact = build_plan(cfg)
+    bucket_p = padded["buckets"][0]["cache_keys"]
+    bucket_e = exact["buckets"][0]["cache_keys"]
+    assert all(bucket_p[m] != bucket_e[m] for m in bucket_p)
+
+
+def test_build_plan_uniform_project_has_no_warning():
+    plan = build_plan(_config())
+    assert "ragged_compile_warning" not in plan
+
+
 def test_generate_workflow_documents():
     docs = generate_workflow(_config())
     kinds = [d["kind"] for d in docs]
