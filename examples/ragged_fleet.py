@@ -1,0 +1,158 @@
+"""Worked example: a RAGGED project end to end.
+
+Raggedness — machines whose train windows/filters produce different row
+counts — is the production norm, and exact-parity fleet builds pay one
+XLA compile per distinct row count.  This example walks the intended
+workflow:
+
+1. plan the project and read the predicted ragged compile bill;
+2. build with ``pad_lengths`` (zero data loss) so the ragged bucket
+   collapses onto one padded program per aligned length;
+3. emit the Argo Workflow document a cluster would run;
+4. serve the artifacts and bulk-score every machine through the client's
+   stacked bulk route.
+
+Run:  python examples/ragged_fleet.py
+(CI runs this in the slow lane — tests/test_examples.py.)
+"""
+
+import asyncio
+import tempfile
+
+import numpy as np
+import yaml
+
+from gordo_tpu import serializer
+from gordo_tpu.builder.fleet_build import build_project
+from gordo_tpu.workflow import NormalizedConfig, build_plan
+from gordo_tpu.workflow.generator import generate_argo_workflow
+
+# four machines sharing one model signature but with three DISTINCT train
+# lengths (staggered end dates at 10-minute resolution): a ragged bucket
+PROJECT = {
+    "machines": [
+        {
+            "name": f"ragged-{i}",
+            "dataset": {
+                "type": "RandomDataset",
+                "tags": [f"rag-tag-{j}" for j in range(3)],
+                "train_start_date": "2017-12-25T06:00:00Z",
+                "train_end_date": end,
+            },
+        }
+        for i, end in enumerate([
+            "2017-12-26T02:10:00Z",   # 122 rows
+            "2017-12-26T03:10:00Z",   # 128 rows
+            "2017-12-26T04:10:00Z",   # 134 rows
+            "2017-12-26T04:10:00Z",   # 134 rows (shares a length)
+        ])
+    ],
+    "globals": {
+        "model": {
+            "gordo_tpu.anomaly.diff.DiffBasedAnomalyDetector": {
+                "base_estimator": {
+                    "gordo_tpu.pipeline.Pipeline": {
+                        "steps": [
+                            "gordo_tpu.ops.scalers.MinMaxScaler",
+                            {
+                                "gordo_tpu.models.estimator.AutoEncoder": {
+                                    "kind": "feedforward_hourglass",
+                                    "epochs": 2,
+                                    "batch_size": 64,
+                                }
+                            },
+                        ]
+                    }
+                }
+            }
+        }
+    },
+}
+
+#: rows 122/128/134 all pad UP to 144 — one program instead of three —
+#: and every machine still reaches the last CV test block (see
+#: docs/fleet.md "pad_lengths")
+PAD = 72
+
+
+def main():
+    out_dir = tempfile.mkdtemp(prefix="gordo-ragged-")
+    config = NormalizedConfig(PROJECT, "ragged-demo")
+
+    # 1. Plan first: the dry run is where the ragged bill should surface
+    plan = build_plan(config)
+    warning = plan.get("ragged_compile_warning")
+    assert warning, "a ragged project must carry the compile-bill warning"
+    print(
+        f"plan: {plan['n_machines']} machines, {plan['n_buckets']} "
+        f"bucket(s); predicted ~{warning['estimated_distinct_lengths']} "
+        f"distinct lengths ≈ {warning['estimated_extra_compile_seconds']}s "
+        "of extra compiles in exact mode"
+    )
+
+    # 2. Build with pad_lengths: zero rows dropped, ragged lengths
+    # collapse onto one padded program (build_project would also
+    # auto-select padding past its compile budget — see --no-auto-pad)
+    result = build_project(config.machines, out_dir, pad_lengths=PAD)
+    assert not result.failed, result.failed
+    print("built:", result.summary())
+    meta = serializer.load_metadata(result.artifacts["ragged-0"])
+    print(
+        "ragged-0 artifact: pad_lengths =", meta["model"].get("pad_lengths"),
+        "| rows_trained =", meta["model"].get("rows_trained"),
+    )
+
+    # 3. The Argo document a cluster would run (one DAG task per fleet
+    # chunk; gordo workflow generate --format argo renders the same)
+    argo = generate_argo_workflow(config)
+    tasks = argo["spec"]["templates"][0]["dag"]["tasks"]
+    print(
+        f"argo workflow: {len(tasks)} build task(s); first runs:",
+        " ".join(argo["spec"]["templates"][1]["container"]["args"][:4]),
+    )
+    print("---- argo yaml (head) ----")
+    print("\n".join(yaml.safe_dump(argo, sort_keys=False).splitlines()[:8]))
+
+    # 4. Serve + client BULK scoring: one stacked dispatch per chunk
+    # across all machines, not one HTTP round-trip per machine
+    from aiohttp import web
+
+    from gordo_tpu.client import Client
+    from gordo_tpu.serve import ModelCollection, build_app
+
+    async def serve_and_bulk_score():
+        runner = web.AppRunner(
+            build_app(
+                ModelCollection.from_directory(out_dir, project="ragged-demo")
+            )
+        )
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = runner.addresses[0][1]
+        try:
+            client = Client("ragged-demo", port=port, use_bulk=True)
+            results = await client.predict_async(
+                "2017-12-28T06:00:00Z", "2017-12-29T06:00:00Z"
+            )
+            for res in results:
+                rows = 0 if res.predictions is None else len(res.predictions)
+                scores = (
+                    res.predictions[("total-anomaly-score", "")]
+                    if res.predictions is not None else None
+                )
+                print(
+                    res.name, "->", rows, "rows, mean total score",
+                    None if scores is None else round(float(np.mean(scores)), 4),
+                    "(ok)" if res.ok else res.error_messages,
+                )
+            assert all(r.ok for r in results)
+        finally:
+            await runner.cleanup()
+
+    asyncio.run(serve_and_bulk_score())
+    print("ragged_fleet example: OK")
+
+
+if __name__ == "__main__":
+    main()

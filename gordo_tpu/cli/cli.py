@@ -150,18 +150,35 @@ def build(output_dir, name, model_config, data_config, metadata,
               help="Comma-separated machine names: build only this subset "
                    "of the project (partial rebuilds; the unit of work in "
                    "the generated Argo DAG).")
+@click.option("--multihost", default=None, envvar="GORDO_MULTIHOST",
+              help="'coordinator:port,N,pid': run as process pid of an "
+                   "N-process multi-host build (jax.distributed; process 0 "
+                   "hosts the coordination service). Each process builds "
+                   "its deterministic shard of the machine list into the "
+                   "shared --output-dir/--model-register-dir. Env "
+                   "equivalents: GORDO_COORDINATOR + GORDO_NUM_PROCESSES + "
+                   "GORDO_PROCESS_ID (what the generated Indexed-Job "
+                   "manifest sets).")
+@click.option("--barrier-timeout", default=None, type=click.FloatRange(min=1),
+              help="Seconds before a multi-host barrier declares a peer "
+                   "dead; the survivor exits 75 (EX_TEMPFAIL) with its "
+                   "shard state resumable. Default 600.")
+@click.option("--auto-pad/--no-auto-pad", default=True, show_default=True,
+              help="When neither --align-lengths nor --pad-lengths is set "
+                   "and the config-level estimate predicts a large ragged "
+                   "compile bill, auto-enable --pad-lengths at a computed "
+                   "alignment (loudly logged) instead of paying one XLA "
+                   "compile per distinct row count.")
 @click.option("--replace-cache", is_flag=True)
 def build_project_cmd(machine_config, project_name, output_dir,
                       model_register_dir, max_bucket_size, data_parallel,
                       data_workers, align_lengths, pad_lengths,
-                      machines_filter, replace_cache):
+                      machines_filter, multihost, barrier_timeout, auto_pad,
+                      replace_cache):
     """Build EVERY machine in the project config — homogeneous machines
     train as single mesh-sharded fleet programs (the TPU-native
     replacement for the reference's one-pod-per-machine Argo DAG)."""
-    import jax
-
     from gordo_tpu.builder.fleet_build import build_project
-    from gordo_tpu.parallel.mesh import fleet_mesh
     from gordo_tpu.workflow.config import NormalizedConfig, load_machine_config
 
     config = NormalizedConfig(load_machine_config(machine_config), project_name)
@@ -174,6 +191,32 @@ def build_project_cmd(machine_config, project_name, output_dir,
             raise click.BadParameter(
                 f"--machines names not in the project: {sorted(missing)}"
             )
+
+    # ---- multi-host: one process of an N-process sharded build ----
+    from gordo_tpu.distributed.runtime import DistributedConfig, parse_multihost_spec
+
+    if multihost:
+        try:
+            dist_cfg = parse_multihost_spec(multihost)
+        except ValueError as exc:
+            raise click.BadParameter(str(exc), param_hint="--multihost")
+    else:
+        dist_cfg = DistributedConfig.from_env()
+    if dist_cfg is not None:
+        if barrier_timeout:
+            dist_cfg.barrier_timeout = barrier_timeout
+        _run_multihost_build(
+            dist_cfg, machines, output_dir, model_register_dir,
+            replace_cache, max_bucket_size, data_parallel, data_workers,
+            align_lengths, pad_lengths, auto_pad,
+        )
+        return
+
+    # ---- single host ----
+    import jax
+
+    from gordo_tpu.parallel.mesh import fleet_mesh
+
     devices = jax.devices()
     mesh = (
         fleet_mesh(devices, data_parallel=data_parallel)
@@ -190,8 +233,89 @@ def build_project_cmd(machine_config, project_name, output_dir,
         data_workers=data_workers,
         align_lengths=align_lengths,
         pad_lengths=pad_lengths,
+        auto_pad=auto_pad,
     )
     click.echo(json.dumps(result.summary()))
+    if result.failed:
+        sys.exit(1)
+
+
+def _run_multihost_build(dist_cfg, machines, output_dir, model_register_dir,
+                         replace_cache, max_bucket_size, data_parallel,
+                         data_workers, align_lengths, pad_lengths, auto_pad):
+    """One worker of an N-process build: init jax.distributed, build this
+    process's shard, barrier at the edges.  A barrier timeout (dead peer)
+    exits EXIT_SHARD_RESUMABLE with this shard's state file resumable —
+    `os._exit`, because jax.distributed.shutdown() aborts once a peer is
+    gone (see distributed/runtime.py)."""
+    from gordo_tpu.builder.fleet_build import build_project
+    from gordo_tpu.distributed.partition import (
+        EXIT_SHARD_RESUMABLE,
+        process_shard,
+    )
+    from gordo_tpu.distributed.runtime import BarrierTimeout, DistributedRuntime
+
+    runtime = DistributedRuntime(dist_cfg)
+    runtime.ensure_env()  # before ANY jax backend init
+    runtime.initialize()
+    n_global = runtime.validate_global_mesh()
+    logger.info(
+        "multihost build: process %d/%d, %d global devices, mesh validated",
+        dist_cfg.process_id, dist_cfg.num_processes, n_global,
+    )
+    shard = process_shard(
+        machines, dist_cfg.num_processes, dist_cfg.process_id,
+        output_dir=output_dir,
+    )
+
+    def _resumable_exit(stage: str, exc: Exception, result=None) -> None:
+        if shard.state is not None:
+            if not shard.state.machines:
+                shard.state.start(shard.names)
+            shard.state.mark_resumable(f"{stage}: {exc}")
+        doc = result.summary() if result is not None else {}
+        doc["resumable"] = {
+            "stage": stage,
+            "process_id": dist_cfg.process_id,
+            "error": str(exc).split("\n")[0][:200],
+        }
+        click.echo(json.dumps(doc))
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(EXIT_SHARD_RESUMABLE)
+
+    try:
+        runtime.barrier("pre-build")
+    except BarrierTimeout as exc:
+        _resumable_exit("pre-build", exc)
+    result = build_project(
+        machines,
+        output_dir,
+        model_register_dir=model_register_dir,
+        mesh=runtime.local_mesh(data_parallel),
+        replace_cache=replace_cache,
+        max_bucket_size=max_bucket_size,
+        data_workers=data_workers,
+        align_lengths=align_lengths,
+        pad_lengths=pad_lengths,
+        auto_pad=auto_pad,
+        shard=shard,
+    )
+    try:
+        runtime.barrier("post-build")
+    except BarrierTimeout as exc:
+        # THIS shard may be fully built (its state says so); the exit code
+        # still signals "re-run the job" because fleet-wide completion is
+        # unconfirmed — the re-run cache-hits everything already on disk
+        _resumable_exit("post-build", exc, result)
+    runtime.shutdown()
+    summary = result.summary()
+    summary["multihost"] = {
+        "process_id": dist_cfg.process_id,
+        "num_processes": dist_cfg.num_processes,
+        "global_devices": n_global,
+    }
+    click.echo(json.dumps(summary))
     if result.failed:
         sys.exit(1)
 
@@ -416,9 +540,14 @@ def workflow_group():
                    "an argoproj Workflow DAG (one task per fleet chunk) "
                    "plus the serving manifests — for clusters whose "
                    "tooling consumes Argo documents.")
+@click.option("--multihost", default=None, type=click.IntRange(min=1),
+              help="Emit the builder as an N-process Indexed Job "
+                   "(jax.distributed over N pods, GORDO_* env wiring, "
+                   "deterministic machine shards). Refused when N exceeds "
+                   "the plan's machine-shard count.")
 @click.option("--output-file", type=click.File("w"), default="-")
 def workflow_generate(machine_config, project_name, image, server_replicas,
-                      server_args, fmt, output_file):
+                      server_args, fmt, multihost, output_file):
     """Render the kubernetes manifests + fleet build plan (reference:
     the Argo workflow template render)."""
     from gordo_tpu.workflow import (
@@ -429,10 +558,19 @@ def workflow_generate(machine_config, project_name, image, server_replicas,
     )
 
     config = NormalizedConfig(load_machine_config(machine_config), project_name)
-    docs = generate_workflow(
-        config, image=image, server_replicas=server_replicas,
-        server_args=list(server_args),
-    )
+    if multihost and fmt == "argo":
+        raise click.BadParameter(
+            "--multihost applies to the k8s Indexed-Job builder; the argo "
+            "format's DAG already fans out one task per fleet chunk",
+            param_hint="--multihost",
+        )
+    try:
+        docs = generate_workflow(
+            config, image=image, server_replicas=server_replicas,
+            server_args=list(server_args), multihost=multihost,
+        )
+    except ValueError as exc:
+        raise click.ClickException(str(exc))
     if fmt == "argo":
         from gordo_tpu.workflow.generator import generate_argo_workflow
 

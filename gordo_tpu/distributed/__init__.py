@@ -1,0 +1,40 @@
+"""Multi-host runtime: process-spanning meshes and sharded fleet builds.
+
+The v5e-64 north star is a 16-host slice.  A single-host ``build-project``
+can only drive it as 16 independent jobs; this package turns the fleet
+engine into ONE multi-process program (the pjit-paper / Podracer pattern):
+
+- :mod:`~gordo_tpu.distributed.runtime` — coordinator/worker bring-up
+  around ``jax.distributed.initialize`` (CLI spec or ``GORDO_*`` env
+  vars), global-mesh construction with the ``"models"`` axis spanning
+  hosts, a coordination-service barrier with timeout (worker-death
+  detection), and clean shutdown.
+- :mod:`~gordo_tpu.distributed.partition` — deterministic
+  process-sharding of the machine list (per-signature contiguous
+  slices), plus the per-shard resumable state file.
+- :mod:`~gordo_tpu.distributed.launcher` — fork N local worker
+  processes with per-process virtual CPU devices: the simulated-
+  multiprocess mechanism behind ``scripts/multihost_dryrun.py`` (same
+  idea as the driver's ``dryrun_multichip``, but with REAL cross-process
+  ``jax.distributed`` init).
+"""
+
+from gordo_tpu.distributed.launcher import (  # noqa: F401
+    launch_workers,
+    pick_free_port,
+    wait_all,
+)
+from gordo_tpu.distributed.partition import (  # noqa: F401
+    EXIT_SHARD_RESUMABLE,
+    ProcessShard,
+    ShardState,
+    max_processes,
+    partition_machines,
+    process_shard,
+)
+from gordo_tpu.distributed.runtime import (  # noqa: F401
+    BarrierTimeout,
+    DistributedConfig,
+    DistributedRuntime,
+    parse_multihost_spec,
+)

@@ -1,0 +1,220 @@
+"""Deterministic process-sharding of the machine list.
+
+Every worker process computes the SAME partition from the same project
+config (no coordination round): machines bucket by fleet signature
+exactly as the build plan does, each bucket splits into up to
+``num_processes`` near-equal CONTIGUOUS slices (same-signature machines
+stay grouped so each process still trains them as few stacked programs;
+slicing finer than machine granularity is impossible, which is why the
+workflow emitter refuses ``N > machine count``), and slices deal
+longest-first onto the least-loaded process with index tie-breaks.  The
+result is disjoint, exhaustive, and independent of machine-list order
+(buckets sort by signature, as in the plan; members by name).
+
+Artifact/metadata layout is byte-identical to the single-host path by
+construction: each process runs the ordinary ``build_project`` on its
+shard, and per-machine fleet builds are bit-identical regardless of
+bucket membership (the RNG-parity contract, ``docs/architecture.md``) —
+so which process builds a machine can't change what lands on disk.
+
+Resumability: each shard owns a state file under
+``<output_dir>/.gordo-shards/`` recording its machine list and what
+completed.  A worker killed mid-build leaves ``completed ⊂ machines``
+with status ``running``; survivors notice via barrier timeout, mark
+their state ``resumable``, and exit :data:`EXIT_SHARD_RESUMABLE` — a
+re-run of the same spec re-derives the identical partition and the
+config-hash registry turns every already-built machine into a cache hit,
+so only the dead shard's remainder trains.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+#: exit code of a worker whose shard is incomplete but resumable (a peer
+#: died / barrier timed out).  BSD EX_TEMPFAIL: "retry the same command".
+EXIT_SHARD_RESUMABLE = 75
+
+SHARD_STATE_DIR = ".gordo-shards"
+
+
+def _bucket_slices(machines: Sequence[Any], num_processes: int):
+    """Work units in deterministic order: signature buckets (sorted, as in
+    the build plan), each split into up to ``num_processes`` near-equal
+    contiguous slices of its name-sorted members."""
+    from gordo_tpu.workflow.generator import _fleet_signature
+
+    buckets: Dict[str, List[Any]] = {}
+    for m in machines:
+        buckets.setdefault(_fleet_signature(m), []).append(m)
+    out: List[List[Any]] = []
+    for _, members in sorted(buckets.items()):
+        members = sorted(members, key=lambda m: m.name)
+        n_slices = min(num_processes, len(members))
+        base, rem = divmod(len(members), n_slices)
+        start = 0
+        for i in range(n_slices):
+            size = base + (1 if i < rem else 0)
+            out.append(members[start : start + size])
+            start += size
+    return out
+
+
+def max_processes(machines: Sequence[Any]) -> int:
+    """Largest useful process count: machines are the atoms of the
+    partition, so it is the machine count.  More processes than machines
+    means idle workers that still hold every barrier — the workflow
+    emitter refuses such specs."""
+    return len(machines)
+
+
+def partition_machines(
+    machines: Sequence[Any],
+    num_processes: int,
+) -> List[List[Any]]:
+    """Disjoint, exhaustive, deterministic machine shards — one per process.
+
+    Per-signature contiguous slices deal longest-first onto the
+    least-loaded process (machine count), process index breaking ties.
+    Every process calling this with the same machine list and
+    ``num_processes`` gets the same answer.
+    """
+    if num_processes < 1:
+        raise ValueError(f"num_processes must be >= 1, got {num_processes}")
+    shards: List[List[Any]] = [[] for _ in range(num_processes)]
+    slices = _bucket_slices(machines, num_processes)
+    # stable longest-first: sort key is (-len, first machine name)
+    order = sorted(
+        range(len(slices)),
+        key=lambda i: (-len(slices[i]), slices[i][0].name),
+    )
+    for i in order:
+        target = min(range(num_processes), key=lambda p: (len(shards[p]), p))
+        shards[target].extend(slices[i])
+    return shards
+
+
+def process_shard(
+    machines: Sequence[Any],
+    num_processes: int,
+    process_id: int,
+    output_dir: Optional[str] = None,
+) -> "ProcessShard":
+    """This process's shard of the project (see :func:`partition_machines`)."""
+    if not 0 <= process_id < num_processes:
+        raise ValueError(
+            f"process_id {process_id} outside [0, {num_processes})"
+        )
+    shards = partition_machines(machines, num_processes)
+    return ProcessShard(
+        machines=shards[process_id],
+        process_id=process_id,
+        num_processes=num_processes,
+        state=(
+            ShardState(output_dir, process_id, num_processes)
+            if output_dir
+            else None
+        ),
+    )
+
+
+@dataclass
+class ProcessShard:
+    """One process's slice of the machine list (+ optional state file)."""
+
+    machines: List[Any]
+    process_id: int
+    num_processes: int
+    state: Optional["ShardState"] = None
+
+    @property
+    def names(self) -> List[str]:
+        return [m.name for m in self.machines]
+
+
+@dataclass
+class ShardState:
+    """Per-shard resumable progress, one JSON file per (pid, n).
+
+    Written atomically (tmp + rename) on every transition so a SIGKILL
+    can never leave a torn document; the staleness check is the re-run
+    reading ``completed`` and finding everything already registry-cached.
+    """
+
+    output_dir: str
+    process_id: int
+    num_processes: int
+    machines: List[str] = field(default_factory=list)
+    completed: List[str] = field(default_factory=list)
+    status: str = "pending"  # pending | running | done | resumable
+
+    @property
+    def path(self) -> str:
+        return os.path.join(
+            self.output_dir,
+            SHARD_STATE_DIR,
+            f"shard-{self.process_id:03d}-of-{self.num_processes:03d}.json",
+        )
+
+    def start(self, machine_names: Sequence[str]) -> None:
+        prior = self.load(
+            self.output_dir, self.process_id, self.num_processes
+        )
+        if prior is not None and sorted(prior.machines) == sorted(machine_names):
+            # resuming the same shard: keep the completed history so an
+            # operator (or the dryrun) can see what the re-run skipped
+            self.completed = list(prior.completed)
+        else:
+            self.completed = []
+        self.machines = list(machine_names)
+        self.status = "running"
+        self._write()
+
+    def record(self, machine_name: str) -> None:
+        if machine_name not in self.completed:
+            self.completed.append(machine_name)
+            self._write()
+
+    def finish(self) -> None:
+        self.status = "done"
+        self._write()
+
+    def mark_resumable(self, reason: str = "") -> None:
+        self.status = "resumable"
+        self._write(extra={"reason": reason})
+
+    def _write(self, extra: Optional[Dict[str, Any]] = None) -> None:
+        doc = {
+            "process_id": self.process_id,
+            "num_processes": self.num_processes,
+            "machines": self.machines,
+            "completed": self.completed,
+            "status": self.status,
+            "updated": time.time(),
+        }
+        if extra:
+            doc.update(extra)
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=2)
+        os.replace(tmp, self.path)
+
+    @classmethod
+    def load(
+        cls, output_dir: str, process_id: int, num_processes: int
+    ) -> Optional["ShardState"]:
+        state = cls(output_dir, process_id, num_processes)
+        try:
+            with open(state.path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return None
+        state.machines = list(doc.get("machines", []))
+        state.completed = list(doc.get("completed", []))
+        state.status = doc.get("status", "pending")
+        return state

@@ -16,6 +16,7 @@ matmuls into MXU-sized ones.  Cross-validation folds ride a second vmap axis
 
 from gordo_tpu.parallel.mesh import (
     fleet_mesh,
+    global_fleet_mesh,
     model_sharding,
     replicated_sharding,
 )
@@ -31,6 +32,7 @@ from gordo_tpu.parallel.anomaly import FleetDiffBuilder
 
 __all__ = [
     "fleet_mesh",
+    "global_fleet_mesh",
     "model_sharding",
     "replicated_sharding",
     "FleetFitResult",

@@ -43,6 +43,40 @@ def fleet_mesh(
     return Mesh(grid, (MODEL_AXIS, DATA_AXIS))
 
 
+def global_fleet_mesh(data_parallel: int = 1) -> Mesh:
+    """The canonical mesh over EVERY process's devices — the multi-host
+    form of :func:`fleet_mesh` (``gordo_tpu.distributed.runtime``).
+
+    Devices order by ``(process_index, device id)`` so each host's local
+    devices are CONTIGUOUS along the ``"models"`` axis: a host feeds its
+    shard of a stacked fleet array with one contiguous
+    ``make_array_from_process_local_data`` block, and a per-host slice of
+    the machine list maps onto a per-host slice of the mesh.  Requires a
+    uniform local device count (true of any TPU slice and of the
+    simulated launcher); raises otherwise rather than building a mesh
+    whose process boundaries fall mid-row.
+    """
+    import collections
+
+    devices = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    per_proc = collections.Counter(d.process_index for d in devices)
+    counts = set(per_proc.values())
+    if len(counts) > 1:
+        raise ValueError(
+            "global_fleet_mesh needs a uniform local device count per "
+            f"process, got {dict(per_proc)}"
+        )
+    if data_parallel > 1 and min(counts) % data_parallel != 0:
+        # keep every ("models" row x "data" group) within one host: the
+        # data axis carries grad all-reduces, which should ride ICI, not
+        # straddle the host boundary onto DCN
+        raise ValueError(
+            f"data_parallel={data_parallel} does not divide the per-process "
+            f"device count {min(counts)}; a data group must not span hosts"
+        )
+    return fleet_mesh(devices, data_parallel=data_parallel)
+
+
 def model_sharding(mesh: Mesh, extra_dims: int = 0) -> NamedSharding:
     """Sharding placing a leading ``models`` axis over the mesh fleet axis."""
     return NamedSharding(mesh, P(MODEL_AXIS, *([None] * extra_dims)))

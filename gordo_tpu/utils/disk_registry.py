@@ -42,6 +42,33 @@ def get_value(registry_dir: str, key: str) -> Optional[str]:
         return f.read().strip()
 
 
+def list_keys(registry_dir: str) -> "list[str]":
+    """Every key in the registry, sorted.  Tolerates a missing dir (empty
+    registry) and skips in-flight ``.tmp.<pid>`` files from concurrent
+    writers — multi-host builds share one registry dir."""
+    try:
+        names = os.listdir(registry_dir)
+    except FileNotFoundError:
+        return []
+    return sorted(n for n in names if _KEY_RE.match(n))
+
+
+def merge_registries(source_dirs: "list[str]", dest_dir: str) -> int:
+    """Union per-shard registries into ``dest_dir`` (last writer wins on a
+    duplicate key, which only happens when two shards built the same
+    config — same value either way).  Returns the number of keys written.
+    Used when multi-host shards write host-local registries instead of a
+    shared one; with a shared dir the merge is implicit."""
+    n = 0
+    for src in source_dirs:
+        for key in list_keys(src):
+            value = get_value(src, key)
+            if value is not None:
+                write_key(dest_dir, key, value)
+                n += 1
+    return n
+
+
 def delete_value(registry_dir: str, key: str) -> bool:
     path = _key_path(registry_dir, key)
     if os.path.exists(path):

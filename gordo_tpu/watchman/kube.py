@@ -150,6 +150,12 @@ class KubeTargetDiscovery:
             try:
                 seeded = self._list_urls()
                 with self._watch_lock:
+                    if self._watch_stop is not stop:
+                        # superseded generation (stop_watch join timed out
+                        # while this thread idled in a list call, then a
+                        # new start_watch began): its resync must not
+                        # clobber the live generation's cache
+                        return
                     changed = seeded != self._watch_cache
                     self._watch_cache = dict(seeded)
                 if changed:
@@ -172,6 +178,10 @@ class KubeTargetDiscovery:
                         continue
                     name = svc.metadata.name
                     with self._watch_lock:
+                        if self._watch_stop is not stop:
+                            # a pending event from an abandoned generation
+                            # races the new one's cache: drop it and die
+                            return
                         if self._watch_cache is None:
                             self._watch_cache = {}
                         if etype == "DELETED":
@@ -194,6 +204,8 @@ class KubeTargetDiscovery:
                     "for %.0fs", backoff,
                 )
                 with self._watch_lock:
+                    if self._watch_stop is not stop:
+                        return  # never blank a successor's live cache
                     self._watch_cache = None  # poll path lists directly
                 if stop.wait(backoff):
                     return

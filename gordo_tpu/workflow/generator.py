@@ -39,6 +39,9 @@ API_PREFIX = "/gordo/v0"
 DEFAULT_IMAGE = "gordo-tpu"
 DEFAULT_SERVER_PORT = 5555
 DEFAULT_WATCHMAN_PORT = 5556
+#: jax.distributed coordination-service port on process 0 of a multi-host
+#: builder Job (the conventional jax coordinator port)
+DEFAULT_COORDINATOR_PORT = 8476
 
 
 def unique_tags(machines: List[Machine]) -> List[str]:
@@ -192,6 +195,72 @@ def _labels(project: str, component: str) -> Dict[str, str]:
         "app.kubernetes.io/instance": project,
         "app.kubernetes.io/component": component,
     }
+
+
+def _multihost_builder_docs(
+    project: str,
+    image: str,
+    tpu_resources: Dict[str, Any],
+    num_processes: int,
+) -> List[Dict]:
+    """Indexed builder Job (one pod per process) + the headless Service
+    that gives process 0 a stable coordinator DNS name.
+
+    Env wiring is the ``GORDO_*`` contract of
+    ``gordo_tpu.distributed.runtime``: every pod gets the same
+    ``GORDO_COORDINATOR`` (pod 0's stable hostname) and its own
+    ``GORDO_PROCESS_ID`` from the index kubernetes injects as
+    ``JOB_COMPLETION_INDEX``.  ``gordo build-project`` picks the env
+    contract up with no extra flags, shards the machine list
+    deterministically, and barriers at the build edges — a pod that dies
+    exits its peers with the resumable code, and the Job's retry
+    (``backoffLimit``) re-runs into cache hits plus the dead shard's
+    remainder."""
+    job_name = f"gordo-builder-{project}"
+    svc_name = f"gordo-builder-{project}"
+    job = _builder_job(project, image, tpu_resources)
+    spec = job["spec"]
+    spec["completions"] = num_processes
+    spec["parallelism"] = num_processes
+    spec["completionMode"] = "Indexed"
+    pod_spec = spec["template"]["spec"]
+    # Indexed pods get hostname {job}-{index}; the headless subdomain
+    # makes {job}-0.{svc} resolvable as the coordinator address
+    pod_spec["subdomain"] = svc_name
+    container = pod_spec["containers"][0]
+    container["env"].extend(
+        [
+            {
+                "name": "GORDO_COORDINATOR",
+                "value": (
+                    f"{job_name}-0.{svc_name}:{DEFAULT_COORDINATOR_PORT}"
+                ),
+            },
+            {"name": "GORDO_NUM_PROCESSES", "value": str(num_processes)},
+            # JOB_COMPLETION_INDEX is injected by kubernetes for Indexed
+            # Jobs; dependent-env expansion turns it into the process id
+            {"name": "GORDO_PROCESS_ID", "value": "$(JOB_COMPLETION_INDEX)"},
+        ]
+    )
+    headless = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": svc_name,
+            "labels": _labels(project, "model-builder"),
+        },
+        "spec": {
+            "clusterIP": "None",  # headless: per-pod DNS, no VIP
+            "selector": _labels(project, "model-builder"),
+            "ports": [
+                {
+                    "port": DEFAULT_COORDINATOR_PORT,
+                    "targetPort": DEFAULT_COORDINATOR_PORT,
+                }
+            ],
+        },
+    }
+    return [job, headless]
 
 
 def _builder_job(project: str, image: str, tpu_resources: Dict[str, Any]) -> Dict:
@@ -387,6 +456,7 @@ def generate_workflow(
     tpu_resources: Optional[Dict[str, Any]] = None,
     include_plan: bool = True,
     server_args: Optional[List[str]] = None,
+    multihost: Optional[int] = None,
 ) -> List[Dict[str, Any]]:
     """Project config → list of k8s manifest dicts (+ the build plan as a
     ConfigMap so the cluster state carries the bucketing decision).
@@ -394,15 +464,41 @@ def generate_workflow(
     ``server_args``: extra ``gordo run-server`` flags for the ml-server
     Deployment (e.g. ``["--coalesce-ms", "2"]`` or ``["--model-parallel"]``
     on a slice-backed node pool).
+
+    ``multihost``: emit the builder as an N-process Indexed Job (one pod
+    per process, ``jax.distributed`` wired via ``GORDO_*`` env) instead of
+    a single-pod Job.  Refused when N exceeds the plan's machine-shard
+    count — the extra pods would have empty shards yet still hold every
+    barrier, so the spec is a config error, not a scheduling preference.
     """
     project = config.project_name
     machines = [m.name for m in config.machines]
+    if multihost is not None:
+        if multihost < 1:
+            raise ValueError(f"multihost must be >= 1, got {multihost}")
+        from gordo_tpu.distributed.partition import max_processes
+
+        shard_count = max_processes(config.machines)
+        if multihost > shard_count:
+            raise ValueError(
+                f"--multihost {multihost} exceeds the plan's machine-shard "
+                f"count ({shard_count}): machines are the atoms of the "
+                f"process partition, so processes beyond that would idle "
+                f"while holding every barrier. Use --multihost <= "
+                f"{shard_count}, or grow the project."
+            )
     tpu_resources = tpu_resources or {
         "limits": {"google.com/tpu": 8},
         "requests": {"google.com/tpu": 8},
     }
+    if multihost is not None and multihost > 1:
+        builder_docs = _multihost_builder_docs(
+            project, image, tpu_resources, multihost
+        )
+    else:
+        builder_docs = [_builder_job(project, image, tpu_resources)]
     docs: List[Dict[str, Any]] = [
-        _builder_job(project, image, tpu_resources),
+        *builder_docs,
         _server_deployment(project, image, server_replicas, server_args),
         _service(project, "ml-server", DEFAULT_SERVER_PORT),
         _watchman_deployment(project, image, machines),

@@ -8,7 +8,6 @@ import time
 from types import SimpleNamespace
 
 import numpy as np
-import pytest
 
 from gordo_tpu.serve.coalesce import CoalescingScorer, estimate_knee, stats
 

@@ -1,0 +1,294 @@
+"""Multi-host runtime tests.
+
+Fast lane: config/spec parsing, deterministic partitioning, shard-state
+resumability, launcher env construction — pure host-side logic, no
+``jax.distributed`` init (a second init in the shared test process would
+poison every later test).  The REAL cross-process path — coordinator
+bring-up, process-spanning mesh, barriers, worker death — runs in the
+slow lane via ``scripts/multihost_dryrun.py``, which forks fresh
+processes exactly like production does.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from gordo_tpu.distributed.launcher import pick_free_port, worker_env
+from gordo_tpu.distributed.partition import (
+    EXIT_SHARD_RESUMABLE,
+    ShardState,
+    max_processes,
+    partition_machines,
+    process_shard,
+)
+from gordo_tpu.distributed.runtime import (
+    DistributedConfig,
+    parse_multihost_spec,
+)
+from gordo_tpu.workflow.config import Machine
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _machine(name, tags=("a", "b", "c"), model=None):
+    cfg = {
+        "name": name,
+        "dataset": {"type": "RandomDataset", "tag_list": list(tags)},
+    }
+    if model:
+        cfg["model"] = model
+    return Machine.from_config(cfg)
+
+
+# ---------------------------------------------------------------------------
+# spec / env parsing
+# ---------------------------------------------------------------------------
+
+class TestSpecParsing:
+    def test_cli_spec_roundtrip(self):
+        cfg = parse_multihost_spec("10.0.0.2:8476,16,3")
+        assert cfg.coordinator == "10.0.0.2:8476"
+        assert cfg.num_processes == 16
+        assert cfg.process_id == 3
+
+    @pytest.mark.parametrize("bad", [
+        "10.0.0.2:8476,16",        # missing pid
+        "10.0.0.2,16,3",           # no port
+        "10.0.0.2:8476,sixteen,3",  # non-integer N
+        "10.0.0.2:8476,16,16",     # pid out of range
+        "10.0.0.2:8476,0,0",       # zero processes
+    ])
+    def test_bad_specs_raise(self, bad):
+        with pytest.raises(ValueError):
+            parse_multihost_spec(bad)
+
+    def test_from_env_full(self):
+        env = {
+            "GORDO_COORDINATOR": "coord:1234",
+            "GORDO_NUM_PROCESSES": "4",
+            "GORDO_PROCESS_ID": "2",
+            "GORDO_LOCAL_DEVICES": "2",
+            "GORDO_BARRIER_TIMEOUT": "45",
+        }
+        cfg = DistributedConfig.from_env(env)
+        assert cfg.coordinator == "coord:1234"
+        assert cfg.num_processes == 4
+        assert cfg.process_id == 2
+        assert cfg.local_device_count == 2
+        assert cfg.barrier_timeout == 45.0
+
+    def test_from_env_absent_means_single_host(self):
+        assert DistributedConfig.from_env({}) is None
+        assert DistributedConfig.from_env({"GORDO_COORDINATOR": ""}) is None
+
+    def test_from_env_partial_is_an_error(self):
+        with pytest.raises(ValueError, match="GORDO"):
+            DistributedConfig.from_env({"GORDO_COORDINATOR": "c:1"})
+
+
+# ---------------------------------------------------------------------------
+# partitioning
+# ---------------------------------------------------------------------------
+
+class TestPartition:
+    def test_disjoint_and_exhaustive(self):
+        machines = [_machine(f"m-{i:02d}") for i in range(11)]
+        for n in (1, 2, 3, 5, 11):
+            shards = partition_machines(machines, n)
+            assert len(shards) == n
+            names = sorted(m.name for s in shards for m in s)
+            assert names == sorted(m.name for m in machines)
+
+    def test_deterministic_and_order_independent(self):
+        machines = [_machine(f"m-{i:02d}") for i in range(9)]
+        ref = [
+            [m.name for m in s] for s in partition_machines(machines, 3)
+        ]
+        shuffled = list(reversed(machines))
+        again = [
+            [m.name for m in s] for s in partition_machines(shuffled, 3)
+        ]
+        assert ref == again
+
+    def test_balanced_within_one_machine(self):
+        machines = [_machine(f"m-{i:02d}") for i in range(10)]
+        sizes = [len(s) for s in partition_machines(machines, 3)]
+        assert max(sizes) - min(sizes) <= 1
+
+    def test_signatures_stay_grouped(self):
+        """Same-signature machines slice contiguously — a shard never
+        interleaves two signatures when it could hold one."""
+        wide = [_machine(f"w-{i}", tags=("a", "b", "c", "d", "e"))
+                for i in range(4)]
+        narrow = [_machine(f"n-{i}") for i in range(4)]
+        shards = partition_machines(narrow + wide, 2)
+        for s in shards:
+            prefixes = [m.name[0] for m in s]
+            # each signature's members appear as one contiguous run
+            for p in set(prefixes):
+                first, last = prefixes.index(p), len(prefixes) - 1 - prefixes[::-1].index(p)
+                assert all(x == p for x in prefixes[first:last + 1])
+
+    def test_more_processes_than_machines_leaves_empty_shards(self):
+        machines = [_machine("m-0"), _machine("m-1")]
+        shards = partition_machines(machines, 4)
+        assert sorted(len(s) for s in shards) == [0, 0, 1, 1]
+
+    def test_max_processes_is_machine_count(self):
+        machines = [_machine(f"m-{i}") for i in range(7)]
+        assert max_processes(machines) == 7
+
+    def test_process_shard_selects_own_slice(self, tmp_path):
+        machines = [_machine(f"m-{i:02d}") for i in range(6)]
+        all_names = []
+        for pid in range(3):
+            shard = process_shard(
+                machines, 3, pid, output_dir=str(tmp_path)
+            )
+            assert shard.process_id == pid
+            assert shard.state is not None
+            all_names.extend(shard.names)
+        assert sorted(all_names) == [m.name for m in machines]
+
+
+# ---------------------------------------------------------------------------
+# shard state (resumability)
+# ---------------------------------------------------------------------------
+
+class TestShardState:
+    def test_roundtrip_and_progress(self, tmp_path):
+        state = ShardState(str(tmp_path), 1, 2)
+        state.start(["m-a", "m-b", "m-c"])
+        state.record("m-a")
+        loaded = ShardState.load(str(tmp_path), 1, 2)
+        assert loaded.status == "running"
+        assert loaded.completed == ["m-a"]
+        assert loaded.machines == ["m-a", "m-b", "m-c"]
+        state.finish()
+        assert ShardState.load(str(tmp_path), 1, 2).status == "done"
+
+    def test_resume_preserves_completed_for_same_shard(self, tmp_path):
+        first = ShardState(str(tmp_path), 0, 2)
+        first.start(["m-a", "m-b"])
+        first.record("m-a")
+        first.mark_resumable("peer died")
+        # a re-run of the SAME shard keeps the history...
+        second = ShardState(str(tmp_path), 0, 2)
+        second.start(["m-b", "m-a"])  # order-insensitive
+        assert second.completed == ["m-a"]
+        # ...a different machine set resets it
+        third = ShardState(str(tmp_path), 0, 2)
+        third.start(["m-a", "m-z"])
+        assert third.completed == []
+
+    def test_load_missing_returns_none(self, tmp_path):
+        assert ShardState.load(str(tmp_path), 0, 2) is None
+
+    def test_resumable_exit_code_is_tempfail(self):
+        assert EXIT_SHARD_RESUMABLE == 75  # BSD EX_TEMPFAIL: retry me
+
+
+# ---------------------------------------------------------------------------
+# launcher env
+# ---------------------------------------------------------------------------
+
+class TestLauncher:
+    def test_pick_free_port_binds(self):
+        port = pick_free_port()
+        assert 1024 <= port <= 65535
+
+    def test_worker_env_contract(self):
+        env = worker_env(
+            1, 4, "127.0.0.1:9999", local_devices=2, barrier_timeout=30,
+        )
+        assert env["GORDO_COORDINATOR"] == "127.0.0.1:9999"
+        assert env["GORDO_NUM_PROCESSES"] == "4"
+        assert env["GORDO_PROCESS_ID"] == "1"
+        assert env["GORDO_LOCAL_DEVICES"] == "2"
+        assert env["GORDO_BARRIER_TIMEOUT"] == "30"
+        assert env["JAX_PLATFORMS"] == "cpu"
+        assert "--xla_force_host_platform_device_count=2" in env["XLA_FLAGS"]
+
+    def test_worker_env_replaces_inherited_device_count(self):
+        base = dict(os.environ)
+        base["XLA_FLAGS"] = (
+            "--xla_foo=1 --xla_force_host_platform_device_count=8"
+        )
+        env = worker_env(0, 2, "c:1", local_devices=3, base_env=base)
+        flags = env["XLA_FLAGS"].split()
+        assert "--xla_foo=1" in flags
+        assert flags.count("--xla_force_host_platform_device_count=3") == 1
+        assert "--xla_force_host_platform_device_count=8" not in flags
+
+
+# ---------------------------------------------------------------------------
+# sharded build_project (in-process, local mesh only — no jax.distributed)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_build_project_with_shard_builds_only_its_slice(tmp_path):
+    from gordo_tpu.builder import build_project
+
+    machines = [
+        Machine.from_config({
+            "name": f"sh-{i}",
+            "dataset": {
+                "type": "RandomDataset",
+                "tag_list": ["a", "b", "c"],
+                "train_start_date": "2017-12-25T06:00:00Z",
+                "train_end_date": "2017-12-26T06:00:00Z",
+            },
+        })
+        for i in range(4)
+    ]
+    out = str(tmp_path / "models")
+    built = []
+    for pid in range(2):
+        shard = process_shard(machines, 2, pid, output_dir=out)
+        result = build_project(machines, out, shard=shard)
+        assert not result.failed
+        assert sorted(result.artifacts) == sorted(shard.names)
+        assert result.shard == (pid, 2)
+        assert result.summary()["shard"]["process_id"] == pid
+        state = ShardState.load(out, pid, 2)
+        assert state.status == "done"
+        assert sorted(state.completed) == sorted(shard.names)
+        built.extend(result.artifacts)
+    assert sorted(built) == [m.name for m in machines]
+
+
+# ---------------------------------------------------------------------------
+# the real multi-process path (slow lane): forked workers, real
+# jax.distributed init, kill/resume — the CI form of the dryrun
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_multihost_dryrun_two_processes():
+    """ISSUE acceptance: 2 forked processes pass on CPU — init succeeds,
+    shards disjoint+exhaustive, artifacts byte-identical to single-host,
+    and a killed worker leaves a resumable state a re-run completes."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "multihost_dryrun.py")],
+        capture_output=True, text=True, timeout=570, cwd=REPO,
+        env={
+            k: v for k, v in os.environ.items()
+            # the forked workers pin their own backends; drop the test
+            # harness's 8-device flag so it can't leak in
+            if k not in ("XLA_FLAGS",)
+        },
+    )
+    assert proc.returncode == 0, (
+        f"dryrun rc={proc.returncode}\nstdout:\n{proc.stdout[-4000:]}\n"
+        f"stderr:\n{proc.stderr[-2000:]}"
+    )
+    ok_line = [
+        line for line in proc.stdout.splitlines() if line.startswith("OK ")
+    ]
+    assert ok_line, proc.stdout[-2000:]
+    doc = json.loads(ok_line[0][3:])
+    assert "multihost-init-2proc" in doc["phases"]
+    assert "artifact-byte-identity" in doc["phases"]
+    assert "resume-completed" in doc["phases"]

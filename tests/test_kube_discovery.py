@@ -211,3 +211,198 @@ def test_watchman_merges_discovered_targets(monkeypatch):
     )
     targets = asyncio.run(watchman._current_targets())
     assert targets == ["http://static:1", "http://svc-a.ns:5555"]
+
+
+# ---------------------------------------------------------------------------
+# watch-thread edge cases (VERDICT weak #6): generation changes mid-event
+# and stop() racing a pending apply
+# ---------------------------------------------------------------------------
+
+def _gated_kubernetes(services, first_events, late_events, gate):
+    """Fake kubernetes whose FIRST Watch stream yields ``first_events``,
+    then blocks on ``gate``, then yields ``late_events`` — so a test can
+    stop/restart discovery while generation 1 is wedged mid-stream.
+    Later Watch instances stream nothing and idle (like a quiet cluster).
+    """
+    import threading
+    import types
+
+    module = types.ModuleType("kubernetes")
+
+    class FakeCoreV1Api:
+        def list_namespaced_service(self, namespace, label_selector=None):
+            return types.SimpleNamespace(
+                items=[_svc(name, port) for name, port in services]
+            )
+
+    instances = []
+
+    class FakeWatch:
+        def __init__(self):
+            self._stopped = False
+            self.generation = len(instances)
+            instances.append(self)
+
+        def stream(self, fn, namespace, label_selector=None,
+                   timeout_seconds=None):
+            import time as _t
+            if self.generation == 0:
+                for event in first_events:
+                    yield event
+                gate.wait(timeout=10)  # wedged mid-stream
+                for event in late_events:
+                    if self._stopped:
+                        return
+                    yield event
+            while not self._stopped:
+                _t.sleep(0.01)
+
+        def stop(self):
+            self._stopped = True
+
+    client = types.ModuleType("kubernetes.client")
+    client.CoreV1Api = FakeCoreV1Api
+    config = types.ModuleType("kubernetes.config")
+    config.load_incluster_config = lambda: None
+    config.load_kube_config = lambda: None
+    watch = types.ModuleType("kubernetes.watch")
+    watch.Watch = FakeWatch
+    module.client = client
+    module.config = config
+    module.watch = watch
+    return module
+
+
+def test_abandoned_generation_event_cannot_poison_new_cache(monkeypatch):
+    """Generation change mid-event: gen-1's stream wedges, stop_watch()'s
+    join times out, a NEW generation starts and owns the cache — then
+    gen-1 un-wedges and yields a late event.  The late apply must be
+    discarded, not merged into gen-2's live cache."""
+    import threading
+    import time
+
+    gate = threading.Event()
+    late = [{"type": "ADDED", "object": _svc("svc-stale", 5555)}]
+    module = _gated_kubernetes(
+        [("svc-live", 5555)], first_events=[], late_events=late, gate=gate,
+    )
+    _install(monkeypatch, module)
+
+    from gordo_tpu.watchman.kube import KubeTargetDiscovery
+
+    disc = KubeTargetDiscovery("ns", in_cluster=False)
+    disc.start_watch()
+    # wait until gen-1 seeded its cache and entered the wedged stream
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if disc.targets() == ["http://svc-live.ns:5555"]:
+            break
+        time.sleep(0.01)
+    gen1_stop = disc._watch_stop
+    # stop with the thread wedged: join(5) would block the test for 5s,
+    # so shrink it by monkeypatching nothing — instead call stop in a
+    # helper thread and wait for the flag
+    stopper = threading.Thread(target=disc.stop_watch)
+    stopper.start()
+    deadline = time.time() + 6
+    while not gen1_stop.is_set() and time.time() < deadline:
+        time.sleep(0.01)
+    assert gen1_stop.is_set()
+
+    # new generation takes over and owns the cache
+    disc.start_watch()
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if disc.targets() == ["http://svc-live.ns:5555"]:
+            break
+        time.sleep(0.01)
+    gen2_stop = disc._watch_stop
+    assert gen2_stop is not gen1_stop
+
+    # un-wedge gen-1: its late svc-stale event must be dropped
+    gate.set()
+    stopper.join(timeout=10)
+    time.sleep(0.2)  # give the abandoned thread time to (mis)apply
+    assert disc.targets() == ["http://svc-live.ns:5555"]
+    disc.stop_watch()
+
+
+def test_stop_racing_pending_apply_leaves_list_fallback(monkeypatch):
+    """stop() racing a pending apply: the stream has an event in flight
+    when stop_watch() runs.  After stop returns, targets() must be
+    list-backed (cache dropped) and STAY list-backed — the straggler
+    apply cannot resurrect a cache nobody owns."""
+    import threading
+    import time
+
+    gate = threading.Event()
+    late = [{"type": "ADDED", "object": _svc("svc-racer", 5555)}]
+    module = _gated_kubernetes(
+        [("svc-static", 5555)], first_events=[], late_events=late, gate=gate,
+    )
+    _install(monkeypatch, module)
+
+    from gordo_tpu.watchman.kube import KubeTargetDiscovery
+
+    disc = KubeTargetDiscovery("ns", in_cluster=False)
+    disc.start_watch()
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if disc.targets() == ["http://svc-static.ns:5555"]:
+            break
+        time.sleep(0.01)
+
+    # stop while the stream is wedged with svc-racer still pending, then
+    # release the event AFTER stop has returned
+    stopper = threading.Thread(target=disc.stop_watch)
+    stopper.start()
+    time.sleep(0.1)
+    gate.set()
+    stopper.join(timeout=10)
+    time.sleep(0.2)
+    # cache must be gone and not resurrected by the raced apply...
+    with disc._watch_lock:
+        assert disc._watch_cache is None
+    # ...and the poll path lists services directly
+    assert disc.targets() == ["http://svc-static.ns:5555"]
+
+
+def test_restart_after_stop_resyncs_fresh_state(monkeypatch):
+    """A stopped-then-restarted discovery re-seeds from a full list
+    (resync), so changes that happened while stopped are picked up."""
+    import time
+
+    services = [("svc-a", 5555)]
+    module, _ = _fake_kubernetes(list(services))
+    _install(monkeypatch, module)
+
+    from gordo_tpu.watchman.kube import KubeTargetDiscovery
+
+    disc = KubeTargetDiscovery("ns", in_cluster=False)
+    disc.start_watch()
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if disc.targets() == ["http://svc-a.ns:5555"]:
+            break
+        time.sleep(0.01)
+    disc.stop_watch()
+
+    # the cluster changed while we were not watching
+    module.client.CoreV1Api = _fake_kubernetes(
+        [("svc-a", 5555), ("svc-b", 80)]
+    )[0].client.CoreV1Api
+    disc._core = module.client.CoreV1Api()
+    disc.start_watch()
+    try:
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if disc.targets() == [
+                "http://svc-a.ns:5555", "http://svc-b.ns:80",
+            ]:
+                break
+            time.sleep(0.01)
+        assert disc.targets() == [
+            "http://svc-a.ns:5555", "http://svc-b.ns:80",
+        ]
+    finally:
+        disc.stop_watch()

@@ -192,3 +192,48 @@ def test_server_deployment_args_and_warmup_default():
     assert "--warmup" in args
     i = args.index("--coalesce-ms")
     assert args[i: i + 3] == ["--coalesce-ms", "2", "--model-parallel"]
+
+
+def test_generate_workflow_multihost_indexed_job():
+    """--multihost N: the builder becomes an N-pod Indexed Job wired with
+    the GORDO_* env contract and a headless Service giving pod 0 a stable
+    coordinator DNS name."""
+    docs = generate_workflow(_config(), multihost=2)
+    job = next(d for d in docs if d["kind"] == "Job")
+    assert job["spec"]["completionMode"] == "Indexed"
+    assert job["spec"]["completions"] == 2
+    assert job["spec"]["parallelism"] == 2
+    pod = job["spec"]["template"]["spec"]
+    assert pod["subdomain"] == "gordo-builder-genproj"
+    env = {
+        e["name"]: e["value"]
+        for e in pod["containers"][0]["env"]
+    }
+    assert env["GORDO_NUM_PROCESSES"] == "2"
+    assert env["GORDO_PROCESS_ID"] == "$(JOB_COMPLETION_INDEX)"
+    assert env["GORDO_COORDINATOR"].startswith("gordo-builder-genproj-0.")
+    # the headless service exists and has no cluster VIP
+    headless = next(
+        d for d in docs
+        if d["kind"] == "Service"
+        and d["metadata"]["name"] == "gordo-builder-genproj"
+    )
+    assert headless["spec"]["clusterIP"] == "None"
+
+
+def test_generate_workflow_multihost_one_process_is_plain_job():
+    docs = generate_workflow(_config(), multihost=1)
+    job = next(d for d in docs if d["kind"] == "Job")
+    assert "completionMode" not in job["spec"]
+
+
+def test_generate_workflow_refuses_oversharded_multihost():
+    """Bugfix (ISSUE 2 satellite): N beyond the machine-shard count is a
+    config error with a clear message, not a manifest with idle
+    barrier-holding pods."""
+    import pytest
+
+    with pytest.raises(ValueError, match="machine-shard count"):
+        generate_workflow(_config(), multihost=4)  # only 3 machines
+    with pytest.raises(ValueError, match="multihost"):
+        generate_workflow(_config(), multihost=0)
