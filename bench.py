@@ -577,6 +577,62 @@ def bench_serving_openloop(out: dict) -> None:
         shutil.rmtree(art_dir, ignore_errors=True)
 
 
+def bench_telemetry_overhead(out: dict) -> None:
+    """Acceptance gate for the telemetry plane: the instrumented msgpack
+    bulk path (request middleware + histograms + spans live) must cost
+    <= 2% throughput vs the ``GORDO_TELEMETRY=off`` kill switch.
+
+    Best-of-3 on BOTH sides: adjacent runs on a shared CPU drift more
+    than the effect under test, and min-noise pairing is the same
+    protocol the coalesced-vs-direct points use.
+    """
+    from gordo_tpu import telemetry
+    from gordo_tpu.serve.replay import replay_bench
+
+    model, metadata = _build_serving_model()
+    art_dir = tempfile.mkdtemp(prefix="gordo-bench-telemetry-")
+    try:
+        collection = _serving_collection(art_dir, model, metadata, 64)
+
+        def best_of(n: int = 3) -> dict:
+            best = None
+            for _ in range(n):
+                res = replay_bench(
+                    collection, mode="bulk", wire="msgpack", n_rounds=5,
+                    rows=2048, parallelism=8,
+                )
+                if best is None or (
+                    res["samples_per_sec"] > best["samples_per_sec"]
+                ):
+                    best = res
+            return best
+
+        on = best_of()
+        telemetry.set_enabled(False)
+        try:
+            off = best_of()
+        finally:
+            telemetry.set_enabled(True)
+        overhead_pct = 100.0 * (
+            1.0 - on["samples_per_sec"] / off["samples_per_sec"]
+        )
+        out["telemetry_on_samples_per_sec"] = round(on["samples_per_sec"])
+        out["telemetry_off_samples_per_sec"] = round(off["samples_per_sec"])
+        # negative = instrumented run measured faster (pure noise floor)
+        out["telemetry_overhead_pct"] = round(overhead_pct, 2)
+        out["telemetry_overhead_ok"] = overhead_pct <= 2.0
+        # the in-run scrape attests /metrics served valid text under load
+        out["telemetry_scrape"] = on.get("metrics_scrape")
+        log(
+            f"telemetry overhead (msgpack bulk): on "
+            f"{on['samples_per_sec']:,.0f} vs off "
+            f"{off['samples_per_sec']:,.0f} samples/s -> "
+            f"{overhead_pct:+.2f}% (gate: <= 2%)"
+        )
+    finally:
+        shutil.rmtree(art_dir, ignore_errors=True)
+
+
 def init_devices(attempts: int = 5, backoff_s: float = 2.0):
     """Initialize the jax backend with bounded retry.
 
@@ -697,7 +753,8 @@ def run_stage_bounded(
 
 #: stage registry order == run order == metric priority (a mid-run wedge
 #: costs the least important remaining numbers)
-STAGES = ("build", "serving", "serving_openloop", "lstm")
+STAGES = ("build", "serving", "serving_openloop", "telemetry_overhead",
+          "lstm")
 
 
 def parse_stages(argv: "list[str]") -> "list[str]":
@@ -797,6 +854,10 @@ def main(argv: "list[str] | None" = None) -> None:
         "serving_openloop": (
             lambda: bench_serving_openloop(out),
             lambda: min(remaining() * 0.7, 420),
+        ),
+        "telemetry_overhead": (
+            lambda: bench_telemetry_overhead(out),
+            lambda: min(remaining() * 0.7, 360),
         ),
         "lstm": (
             lambda: bench_lstm_build(mesh, out),

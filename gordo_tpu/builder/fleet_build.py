@@ -36,7 +36,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 import numpy as np
 from jax.sharding import Mesh
 
-from gordo_tpu import serializer
+from gordo_tpu import serializer, telemetry
 from gordo_tpu.builder.build_model import (
     assemble_metadata,
     build_model,
@@ -49,6 +49,26 @@ from gordo_tpu.utils import disk_registry, profiling
 from gordo_tpu.workflow.config import Machine
 
 logger = logging.getLogger(__name__)
+
+# -- telemetry instruments (docs/observability.md) --------------------------
+_BUILD_MACHINES_TOTAL = telemetry.counter(
+    "gordo_build_machines_total",
+    "Machines resolved by project builds, by path taken",
+    labels=("path",),  # cached | fleet | single | failed
+)
+_BUILD_MACHINE_SECONDS = telemetry.histogram(
+    "gordo_build_machine_seconds",
+    "Per-machine build seconds (fleet machines: bucket seconds / size)",
+    labels=("path",),
+)
+_BUILD_BUCKET_SECONDS = telemetry.histogram(
+    "gordo_build_bucket_seconds",
+    "Stacked CV+fit seconds per fleet chunk",
+)
+_DATA_LOAD_SECONDS = telemetry.histogram(
+    "gordo_build_data_load_seconds",
+    "Per-machine dataset load+assembly seconds (loader pool)",
+)
 
 #: fleet programs are chunked so a bucket's stacked arrays stay well inside
 #: device memory (tiny models: the data, not the params, is the footprint).
@@ -396,6 +416,7 @@ def build_project(
             if cached is not None:
                 result.artifacts[m.name] = cached
                 result.cached.append(m.name)
+                _BUILD_MACHINES_TOTAL.inc(1.0, "cached")
                 _done(m.name)
                 return True
         return False
@@ -459,6 +480,7 @@ def build_project(
             # newest rows win: industrial sensor history is trained most-
             # recent-first relevant, so the truncation drops the head
             X, y = X[len(X) - keep:], y[len(y) - keep:]
+        _DATA_LOAD_SECONDS.observe(time.time() - t0)
         entry = (X, y, dataset.get_metadata(), time.time() - t0)
         tracker.acquire()  # arrays are live from here until freed
         return entry
@@ -474,6 +496,7 @@ def build_project(
             except Exception as exc:  # data failure must not sink the fleet
                 logger.exception("Data load failed for %s", m.name)
                 result.failed[m.name] = f"data: {exc}"
+                _BUILD_MACHINES_TOTAL.inc(1.0, "failed")
         return loaded
 
     def _free(loaded: Dict[str, Tuple], names: Sequence[str]) -> None:
@@ -535,6 +558,7 @@ def build_project(
                 _free(loaded, [m.name for m in ok_chunk])
                 continue
             fleet_seconds = time.time() - t0
+            _BUILD_BUCKET_SECONDS.observe(fleet_seconds)
             for m, det in zip(ok_chunk, detectors):
                 _dump_machine(
                     m,
@@ -572,6 +596,7 @@ def build_project(
         # form; a prior run's single artifact may already satisfy it
         if m.name in demoted and _lookup(machine_keys[m.name], m):
             continue
+        t_single = time.time()
         try:
             model, metadata = build_model(
                 m.name, m.model, m.dataset, m.metadata, m.evaluation
@@ -579,6 +604,7 @@ def build_project(
         except Exception as exc:
             logger.exception("Single build failed for %s", m.name)
             result.failed[m.name] = f"build: {exc}"
+            _BUILD_MACHINES_TOTAL.inc(1.0, "failed")
             continue
         metadata["cache_key"] = machine_keys[m.name]
         dest = os.path.join(output_dir, m.name)
@@ -586,6 +612,8 @@ def build_project(
         _register(dest, model_register_dir, machine_keys[m.name])
         result.artifacts[m.name] = dest
         result.single_built.append(m.name)
+        _BUILD_MACHINES_TOTAL.inc(1.0, "single")
+        _BUILD_MACHINE_SECONDS.observe(time.time() - t_single, "single")
         _done(m.name)
 
     if shard_state is not None:
@@ -597,7 +625,29 @@ def build_project(
             shard_state.finish()
     result.seconds = time.time() - t_start
     result.peak_loaded = tracker.peak
+    _write_telemetry_snapshot(output_dir, result.shard)
     return result
+
+
+def _write_telemetry_snapshot(
+    output_dir: str, shard: Optional[Tuple[int, int]]
+) -> None:
+    """Shard-local metric snapshot under ``<output_dir>/.gordo-telemetry/``
+    — one file per process of a (multi-host) build, merged later by
+    ``gordo telemetry dump --dir`` / watchman.  Process-id-keyed filenames
+    mean a re-run of the same shard overwrites its own snapshot and never
+    a peer's."""
+    if not telemetry.enabled():
+        return
+    pid, n = shard or (0, 1)
+    path = os.path.join(
+        output_dir, telemetry.SNAPSHOT_DIR,
+        f"shard-{pid:03d}-of-{n:03d}.json",
+    )
+    try:
+        telemetry.REGISTRY.write_snapshot(path)
+    except Exception:  # telemetry must never fail a build
+        logger.exception("telemetry snapshot write failed: %s", path)
 
 
 def _dump_machine(
@@ -648,6 +698,8 @@ def _dump_machine(
     _register(dest, model_register_dir, cache_key)
     result.artifacts[m.name] = dest
     result.fleet_built.append(m.name)
+    _BUILD_MACHINES_TOTAL.inc(1.0, "fleet")
+    _BUILD_MACHINE_SECONDS.observe(fit_seconds, "fleet")
 
 
 def _register(

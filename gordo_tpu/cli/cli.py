@@ -23,8 +23,16 @@ import click
 import yaml
 
 import gordo_tpu
+from gordo_tpu import telemetry
 
 logger = logging.getLogger(__name__)
+
+_RESUMABLE_EXITS_TOTAL = telemetry.counter(
+    "gordo_resumable_exits_total",
+    "exit-75 (EX_TEMPFAIL) resumable exits of multi-host build workers, "
+    "by stage",
+    labels=("stage",),
+)
 
 
 def _parse_config(value: Optional[str], name: str) -> Dict[str, Any]:
@@ -269,10 +277,30 @@ def _run_multihost_build(dist_cfg, machines, output_dir, model_register_dir,
     )
 
     def _resumable_exit(stage: str, exc: Exception, result=None) -> None:
+        _RESUMABLE_EXITS_TOTAL.inc(1.0, stage)
+        telemetry.log_event(
+            logger, "resumable_exit",
+            stage=stage,
+            process_id=dist_cfg.process_id,
+            num_processes=dist_cfg.num_processes,
+            exit_code=EXIT_SHARD_RESUMABLE,
+        )
         if shard.state is not None:
             if not shard.state.machines:
                 shard.state.start(shard.names)
             shard.state.mark_resumable(f"{stage}: {exc}")
+        # last-gasp shard-local snapshot: the barrier-wait/timeout series
+        # this process accumulated must survive the os._exit for the
+        # post-mortem merge (`gordo telemetry dump --dir <output_dir>`)
+        if telemetry.enabled():
+            try:
+                telemetry.REGISTRY.write_snapshot(os.path.join(
+                    output_dir, telemetry.SNAPSHOT_DIR,
+                    f"shard-{dist_cfg.process_id:03d}"
+                    f"-of-{dist_cfg.num_processes:03d}.json",
+                ))
+            except Exception:
+                logger.exception("telemetry snapshot write failed")
         doc = result.summary() if result is not None else {}
         doc["resumable"] = {
             "stage": stage,
@@ -517,6 +545,67 @@ def client_download_model(ctx, output_dir, machine_names):
 
 
 # ---------------------------------------------------------------------------
+# telemetry
+# ---------------------------------------------------------------------------
+
+@gordo.group("telemetry")
+def telemetry_group():
+    """Observability plane: metric snapshots and scrapes."""
+
+
+@telemetry_group.command("dump")
+@click.option("--dir", "snapshot_dir", default=None,
+              help="Merge the shard-local snapshots a project build wrote "
+                   "under DIR (a build --output-dir, or its "
+                   ".gordo-telemetry/ subdir directly) and print the "
+                   "merged Prometheus text.")
+@click.option("--url", "scrape_url", default=None,
+              help="Scrape a live server's /metrics (base URL or full "
+                   "/metrics URL) and print it.")
+def telemetry_dump(snapshot_dir, scrape_url):
+    """Print a metrics snapshot as Prometheus text.
+
+    Default (no option): this process's own registry — mostly useful under
+    ``GORDO_SPAN_LOG``/scripted use.  ``--dir`` merges a (multi-host)
+    build's shard-local snapshot files; ``--url`` scrapes a live server.
+    """
+    if snapshot_dir and scrape_url:
+        raise click.UsageError("--dir and --url are mutually exclusive")
+    if snapshot_dir:
+        candidates = [
+            os.path.join(snapshot_dir, telemetry.SNAPSHOT_DIR),
+            snapshot_dir,
+        ]
+        snaps = []
+        for directory in candidates:
+            snaps = telemetry.load_snapshot_dir(directory)
+            if snaps:
+                break
+        if not snaps:
+            raise click.ClickException(
+                f"no telemetry snapshots under {candidates}"
+            )
+        click.echo(
+            telemetry.render_snapshot(telemetry.merge_snapshots(snaps)),
+            nl=False,
+        )
+        return
+    if scrape_url:
+        import urllib.request
+
+        url = scrape_url.rstrip("/")
+        if not url.endswith("/metrics"):
+            url += "/metrics"
+        try:
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                click.echo(resp.read().decode(), nl=False)
+        except Exception as exc:
+            raise click.ClickException(f"scrape {url} failed: {exc}")
+        return
+    click.echo(telemetry.render(), nl=False)
+
+
+# ---------------------------------------------------------------------------
 # workflow
 # ---------------------------------------------------------------------------
 
@@ -545,9 +634,16 @@ def workflow_group():
                    "(jax.distributed over N pods, GORDO_* env wiring, "
                    "deterministic machine shards). Refused when N exceeds "
                    "the plan's machine-shard count.")
+@click.option("--scrape-annotations/--no-scrape-annotations", default=True,
+              show_default=True,
+              help="Stamp prometheus.io/{scrape,port,path} discovery "
+                   "annotations on the server and watchman pod templates "
+                   "so their /metrics endpoints are scraped without extra "
+                   "cluster config.")
 @click.option("--output-file", type=click.File("w"), default="-")
 def workflow_generate(machine_config, project_name, image, server_replicas,
-                      server_args, fmt, multihost, output_file):
+                      server_args, fmt, multihost, scrape_annotations,
+                      output_file):
     """Render the kubernetes manifests + fleet build plan (reference:
     the Argo workflow template render)."""
     from gordo_tpu.workflow import (
@@ -568,6 +664,7 @@ def workflow_generate(machine_config, project_name, image, server_replicas,
         docs = generate_workflow(
             config, image=image, server_replicas=server_replicas,
             server_args=list(server_args), multihost=multihost,
+            scrape_annotations=scrape_annotations,
         )
     except ValueError as exc:
         raise click.ClickException(str(exc))

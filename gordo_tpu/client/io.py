@@ -13,6 +13,8 @@ from typing import Any, Dict, Optional
 
 import aiohttp
 
+from gordo_tpu import telemetry
+
 
 class HttpUnprocessableEntity(Exception):
     """422 — the endpoint understood the request but the model refuses it
@@ -47,7 +49,15 @@ async def request_json(
 
     Responses decode by content type: ``application/x-msgpack`` through the
     binary codec (array leaves come back as ndarrays), anything else as
-    JSON."""
+    JSON.
+
+    Every request carries the context's trace id in the
+    ``X-Gordo-Trace-Id`` header (minted here when the caller hasn't bound
+    one): the server tags its handler/coalescer/scorer spans with it and
+    echoes it on the response, so one id stitches a request's timeline
+    from this client through the whole serving stack."""
+    headers = dict(headers or {})
+    headers.setdefault(telemetry.TRACE_HEADER, telemetry.ensure_trace_id())
     last_exc: Optional[Exception] = None
     for attempt in range(retries + 1):
         try:

@@ -30,10 +30,20 @@ so only the dead shard's remainder trains.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence
+
+from gordo_tpu import telemetry
+
+logger = logging.getLogger(__name__)
+
+_SHARD_RESUMABLE_TOTAL = telemetry.counter(
+    "gordo_shard_resumable_total",
+    "Shard states marked resumable (peer death / failed machines)",
+)
 
 #: exit code of a worker whose shard is incomplete but resumable (a peer
 #: died / barrier timed out).  BSD EX_TEMPFAIL: "retry the same command".
@@ -185,6 +195,17 @@ class ShardState:
 
     def mark_resumable(self, reason: str = "") -> None:
         self.status = "resumable"
+        _SHARD_RESUMABLE_TOTAL.inc()
+        # one structured line per transition: a shard going resumable is
+        # the multi-host failure signal operators grep for
+        telemetry.log_event(
+            logger, "shard_resumable",
+            process_id=self.process_id,
+            num_processes=self.num_processes,
+            completed=len(self.completed),
+            machines=len(self.machines),
+            reason=repr(reason)[:120],
+        )
         self._write(extra={"reason": reason})
 
     def _write(self, extra: Optional[Dict[str, Any]] = None) -> None:

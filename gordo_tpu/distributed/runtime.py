@@ -32,10 +32,25 @@ from __future__ import annotations
 import logging
 import os
 import threading
+import time
 from dataclasses import dataclass
 from typing import Optional
 
+from gordo_tpu import telemetry
+
 logger = logging.getLogger(__name__)
+
+# -- telemetry instruments (docs/observability.md) --------------------------
+_BARRIER_WAIT_SECONDS = telemetry.histogram(
+    "gordo_barrier_wait_seconds",
+    "Time this process spent waiting at cross-process barriers, by name",
+    labels=("barrier",),
+)
+_BARRIER_TIMEOUTS_TOTAL = telemetry.counter(
+    "gordo_barrier_timeouts_total",
+    "Barriers that expired (a peer is dead or wedged), by name",
+    labels=("barrier",),
+)
 
 #: default barrier timeout: generous enough for a straggler host's XLA
 #: compile skew, far below a wedged-slice babysitting interval
@@ -298,6 +313,7 @@ class DistributedRuntime:
         from jax._src import distributed as jax_distributed
 
         client = jax_distributed.global_state.client
+        t0 = time.monotonic()
         try:
             if client is not None and hasattr(client, "wait_at_barrier"):
                 client.wait_at_barrier(
@@ -306,15 +322,32 @@ class DistributedRuntime:
             else:  # pragma: no cover - jax without the coordination client
                 self._sync_with_thread_timeout(name, timeout)
         except BarrierTimeout:
-            self._barrier_failed = True
+            self._note_barrier_timeout(name, timeout, t0)
             raise
         except Exception as exc:
-            self._barrier_failed = True
+            self._note_barrier_timeout(name, timeout, t0)
             raise BarrierTimeout(
                 f"barrier {name!r} failed after <= {timeout:.0f}s "
                 f"(process {self.config.process_id}/"
                 f"{self.config.num_processes}): {exc}"
             ) from exc
+        _BARRIER_WAIT_SECONDS.observe(time.monotonic() - t0, name)
+
+    def _note_barrier_timeout(
+        self, name: str, timeout: float, t0: float
+    ) -> None:
+        """Count + one structured line per expired barrier (previously the
+        only trace was the raised exception's message)."""
+        self._barrier_failed = True
+        _BARRIER_WAIT_SECONDS.observe(time.monotonic() - t0, name)
+        _BARRIER_TIMEOUTS_TOTAL.inc(1.0, name)
+        telemetry.log_event(
+            logger, "barrier_timeout",
+            barrier=name,
+            timeout_s=round(timeout, 1),
+            process_id=self.config.process_id,
+            num_processes=self.config.num_processes,
+        )
 
     @staticmethod
     def _sync_with_thread_timeout(name: str, timeout: float) -> None:

@@ -55,7 +55,72 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from gordo_tpu import telemetry
+
 logger = logging.getLogger(__name__)
+
+# -- telemetry instruments (docs/observability.md) --------------------------
+_REQUESTS_TOTAL = telemetry.counter(
+    "gordo_coalesce_requests_total",
+    "Requests entering the coalescer (stacked and fallback-routed)",
+)
+_DISPATCHES_TOTAL = telemetry.counter(
+    "gordo_coalesce_dispatches_total",
+    "Stacked device dispatches run by the drain worker",
+)
+_BYPASSED_TOTAL = telemetry.counter(
+    "gordo_coalesce_bypassed_total",
+    "Requests routed direct instead of coalescing, by reason",
+    labels=("reason",),
+)
+_BATCH_SIZE = telemetry.histogram(
+    "gordo_coalesce_batch_size",
+    "Requests drained per batch (before round-splitting)",
+    buckets=telemetry.metrics.DEFAULT_SIZE_BUCKETS,
+)
+_QUEUE_WAIT_SECONDS = telemetry.histogram(
+    "gordo_coalesce_queue_wait_seconds",
+    "Per-request wait between enqueue and dispatch",
+)
+_DISPATCH_SECONDS = telemetry.histogram(
+    "gordo_coalesce_dispatch_seconds",
+    "Device service time of one stacked coalesced dispatch",
+)
+_STANDDOWNS_TOTAL = telemetry.counter(
+    "gordo_coalesce_standdowns_total",
+    "Saturation stand-downs (batching judged losing; routing direct)",
+)
+_KNEE_ESTIMATES_TOTAL = telemetry.counter(
+    "gordo_coalesce_knee_estimates_total",
+    "Knee-sweep runs by outcome",
+    labels=("outcome",),
+)
+_QUEUE_DEPTH_GAUGE = telemetry.gauge(
+    "gordo_coalesce_queue_depth", "Requests currently queued for a dispatch"
+)
+_INFLIGHT_GAUGE = telemetry.gauge(
+    "gordo_coalesce_inflight",
+    "In-flight single-machine anomaly requests (the bypass signal)",
+)
+_BATCH_CAP_GAUGE = telemetry.gauge(
+    "gordo_coalesce_batch_cap", "Effective per-dispatch batch bound"
+)
+_STANDING_DOWN_GAUGE = telemetry.gauge(
+    "gordo_coalesce_standing_down",
+    "1 while the saturation stand-down routes requests direct",
+)
+
+
+def export_gauges(coalescer: Optional["CoalescingScorer"]) -> None:
+    """Refresh the point-in-time coalescer gauges (called by the server's
+    ``/metrics`` handler at scrape time — gauges describe 'now')."""
+    if coalescer is None:
+        return
+    _QUEUE_DEPTH_GAUGE.set(len(coalescer._queue))
+    _INFLIGHT_GAUGE.set(coalescer.inflight)
+    _BATCH_CAP_GAUGE.set(coalescer.batch_cap)
+    _STANDING_DOWN_GAUGE.set(1.0 if coalescer.standing_down else 0.0)
+
 
 #: knee sweep acceptance: doubling the batch must improve throughput by at
 #: least this factor to keep doubling (1.1 = 10% — below that the bigger
@@ -189,7 +254,11 @@ class CoalescingScorer:
         self._knee: Optional[int] = None
         self._knee_started = False
         self._cv = threading.Condition()
-        self._queue: List[Tuple[str, np.ndarray, Future, float]] = []
+        #: (name, X, future, enqueue time, trace id) — the trace id rides
+        #: the queue so dispatch spans can name every rider they carried
+        self._queue: List[
+            Tuple[str, np.ndarray, Future, float, Optional[str]]
+        ] = []
         self._closed = False
         self.n_dispatches = 0
         self.n_requests = 0
@@ -250,27 +319,32 @@ class CoalescingScorer:
                 self._provider(), rows=rows, max_batch=self.max_batch
             )
         except Exception:
+            _KNEE_ESTIMATES_TOTAL.inc(1.0, "failed")
             logger.exception(
                 "Knee estimation failed; batch cap stays at the pre-knee "
                 "bound"
             )
             return None
         if est is None:
+            _KNEE_ESTIMATES_TOTAL.inc(1.0, "no_bucket")
             return None
         if est["amortization"] < self.min_amortization:
             self._knee_no_gain = True
-            logger.warning(
-                "Coalescing disabled: batching amortizes only %.2fx a "
-                "single dispatch at the knee (< %.1fx) — sharing a "
-                "dispatch saves nothing on this backend, requests route "
-                "direct",
-                est["amortization"], self.min_amortization,
+            _KNEE_ESTIMATES_TOTAL.inc(1.0, "no_gain")
+            # one structured line: batching saves nothing on this backend,
+            # every future request routes direct for this scorer's lifetime
+            telemetry.log_event(
+                logger, "coalescer_knee_no_gain",
+                amortization=round(est["amortization"], 2),
+                min_amortization=self.min_amortization,
+                knee=int(est["knee"]),
             )
             return None
         self._knee = int(est["knee"])
-        logger.info(
-            "Coalescer batch knee estimated: %d (amortization %.1fx)",
-            self._knee, est["amortization"],
+        _KNEE_ESTIMATES_TOTAL.inc(1.0, "estimated")
+        telemetry.log_event(
+            logger, "coalescer_knee_estimated", level=logging.INFO,
+            knee=self._knee, amortization=round(est["amortization"], 2),
         )
         return self._knee
 
@@ -294,17 +368,19 @@ class CoalescingScorer:
             self._standdown_streak += 1
             self._standdown_until = time.monotonic() + cooldown
             self.n_standdowns += 1
+            _STANDDOWNS_TOTAL.inc()
             # waits reset (they describe the regime we just left); service
             # times stay — they remain valid and let a post-cooldown probe
             # re-evaluate after only ~signal_window/4 fresh waits
             self._waits.clear()
-            logger.warning(
-                "Coalescer standing down for %.2fs: queue wait p99 %.1fms "
-                "vs service median %.1fms (batching is losing; routing "
-                "direct)",
-                cooldown,
-                wait_p99 * 1e3,
-                med_service * 1e3,
+            # one structured line per stand-down (the satellite contract:
+            # these transitions were previously invisible at runtime)
+            telemetry.log_event(
+                logger, "coalescer_standdown",
+                cooldown_s=round(cooldown, 2),
+                wait_p99_ms=round(wait_p99 * 1e3, 1),
+                service_median_ms=round(med_service * 1e3, 1),
+                streak=self._standdown_streak,
             )
         else:
             # a healthy evaluation ends the escalation: the next
@@ -330,15 +406,20 @@ class CoalescingScorer:
         stand-down signal to accumulate."""
         if self._knee_no_gain or self.standing_down:
             self.n_bypassed += 1
+            _BYPASSED_TOTAL.inc(
+                1.0, "no_gain" if self._knee_no_gain else "standdown"
+            )
             return False
         if self.inflight < self.min_concurrency:
             self.n_bypassed += 1
+            _BYPASSED_TOTAL.inc(1.0, "low_concurrency")
             return False
         # len() on the queue list is GIL-atomic; a stale read only shifts
         # one request between two correct paths
         if len(self._queue) >= 2 * self.batch_cap:
             self.n_queue_full += 1
             self.n_bypassed += 1
+            _BYPASSED_TOTAL.inc(1.0, "queue_full")
             return False
         return True
 
@@ -354,14 +435,18 @@ class CoalescingScorer:
         self.n_queue_full = 0
         self.n_standdowns = 0
 
-    def submit(self, name: str, X: np.ndarray) -> Future:
+    def submit(
+        self, name: str, X: np.ndarray, trace_id: Optional[str] = None
+    ) -> Future:
         """Enqueue one machine's rows; the Future resolves to the same
-        arrays dict ``CompiledScorer.anomaly_arrays`` returns."""
+        arrays dict ``CompiledScorer.anomaly_arrays`` returns.
+        ``trace_id`` (the request's propagated id) tags the dispatch span
+        this request ends up riding."""
         fut: Future = Future()
         with self._cv:
             if self._closed:
                 raise RuntimeError("CoalescingScorer is closed")
-            self._queue.append((name, X, fut, time.monotonic()))
+            self._queue.append((name, X, fut, time.monotonic(), trace_id))
             self._cv.notify()
         return fut
 
@@ -376,7 +461,9 @@ class CoalescingScorer:
         self._fallback_pool.shutdown(wait=False)
 
     # -- worker side ---------------------------------------------------------
-    def _drain(self) -> List[Tuple[str, np.ndarray, Future, float]]:
+    def _drain(
+        self,
+    ) -> List[Tuple[str, np.ndarray, Future, float, Optional[str]]]:
         """Continuous drain: block for work, take what's queued (up to the
         knee cap) NOW.  The only wait is the single-rider grace — one
         queued request with peers still in flight holds ``max_wait_s`` for
@@ -413,17 +500,22 @@ class CoalescingScorer:
                         return
                     continue
                 t_dispatch = time.monotonic()
-                waits = [t_dispatch - t_enq for _, _, _, t_enq in batch]
+                waits = [t_dispatch - t_enq for _, _, _, t_enq, _ in batch]
+                for w in waits:
+                    _QUEUE_WAIT_SECONDS.observe(w)
+                _BATCH_SIZE.observe(len(batch))
                 # score_all keys by machine name, so duplicate-name requests
                 # split into successive rounds (each round has unique names)
-                rounds: List[Dict[str, Tuple[np.ndarray, Future]]] = []
-                for name, X, fut, _ in batch:
+                rounds: List[
+                    Dict[str, Tuple[np.ndarray, Future, Optional[str]]]
+                ] = []
+                for name, X, fut, _, tid in batch:
                     for rnd in rounds:
                         if name not in rnd:
-                            rnd[name] = (X, fut)
+                            rnd[name] = (X, fut, tid)
                             break
                     else:
-                        rounds.append({name: (X, fut)})
+                        rounds.append({name: (X, fut, tid)})
                 service = 0.0
                 for rnd in rounds:
                     service += self._score_round(rnd)
@@ -459,14 +551,17 @@ class CoalescingScorer:
             return
         self._finish(name, fut, out)
 
-    def _score_round(self, rnd: Dict[str, Tuple[np.ndarray, Future]]) -> float:
+    def _score_round(
+        self, rnd: Dict[str, Tuple[np.ndarray, Future, Optional[str]]]
+    ) -> float:
         """Dispatch one unique-name round; returns the device service time
         (0.0 when nothing reached a stacked dispatch)."""
         self.n_requests += len(rnd)
+        _REQUESTS_TOTAL.inc(len(rnd))
         try:
             scorer = self._provider()
         except Exception as exc:
-            for _, fut in rnd.values():
+            for _, fut, _ in rnd.values():
                 self._resolve(fut, exc=exc)
             return 0.0
         if not self._knee_started and not self.knee_batch:
@@ -474,15 +569,15 @@ class CoalescingScorer:
             # cap is max_batch (the r5 behavior); the sweep doubles as
             # subset-program warmup.  Row hint: this round's request shape.
             self._knee_started = True
-            rows = max(x.shape[0] for x, _ in rnd.values())
+            rows = max(x.shape[0] for x, _, _ in rnd.values())
             self._fallback_pool.submit(self.ensure_knee, rows)
         # machines outside the stacked buckets run FleetScorer's host-side
         # fallback (potentially 100s of ms each) — push those off the
         # worker so they can't head-of-line-block the fast stacked batch
         stacked = {}
-        for name, (X, fut) in rnd.items():
+        for name, (X, fut, tid) in rnd.items():
             if name in scorer.machine_bucket or name not in scorer.models:
-                stacked[name] = (X, fut)  # unknown names error in-slot
+                stacked[name] = (X, fut, tid)  # unknown names error in-slot
             else:
                 self.n_fallback += 1
                 self._fallback_pool.submit(
@@ -492,29 +587,44 @@ class CoalescingScorer:
             return 0.0
         rnd = stacked
         self.n_dispatches += 1
+        _DISPATCHES_TOTAL.inc()
         t0 = time.monotonic()
-        try:
-            # dispatch_all runs the device work (stack → dispatch →
-            # device_get) and defers per-machine assembly; scorers without
-            # the split API (tests, exotic providers) do both here
-            dispatch = getattr(scorer, "dispatch_all", None)
-            X_map = {n: x for n, (x, _) in rnd.items()}
-            pending = dispatch(X_map) if dispatch is not None else (
-                scorer.score_all(X_map)
-            )
-        except Exception as exc:  # whole-dispatch failure: fail each future
-            logger.exception("Coalesced dispatch failed")
-            for _, fut in rnd.values():
-                self._resolve(fut, exc=exc)
-            return time.monotonic() - t0
+        # the dispatch span carries every rider's propagated trace id, so
+        # a request's timeline can be followed INTO the shared dispatch
+        riders = sorted(
+            {tid for _, _, tid in rnd.values() if tid is not None}
+        )
+        with telemetry.span(
+            "coalesce.dispatch", batch=len(rnd), traces=riders
+        ):
+            try:
+                # dispatch_all runs the device work (stack → dispatch →
+                # device_get) and defers per-machine assembly; scorers
+                # without the split API (tests, exotic providers) do both
+                # here
+                dispatch = getattr(scorer, "dispatch_all", None)
+                X_map = {n: x for n, (x, _, _) in rnd.items()}
+                pending = dispatch(X_map) if dispatch is not None else (
+                    scorer.score_all(X_map)
+                )
+            except Exception as exc:  # whole-dispatch failure: fail futures
+                logger.exception("Coalesced dispatch failed")
+                for _, fut, _ in rnd.values():
+                    self._resolve(fut, exc=exc)
+                service = time.monotonic() - t0
+                _DISPATCH_SECONDS.observe(service)
+                return service
         service = time.monotonic() - t0
+        _DISPATCH_SECONDS.observe(service)
         # per-request result assembly + future resolution run on the
         # finish pool: the drain thread is free to gather the next batch
         self._finish_pool.submit(self._finish_round, rnd, pending)
         return service
 
     def _finish_round(
-        self, rnd: Dict[str, Tuple[np.ndarray, Future]], pending: Any
+        self,
+        rnd: Dict[str, Tuple[np.ndarray, Future, Optional[str]]],
+        pending: Any,
     ) -> None:
         """Assemble per-machine results (host-side numpy slicing) and
         resolve the round's futures — off the drain thread."""
@@ -523,10 +633,10 @@ class CoalescingScorer:
             out = assemble() if assemble is not None else pending
         except Exception as exc:
             logger.exception("Coalesced result assembly failed")
-            for _, fut in rnd.values():
+            for _, fut, _ in rnd.values():
                 self._resolve(fut, exc=exc)
             return
-        for name, (_, fut) in rnd.items():
+        for name, (_, fut, _) in rnd.items():
             self._finish(name, fut, out)
 
     def _finish(self, name: str, fut: Future, out: Dict[str, Any]) -> None:

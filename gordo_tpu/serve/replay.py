@@ -218,6 +218,25 @@ async def _replay(
                 response_bytes += sum(sizes)
             dt = time.perf_counter() - t0
             n_measured = sum(len(rnd) for rnd in bodies[1:])
+
+        # scrape the server's own /metrics over the same TCP surface:
+        # every replay run doubles as the assertion that the instrumented
+        # server emits parseable Prometheus text under load (the tier-1
+        # lane's scrape check rides tests/test_server.py's replay smoke)
+        async with session.get(
+            f"http://127.0.0.1:{port}/metrics"
+        ) as resp:
+            metrics_text = await resp.text()
+        metrics_scrape = {
+            "status": resp.status,
+            "families": metrics_text.count("# TYPE "),
+            "has_request_histogram": (
+                "gordo_server_request_seconds_bucket" in metrics_text
+            ),
+            "has_coalescer_gauges": (
+                "gordo_coalesce_batch_cap" in metrics_text
+            ),
+        }
     coalescer_stats = None
     if coalesce_window_ms > 0:
         from gordo_tpu.serve import coalesce as coalesce_mod
@@ -249,6 +268,9 @@ async def _replay(
         "latency_n": len(latencies),
         "latency_p50_ms": float(p50 * 1e3),
         "latency_p99_ms": float(p99 * 1e3),
+        # how the in-run /metrics scrape went (status, family count, and
+        # whether the serving instruments were present in the exposition)
+        "metrics_scrape": metrics_scrape,
     }
     if arrival_rate_hz > 0:
         out["open_loop"] = True

@@ -31,7 +31,7 @@ import pandas as pd
 from aiohttp import web
 
 import gordo_tpu
-from gordo_tpu import serializer
+from gordo_tpu import serializer, telemetry
 from gordo_tpu.serve import codec
 from gordo_tpu.serve import coalesce as coalesce_mod
 from gordo_tpu.serve.scorer import CompiledScorer
@@ -39,6 +39,67 @@ from gordo_tpu.serve.scorer import CompiledScorer
 logger = logging.getLogger(__name__)
 
 API_PREFIX = "/gordo/v0"
+
+# -- telemetry instruments (see docs/observability.md for the catalog) ------
+_REQUEST_SECONDS = telemetry.histogram(
+    "gordo_server_request_seconds",
+    "End-to-end request handling time by route pattern and response codec",
+    labels=("route", "codec"),
+)
+_REQUESTS_TOTAL = telemetry.counter(
+    "gordo_server_requests_total",
+    "Requests served by route pattern and HTTP status",
+    labels=("route", "status"),
+)
+_MACHINES_GAUGE = telemetry.gauge(
+    "gordo_server_machines",
+    "Machines currently loaded in this server's collection",
+)
+
+#: Prometheus exposition content type (text format 0.0.4)
+METRICS_CONTENT_TYPE = "text/plain"
+
+
+def _codec_label(content_type: Optional[str]) -> str:
+    if content_type == codec.MSGPACK_CONTENT_TYPE:
+        return "msgpack"
+    if content_type == "application/json":
+        return "json"
+    return "other"
+
+
+@web.middleware
+async def telemetry_middleware(request: web.Request, handler):
+    """Per-request observability: a trace id from the ``X-Gordo-Trace-Id``
+    header (minted when absent) binds to the handler's context and echoes
+    back on the response; every request lands in the per-route/per-codec
+    request histogram and the route/status counter.  Route label is the
+    matched ROUTE PATTERN (``{machine}`` stays a placeholder), so
+    cardinality is bounded by the route table, not the fleet."""
+    trace_id = request.headers.get(telemetry.TRACE_HEADER) or (
+        telemetry.new_trace_id()
+    )
+    telemetry.set_trace_id(trace_id)
+    t0 = time.perf_counter()
+    status = 500
+    codec_label = "other"
+    try:
+        resp = await handler(request)
+        status = resp.status
+        codec_label = _codec_label(resp.content_type)
+        resp.headers[telemetry.TRACE_HEADER] = trace_id
+        return resp
+    except web.HTTPException as exc:
+        status = exc.status
+        exc.headers[telemetry.TRACE_HEADER] = trace_id
+        raise
+    finally:
+        resource = request.match_info.route.resource
+        route = resource.canonical if resource is not None else "unmatched"
+        _REQUEST_SECONDS.observe(
+            time.perf_counter() - t0, route, codec_label
+        )
+        _REQUESTS_TOTAL.inc(1.0, route, str(status))
 
 COLLECTION_KEY: "web.AppKey[ModelCollection]" = web.AppKey(
     "collection", object
@@ -390,7 +451,10 @@ async def prediction(request: web.Request) -> web.Response:
         return web.json_response({"error": str(exc)}, status=400)
     loop = asyncio.get_running_loop()
     try:
-        out = await loop.run_in_executor(None, entry.scorer.predict, X)
+        with telemetry.span(
+            "server.predict", machine=entry.name, rows=X.shape[0]
+        ):
+            out = await loop.run_in_executor(None, entry.scorer.predict, X)
     except ValueError as exc:  # client-input problem (e.g. short rows)
         return web.json_response({"error": str(exc)}, status=400)
     except Exception as exc:
@@ -424,30 +488,39 @@ async def anomaly_prediction(request: web.Request) -> web.Response:
         return web.json_response({"error": str(exc)}, status=400)
     loop = asyncio.get_running_loop()
     coalescer = request.app.get(COALESCER_KEY)
+    score_span = telemetry.span(
+        "server.anomaly", machine=entry.name, rows=X.shape[0]
+    )
     try:
-        if coalescer is not None and y is None:
-            # handlers run on the single-threaded event loop, so the
-            # inflight counter needs no lock; it counts EVERY in-flight
-            # single-machine anomaly request (direct or coalesced) — the
-            # concurrency signal the adaptive bypass keys on
-            coalescer.inflight += 1
-            try:
-                if coalescer.should_coalesce():
-                    # concurrent requests across machines merge into one
-                    # stacked dispatch (the _bulk route's program family)
-                    out = await asyncio.wrap_future(
-                        coalescer.submit(entry.name, X)
-                    )
-                else:  # too few riders: direct dispatch wins — bypass
-                    out = await loop.run_in_executor(
-                        None, entry.scorer.anomaly_arrays, X, None
-                    )
-            finally:
-                coalescer.inflight -= 1
-        else:
-            out = await loop.run_in_executor(
-                None, entry.scorer.anomaly_arrays, X, y
-            )
+        with score_span:
+            if coalescer is not None and y is None:
+                # handlers run on the single-threaded event loop, so the
+                # inflight counter needs no lock; it counts EVERY in-flight
+                # single-machine anomaly request (direct or coalesced) —
+                # the concurrency signal the adaptive bypass keys on
+                coalescer.inflight += 1
+                try:
+                    if coalescer.should_coalesce():
+                        # concurrent requests across machines merge into
+                        # one stacked dispatch (the _bulk route's program
+                        # family)
+                        out = await asyncio.wrap_future(
+                            coalescer.submit(
+                                entry.name,
+                                X,
+                                trace_id=telemetry.current_trace_id(),
+                            )
+                        )
+                    else:  # too few riders: direct dispatch wins — bypass
+                        out = await loop.run_in_executor(
+                            None, entry.scorer.anomaly_arrays, X, None
+                        )
+                finally:
+                    coalescer.inflight -= 1
+            else:
+                out = await loop.run_in_executor(
+                    None, entry.scorer.anomaly_arrays, X, y
+                )
     except ValueError as exc:  # client-input problem (e.g. short rows)
         return web.json_response({"error": str(exc)}, status=400)
     except Exception as exc:
@@ -522,9 +595,10 @@ async def bulk_anomaly_prediction(request: web.Request) -> web.Response:
     try:
         # resolve the lazy scorer inside the executor too: first-call param
         # stacking for a large project must not stall the accept loop
-        out = await loop.run_in_executor(
-            None, lambda: collection.fleet_scorer.score_all(X_by_name)
-        )
+        with telemetry.span("server.bulk", machines=len(X_by_name)):
+            out = await loop.run_in_executor(
+                None, lambda: collection.fleet_scorer.score_all(X_by_name)
+            )
     except Exception as exc:
         logger.exception("Bulk anomaly scoring failed")
         return web.json_response({"error": str(exc)}, status=500)
@@ -574,6 +648,21 @@ async def readiness(request: web.Request) -> web.Response:
             {"ready": False, "reason": "warmup in progress"}, status=503
         )
     return web.json_response({"ready": True})
+
+
+async def metrics_endpoint(request: web.Request) -> web.Response:
+    """Prometheus scrape surface (mounted at ``/metrics``, where every
+    scraper looks by default).  Point-in-time gauges (collection size,
+    coalescer queue/policy state) refresh at scrape time — they describe
+    "now", so sampling them on the read side is both cheaper and more
+    honest than pushing every transition."""
+    collection = request.app.get(COLLECTION_KEY)
+    if collection is not None:
+        _MACHINES_GAUGE.set(len(collection.entries))
+    coalesce_mod.export_gauges(request.app.get(COALESCER_KEY))
+    return web.Response(
+        text=telemetry.render(), content_type=METRICS_CONTENT_TYPE
+    )
 
 
 async def project_index(request: web.Request) -> web.Response:
@@ -719,7 +808,10 @@ def build_app(
     from gordo_tpu.utils.compile_cache import enable_persistent_compile_cache
 
     enable_persistent_compile_cache()
-    app = web.Application(client_max_size=256 * 1024 * 1024)
+    app = web.Application(
+        client_max_size=256 * 1024 * 1024,
+        middlewares=[telemetry_middleware],
+    )
     app[COLLECTION_KEY] = collection
 
     if warmup:
@@ -817,6 +909,9 @@ def build_app(
         app.on_startup.append(_start)
         app.on_cleanup.append(_stop)
 
+    # scrape surface at the conventional root path (no project segment:
+    # one process = one scrape target, whatever it hosts)
+    app.router.add_get("/metrics", metrics_endpoint)
     p = f"{API_PREFIX}/{{project}}"
     app.router.add_get(f"{p}/", project_index)
     app.router.add_get(f"{p}/ready", readiness)

@@ -1,0 +1,69 @@
+"""Fleet-wide observability: metrics, Prometheus exposition, trace spans.
+
+The telemetry plane every layer reports through:
+
+- :mod:`gordo_tpu.telemetry.metrics` — process-wide registry of counters,
+  gauges and fixed-bucket histograms; Prometheus text exposition
+  (``serve/server.py`` mounts it at ``GET /metrics``); JSON snapshots the
+  multi-host builder writes per shard and watchman/CLI merge.
+- :mod:`gordo_tpu.telemetry.spans` — wall-clock trace spans with a
+  context-propagated trace id (``X-Gordo-Trace-Id`` header), layered on
+  top of the opt-in ``utils/profiling.trace`` jax-profiler hook.
+
+Kill switch: ``GORDO_TELEMETRY=off`` (or :func:`set_enabled`) turns every
+record call into a cheap no-op; ``bench.py --stage telemetry_overhead``
+attests the instrumented hot path costs <= 2% vs the switch.
+"""
+
+from gordo_tpu.telemetry.metrics import (  # noqa: F401
+    REGISTRY,
+    MetricsRegistry,
+    add_instance_label,
+    counter,
+    enabled,
+    gauge,
+    histogram,
+    load_snapshot_dir,
+    log_event,
+    merge_expositions,
+    merge_snapshots,
+    render,
+    render_snapshot,
+    set_enabled,
+)
+from gordo_tpu.telemetry.spans import (  # noqa: F401
+    TRACE_HEADER,
+    current_trace_id,
+    ensure_trace_id,
+    new_trace_id,
+    set_trace_id,
+    span,
+)
+
+#: directory (under a build's output dir) where shard-local metric
+#: snapshots land — one file per process of a (multi-host) project build
+SNAPSHOT_DIR = ".gordo-telemetry"
+
+__all__ = [
+    "REGISTRY",
+    "MetricsRegistry",
+    "SNAPSHOT_DIR",
+    "TRACE_HEADER",
+    "add_instance_label",
+    "counter",
+    "current_trace_id",
+    "enabled",
+    "ensure_trace_id",
+    "gauge",
+    "histogram",
+    "load_snapshot_dir",
+    "log_event",
+    "merge_expositions",
+    "merge_snapshots",
+    "new_trace_id",
+    "render",
+    "render_snapshot",
+    "set_enabled",
+    "set_trace_id",
+    "span",
+]

@@ -1,0 +1,536 @@
+"""Process-wide metrics registry with Prometheus text exposition.
+
+Reference status: absent upstream — the reference recorded build wall-times
+into artifact metadata and nothing else (SURVEY.md §6.1); serving and fleet
+behavior were unobservable at runtime.  Production ML systems treat
+monitoring as a first-class subsystem (the TensorFlow paper ships a whole
+metrics plane), and the adaptive machinery this repo grew in r6/r7 (knee
+estimation, saturation stand-downs, barrier timeouts, resumable exits) is
+exactly the kind of behavior that must be visible while it happens, not
+reconstructed from logs afterwards.
+
+Design constraints, in priority order:
+
+- **Hot-path cheap.**  A counter increment on the serve path is a dict
+  lookup plus a float add under a per-metric lock (uncontended in
+  practice: the GIL serializes the adds and the lock only arbitrates the
+  rare first-touch of a new label set).  The ``GORDO_TELEMETRY=off`` kill
+  switch turns every record call into one attribute read and a return —
+  the bench's ``telemetry_overhead`` stage holds the instrumented path to
+  <= 2% of the disabled one.
+- **Dependency-free.**  No prometheus_client in the image; the text
+  exposition format is simple enough to emit directly, and owning it
+  keeps the registry snapshot-able as JSON (the multi-host builder writes
+  shard-local snapshots that watchman/CLI merge).
+- **One naming convention.**  Every metric name must match
+  ``gordo_[a-z_]+`` (enforced here at registration AND statically by
+  ``scripts/lint.py``), with the usual Prometheus suffix conventions:
+  ``*_total`` for counters, ``*_seconds`` for time histograms.
+
+The module-level :data:`REGISTRY` is the process's default; components
+register their instruments at import time via :func:`counter` /
+:func:`gauge` / :func:`histogram` (get-or-create, so re-imports and tests
+share series instead of colliding).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import threading
+import time
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+ENV_TELEMETRY = "GORDO_TELEMETRY"
+
+#: the catalog rule: lowercase, underscore-separated, gordo-prefixed.
+#: scripts/lint.py enforces the same pattern statically over the repo so a
+#: misnamed metric fails CI before it ever registers.
+NAME_RE = re.compile(r"^gordo_[a-z_]+$")
+
+#: default latency buckets (seconds): sub-ms device dispatches through
+#: multi-second cold compiles.  Histograms are fixed-bucket by design —
+#: per-observation cost is one binary search, and fixed buckets merge
+#: trivially across shard snapshots and scraped endpoints.
+DEFAULT_TIME_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+#: batch/queue-size buckets (counts, not seconds)
+DEFAULT_SIZE_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
+
+
+def _env_enabled() -> bool:
+    return os.environ.get(ENV_TELEMETRY, "").lower() not in (
+        "off", "0", "false", "disabled",
+    )
+
+
+def _escape_label(value: str) -> str:
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace("\n", "\\n")
+        .replace('"', '\\"')
+    )
+
+
+def _fmt(value: float) -> str:
+    """Prometheus sample value: integers render without the trailing .0
+    noise, everything else as repr (shortest round-trip)."""
+    f = float(value)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+class _Metric:
+    """Shared label-series bookkeeping for the three instrument kinds."""
+
+    kind = "untyped"
+
+    def __init__(self, registry: "MetricsRegistry", name: str, help: str,
+                 labels: Sequence[str] = ()):
+        if not NAME_RE.match(name):
+            raise ValueError(
+                f"metric name {name!r} violates the catalog convention "
+                f"{NAME_RE.pattern!r} (see docs/observability.md)"
+            )
+        self.registry = registry
+        self.name = name
+        self.help = help
+        self.label_names = tuple(labels)
+        self._lock = threading.Lock()
+        self._series: Dict[Tuple[str, ...], Any] = {}
+
+    def _key(self, label_values: Tuple[Any, ...]) -> Tuple[str, ...]:
+        if len(label_values) != len(self.label_names):
+            raise ValueError(
+                f"{self.name} expects {len(self.label_names)} label "
+                f"value(s) {self.label_names}, got {label_values!r}"
+            )
+        return tuple(str(v) for v in label_values)
+
+    def _series_lines(self) -> List[str]:
+        raise NotImplementedError
+
+    def render(self) -> List[str]:
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} {self.kind}",
+        ]
+        lines.extend(self._series_lines())
+        return lines
+
+    def _label_str(self, key: Tuple[str, ...], extra: str = "") -> str:
+        parts = [
+            f'{n}="{_escape_label(v)}"'
+            for n, v in zip(self.label_names, key)
+        ]
+        if extra:
+            parts.append(extra)
+        return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class Counter(_Metric):
+    """Monotonic float counter, optionally labeled."""
+
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, *label_values: Any) -> None:
+        if not self.registry.enabled:
+            return
+        key = self._key(label_values)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + amount
+
+    def value(self, *label_values: Any) -> float:
+        return float(self._series.get(self._key(label_values), 0.0))
+
+    def _series_lines(self) -> List[str]:
+        with self._lock:
+            items = sorted(self._series.items())
+        return [
+            f"{self.name}{self._label_str(k)} {_fmt(v)}" for k, v in items
+        ]
+
+
+class Gauge(_Metric):
+    """Last-write-wins instantaneous value, optionally labeled."""
+
+    kind = "gauge"
+
+    def set(self, value: float, *label_values: Any) -> None:
+        if not self.registry.enabled:
+            return
+        key = self._key(label_values)
+        with self._lock:
+            self._series[key] = float(value)
+
+    def value(self, *label_values: Any) -> float:
+        return float(self._series.get(self._key(label_values), 0.0))
+
+    def _series_lines(self) -> List[str]:
+        with self._lock:
+            items = sorted(self._series.items())
+        return [
+            f"{self.name}{self._label_str(k)} {_fmt(v)}" for k, v in items
+        ]
+
+
+class Histogram(_Metric):
+    """Fixed-bucket histogram with ``le``-inclusive Prometheus semantics.
+
+    Per-bucket counts are stored non-cumulative (merging shard snapshots
+    is then plain addition); exposition renders the cumulative form the
+    text format requires.
+    """
+
+    kind = "histogram"
+
+    def __init__(self, registry, name, help, labels=(),
+                 buckets: Sequence[float] = DEFAULT_TIME_BUCKETS):
+        super().__init__(registry, name, help, labels)
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        if not self.buckets:
+            raise ValueError(f"{name}: histogram needs at least one bucket")
+
+    def observe(self, value: float, *label_values: Any) -> None:
+        if not self.registry.enabled:
+            return
+        key = self._key(label_values)
+        with self._lock:
+            state = self._series.get(key)
+            if state is None:
+                # [per-bucket counts..., +Inf count], sum, count
+                state = [[0] * (len(self.buckets) + 1), 0.0, 0]
+                self._series[key] = state
+            counts, _, _ = state
+            i = 0
+            for i, bound in enumerate(self.buckets):
+                if value <= bound:
+                    counts[i] += 1
+                    break
+            else:
+                counts[len(self.buckets)] += 1
+            state[1] += float(value)
+            state[2] += 1
+
+    def snapshot_series(self, *label_values: Any) -> Dict[str, Any]:
+        state = self._series.get(self._key(label_values))
+        if state is None:
+            return {"counts": [0] * (len(self.buckets) + 1),
+                    "sum": 0.0, "count": 0}
+        return {
+            "counts": list(state[0]), "sum": state[1], "count": state[2],
+        }
+
+    def _series_lines(self) -> List[str]:
+        with self._lock:
+            items = sorted(
+                (k, [list(v[0]), v[1], v[2]]) for k, v in self._series.items()
+            )
+        lines: List[str] = []
+        for key, (counts, total, count) in items:
+            cum = 0
+            for bound, c in zip(self.buckets, counts):
+                cum += c
+                le = 'le="%s"' % _fmt(bound)
+                lines.append(
+                    f"{self.name}_bucket{self._label_str(key, le)} {cum}"
+                )
+            cum += counts[-1]
+            le_inf = 'le="+Inf"'
+            lines.append(
+                f"{self.name}_bucket{self._label_str(key, le_inf)} {cum}"
+            )
+            lines.append(f"{self.name}_sum{self._label_str(key)} {_fmt(total)}")
+            lines.append(f"{self.name}_count{self._label_str(key)} {count}")
+        return lines
+
+
+class MetricsRegistry:
+    """Get-or-create instrument registry + exposition/snapshot surface."""
+
+    def __init__(self, enabled: Optional[bool] = None):
+        self.enabled = _env_enabled() if enabled is None else enabled
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def set_enabled(self, enabled: bool) -> None:
+        """Runtime kill switch (env ``GORDO_TELEMETRY=off`` sets the
+        initial state; benches toggle it to measure their own overhead).
+        Disabling stops recording; registered series keep their values."""
+        self.enabled = bool(enabled)
+
+    def _get_or_create(self, cls, name, help, labels, **kw) -> _Metric:
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if type(existing) is not cls or (
+                    existing.label_names != tuple(labels)
+                ):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{existing.kind} with labels {existing.label_names}"
+                    )
+                return existing
+            metric = cls(self, name, help, labels, **kw)
+            self._metrics[name] = metric
+            return metric
+
+    def counter(self, name: str, help: str,
+                labels: Sequence[str] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help, labels)
+
+    def gauge(self, name: str, help: str,
+              labels: Sequence[str] = ()) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labels)
+
+    def histogram(self, name: str, help: str, labels: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_TIME_BUCKETS) -> Histogram:
+        return self._get_or_create(
+            Histogram, name, help, labels, buckets=buckets
+        )
+
+    def get(self, name: str) -> Optional[_Metric]:
+        return self._metrics.get(name)
+
+    def render(self) -> str:
+        """The full Prometheus text exposition (``text/plain; version=0.0.4``)."""
+        lines: List[str] = []
+        for name in sorted(self._metrics):
+            lines.extend(self._metrics[name].render())
+        return "\n".join(lines) + "\n"
+
+    # -- snapshots (shard-local files the fleet layers merge) ---------------
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-able dump of every series.  Counter/histogram series merge
+        across snapshots by addition; gauges are last-write (the merge
+        keeps the value from the latest snapshot)."""
+        out: Dict[str, Any] = {}
+        for name, metric in sorted(self._metrics.items()):
+            doc: Dict[str, Any] = {
+                "kind": metric.kind,
+                "help": metric.help,
+                "labels": list(metric.label_names),
+            }
+            if isinstance(metric, Histogram):
+                doc["buckets"] = list(metric.buckets)
+                doc["series"] = {
+                    json.dumps(list(k)): {
+                        "counts": list(v[0]), "sum": v[1], "count": v[2],
+                    }
+                    for k, v in metric._series.items()
+                }
+            else:
+                doc["series"] = {
+                    json.dumps(list(k)): v
+                    for k, v in metric._series.items()
+                }
+            out[name] = doc
+        return {"gordo_telemetry_snapshot": 1, "time": time.time(),
+                "metrics": out}
+
+    def write_snapshot(self, path: str) -> None:
+        """Atomic snapshot dump (tmp + rename, like the shard state files:
+        a SIGKILL mid-write must not leave a torn document)."""
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(self.snapshot(), f, indent=1)
+        os.replace(tmp, path)
+
+
+def merge_snapshots(snapshots: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+    """Merge snapshot documents (shard-local files from a multi-host
+    build, or per-process dumps): counters and histogram series add,
+    gauges take the value from the latest-``time`` snapshot."""
+    merged: Dict[str, Any] = {}
+    merged_time: Dict[str, Dict[str, float]] = {}
+    out_time = 0.0
+    for snap in snapshots:
+        snap_time = float(snap.get("time", 0.0))
+        out_time = max(out_time, snap_time)
+        for name, doc in snap.get("metrics", {}).items():
+            into = merged.get(name)
+            if into is None:
+                merged[name] = json.loads(json.dumps(doc))  # deep copy
+                merged_time[name] = {
+                    k: snap_time for k in doc.get("series", {})
+                }
+                continue
+            series_time = merged_time[name]
+            for key, value in doc.get("series", {}).items():
+                if key not in into["series"]:
+                    into["series"][key] = json.loads(json.dumps(value))
+                    series_time[key] = snap_time
+                elif doc["kind"] == "histogram":
+                    tgt = into["series"][key]
+                    tgt["counts"] = [
+                        a + b for a, b in zip(tgt["counts"], value["counts"])
+                    ]
+                    tgt["sum"] += value["sum"]
+                    tgt["count"] += value["count"]
+                elif doc["kind"] == "gauge":
+                    if snap_time >= series_time.get(key, 0.0):
+                        into["series"][key] = value
+                        series_time[key] = snap_time
+                else:  # counter
+                    into["series"][key] += value
+    return {"gordo_telemetry_snapshot": 1, "time": out_time,
+            "metrics": merged}
+
+
+def render_snapshot(snapshot: Dict[str, Any]) -> str:
+    """Snapshot document → Prometheus text (loads into a throwaway
+    registry so exposition has exactly one implementation)."""
+    reg = MetricsRegistry(enabled=True)
+    for name, doc in sorted(snapshot.get("metrics", {}).items()):
+        labels = doc.get("labels", [])
+        if doc["kind"] == "histogram":
+            h = reg.histogram(name, doc.get("help", ""), labels,
+                              buckets=doc.get("buckets") or DEFAULT_TIME_BUCKETS)
+            for key, v in doc.get("series", {}).items():
+                h._series[tuple(json.loads(key))] = [
+                    list(v["counts"]), v["sum"], v["count"],
+                ]
+        else:
+            m = (reg.counter if doc["kind"] == "counter" else reg.gauge)(
+                name, doc.get("help", ""), labels
+            )
+            for key, v in doc.get("series", {}).items():
+                m._series[tuple(json.loads(key))] = float(v)
+    return reg.render()
+
+
+def load_snapshot_dir(directory: str) -> List[Dict[str, Any]]:
+    """All snapshot JSONs under ``directory`` (the ``.gordo-telemetry/``
+    dir a project build maintains — one file per shard/process)."""
+    snaps: List[Dict[str, Any]] = []
+    if not os.path.isdir(directory):
+        return snaps
+    for fname in sorted(os.listdir(directory)):
+        if not fname.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(directory, fname)) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if doc.get("gordo_telemetry_snapshot"):
+            snaps.append(doc)
+    return snaps
+
+
+def add_instance_label(exposition: str, instance: str) -> str:
+    """Inject ``instance="<url>"`` into every sample of a Prometheus text
+    exposition — how watchman merges N endpoints' scrapes without
+    guessing merge semantics (summing a ``batch_cap`` gauge across
+    servers would be a lie; per-instance series are just the truth)."""
+    out: List[str] = []
+    esc = _escape_label(instance)
+    for line in exposition.splitlines():
+        if not line or line.startswith("#"):
+            out.append(line)
+            continue
+        name_part, _, value_part = line.rpartition(" ")
+        if not name_part:
+            out.append(line)
+            continue
+        if name_part.endswith("}"):
+            rewritten = name_part[:-1] + f',instance="{esc}"}}'
+        else:
+            rewritten = name_part + f'{{instance="{esc}"}}'
+        out.append(f"{rewritten} {value_part}")
+    return "\n".join(out) + ("\n" if exposition.endswith("\n") else "")
+
+
+def merge_expositions(pairs: Sequence[Tuple[str, str]]) -> str:
+    """Merge N Prometheus text expositions into one, tagging every sample
+    with ``instance="<id>"`` (``pairs`` is ``[(instance_id, text), ...]``).
+
+    Families regroup so each metric's samples stay contiguous under one
+    HELP/TYPE header — the text format requires all lines of a family in
+    a single group, which naive concatenation of per-target scrapes
+    violates.  Conflicting HELP strings keep the first seen.
+    """
+    help_lines: Dict[str, str] = {}
+    type_lines: Dict[str, str] = {}
+    samples: Dict[str, List[str]] = {}
+    for instance, text in pairs:
+        labeled = add_instance_label(text, instance)
+        family: Optional[str] = None
+        for line in labeled.splitlines():
+            if line.startswith("# HELP ") or line.startswith("# TYPE "):
+                parts = line.split(None, 3)
+                if len(parts) < 3:
+                    continue
+                family = parts[2]
+                target = help_lines if parts[1] == "HELP" else type_lines
+                target.setdefault(family, line)
+            elif line.strip() and not line.startswith("#"):
+                # samples attach to the family block they appeared under;
+                # a headerless line keys by its own bare metric name
+                key = family or line.split("{", 1)[0].split(" ", 1)[0]
+                samples.setdefault(key, []).append(line)
+    out: List[str] = []
+    for name in sorted(set(samples) | set(type_lines)):
+        if name in help_lines:
+            out.append(help_lines[name])
+        if name in type_lines:
+            out.append(type_lines[name])
+        out.extend(samples.get(name, ()))
+    return "\n".join(out) + "\n"
+
+
+#: the process-wide default registry every component records into
+REGISTRY = MetricsRegistry()
+
+
+def enabled() -> bool:
+    return REGISTRY.enabled
+
+
+def set_enabled(value: bool) -> None:
+    REGISTRY.set_enabled(value)
+
+
+def counter(name: str, help: str, labels: Sequence[str] = ()) -> Counter:
+    return REGISTRY.counter(name, help, labels)
+
+
+def gauge(name: str, help: str, labels: Sequence[str] = ()) -> Gauge:
+    return REGISTRY.gauge(name, help, labels)
+
+
+def histogram(name: str, help: str, labels: Sequence[str] = (),
+              buckets: Sequence[float] = DEFAULT_TIME_BUCKETS) -> Histogram:
+    return REGISTRY.histogram(name, help, labels, buckets=buckets)
+
+
+def render() -> str:
+    return REGISTRY.render()
+
+
+#: every structured event increments this, so event rates are queryable
+#: even when nobody tails the logs
+_EVENTS = REGISTRY.counter(
+    "gordo_events_total",
+    "Structured operational events by name (see docs/observability.md)",
+    labels=("event",),
+)
+
+
+def log_event(target_logger: logging.Logger, event: str,
+              level: int = logging.WARNING, **fields: Any) -> None:
+    """Count + log one operational event as a SINGLE structured line:
+    ``EVENT <name> key=value ...`` — grep-able, parse-able, and exactly
+    one line per occurrence (the satellite contract for stand-downs,
+    knee estimates, barrier timeouts and resumable exits)."""
+    _EVENTS.inc(1.0, event)
+    parts = " ".join(f"{k}={v}" for k, v in fields.items())
+    target_logger.log(level, "EVENT %s %s", event, parts)

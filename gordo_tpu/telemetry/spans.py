@@ -1,0 +1,127 @@
+"""Wall-clock trace spans with a context-propagated trace id.
+
+This is the request-scoped half of the telemetry plane: where
+``telemetry.metrics`` answers "how often / how slow on aggregate",
+spans answer "where did THIS request's time go" — client → HTTP header →
+server handler → coalescer dispatch → scorer, all stitched by one trace
+id riding the ``X-Gordo-Trace-Id`` header.
+
+Layering: spans sit ON TOP of ``utils/profiling.trace`` (the opt-in
+``jax.profiler`` hook), not instead of it.  The profiler answers
+"what did XLA do inside this section" at Perfetto granularity when
+``GORDO_PROFILE_DIR`` is set; spans are always-on wall-clock timing that
+feeds the ``gordo_span_seconds`` histogram and (optionally) a JSONL span
+log, cheap enough for every request.
+
+Span log: set ``GORDO_SPAN_LOG=/path/spans.jsonl`` and every finished
+span appends one JSON line ``{ts, trace, span, seconds, ...attrs}``.
+Off by default — the histograms alone carry the aggregate signal.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import logging
+import os
+import threading
+import time
+import uuid
+from typing import Any, Dict, Iterator, Optional
+
+from gordo_tpu.telemetry import metrics
+
+logger = logging.getLogger(__name__)
+
+#: the propagation header: clients send it, servers echo it back and tag
+#: their spans with it; absent on ingress the server mints one so every
+#: request is traceable end-to-end regardless of the caller
+TRACE_HEADER = "X-Gordo-Trace-Id"
+
+ENV_SPAN_LOG = "GORDO_SPAN_LOG"
+
+_trace_id: "contextvars.ContextVar[Optional[str]]" = contextvars.ContextVar(
+    "gordo_trace_id", default=None
+)
+
+_SPAN_SECONDS = metrics.histogram(
+    "gordo_span_seconds",
+    "Wall-clock duration of named trace spans",
+    labels=("span",),
+)
+
+_log_lock = threading.Lock()
+
+
+def new_trace_id() -> str:
+    """16-hex-char trace id (random; uniqueness, not secrecy)."""
+    return uuid.uuid4().hex[:16]
+
+
+def current_trace_id() -> Optional[str]:
+    """The trace id bound to this execution context, or None."""
+    return _trace_id.get()
+
+
+def set_trace_id(trace_id: Optional[str]) -> "contextvars.Token":
+    """Bind a trace id to the current context (handlers call this on
+    ingress); returns the token for symmetric reset."""
+    return _trace_id.set(trace_id)
+
+
+def ensure_trace_id() -> str:
+    """Current trace id, minting and binding one if absent."""
+    tid = _trace_id.get()
+    if tid is None:
+        tid = new_trace_id()
+        _trace_id.set(tid)
+    return tid
+
+
+def span_log_path() -> Optional[str]:
+    return os.environ.get(ENV_SPAN_LOG) or None
+
+
+def _write_span_line(doc: Dict[str, Any]) -> None:
+    path = span_log_path()
+    if not path:
+        return
+    try:
+        line = json.dumps(doc)
+        with _log_lock:
+            with open(path, "a") as f:
+                f.write(line + "\n")
+    except Exception:  # the span log must never break the traced path
+        logger.exception("span log append failed")
+
+
+@contextlib.contextmanager
+def span(name: str, trace_id: Optional[str] = None,
+         **attrs: Any) -> Iterator[Dict[str, Any]]:
+    """Time a section: feeds ``gordo_span_seconds{span=name}`` and (when
+    ``GORDO_SPAN_LOG`` is set) appends one JSONL line.  ``name`` is a
+    histogram label — keep it a BOUNDED set (route names, stage names);
+    per-request values belong in ``attrs``, which only reach the span
+    log.  Yields the attrs dict so callers can attach results
+    (e.g. batch sizes known only at exit)."""
+    if not metrics.enabled():
+        yield attrs
+        return
+    tid = trace_id if trace_id is not None else current_trace_id()
+    t0 = time.perf_counter()
+    try:
+        yield attrs
+    finally:
+        seconds = time.perf_counter() - t0
+        _SPAN_SECONDS.observe(seconds, name)
+        if span_log_path():
+            doc: Dict[str, Any] = {
+                "ts": round(time.time(), 6),
+                "span": name,
+                "seconds": round(seconds, 6),
+            }
+            if tid:
+                doc["trace"] = tid
+            doc.update(attrs)
+            _write_span_line(doc)

@@ -5,6 +5,14 @@ records build wall-times into metadata.  The TPU build keeps that
 metadata-first design and adds opt-in ``jax.profiler`` tracing: set
 ``GORDO_PROFILE_DIR`` (or pass ``profile_dir``) and every wrapped section
 dumps a Perfetto/TensorBoard-loadable trace.
+
+Since the telemetry plane landed, ``trace`` is no longer a pure no-op
+without the profiler: every wrapped section ALWAYS records its wall time
+into the ``gordo_profile_section_seconds`` histogram (label = the section
+name's leading component, so ``fleet_bucket/512`` and ``fleet_bucket/64``
+share a bounded series), and emits a span (``telemetry.spans``) carrying
+the full section name.  The jax-profiler dump stays opt-in — it is the
+expensive microscope; the histogram is the always-on clock.
 """
 
 from __future__ import annotations
@@ -12,11 +20,21 @@ from __future__ import annotations
 import contextlib
 import logging
 import os
+import time
 from typing import Iterator, Optional
+
+from gordo_tpu import telemetry
 
 logger = logging.getLogger(__name__)
 
 ENV_VAR = "GORDO_PROFILE_DIR"
+
+_SECTION_SECONDS = telemetry.histogram(
+    "gordo_profile_section_seconds",
+    "Wall-clock duration of profiling.trace sections (always recorded; "
+    "label is the section name before any '/')",
+    labels=("section",),
+)
 
 
 def profile_dir() -> Optional[str]:
@@ -25,19 +43,26 @@ def profile_dir() -> Optional[str]:
 
 @contextlib.contextmanager
 def trace(section: str, directory: Optional[str] = None) -> Iterator[None]:
-    """Wrap a section in a ``jax.profiler`` trace when profiling is enabled,
-    else a no-op.  Traces land in ``<dir>/<section>/`` (one subdir per
+    """Wrap a section: wall time always lands in the telemetry histogram;
+    additionally, when profiling is enabled (``GORDO_PROFILE_DIR``), a
+    ``jax.profiler`` trace dumps to ``<dir>/<section>/`` (one subdir per
     section so repeated builds don't clobber each other)."""
     directory = directory or profile_dir()
-    if not directory:
-        yield
-        return
-    import jax
+    # bounded histogram label: 'fleet_bucket/512' -> 'fleet_bucket'; the
+    # exact section name still reaches the span log when enabled
+    head = section.split("/", 1)[0]
+    t0 = time.perf_counter()
+    try:
+        with telemetry.span("profile." + head, section=section):
+            if not directory:
+                yield
+                return
+            import jax
 
-    dest = os.path.join(directory, section.replace("/", "_"))
-    os.makedirs(dest, exist_ok=True)
-    logger.info("Profiling %r -> %s", section, dest)
-    with jax.profiler.trace(dest):
-        yield
-
-
+            dest = os.path.join(directory, section.replace("/", "_"))
+            os.makedirs(dest, exist_ok=True)
+            logger.info("Profiling %r -> %s", section, dest)
+            with jax.profiler.trace(dest):
+                yield
+    finally:
+        _SECTION_SECONDS.observe(time.perf_counter() - t0, head)

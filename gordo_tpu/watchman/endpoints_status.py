@@ -14,9 +14,11 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 import time
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import aiohttp
+
+from gordo_tpu import telemetry
 
 API_PREFIX = "/gordo/v0"
 
@@ -145,6 +147,52 @@ async def discover_machines_ex(
         if own_session:
             await session.close()
     return names, n_responding
+
+
+async def scrape_metrics(
+    base_urls: Sequence[str],
+    timeout: float = 5.0,
+    session: Optional[aiohttp.ClientSession] = None,
+    extra: Optional[Sequence[Tuple[str, str]]] = None,
+) -> Tuple[str, int]:
+    """Scrape every target server's ``/metrics`` and merge them into one
+    Prometheus exposition with per-target ``instance`` labels.
+
+    Merging is label-tagging, never arithmetic: summing a ``batch_cap``
+    gauge across servers would manufacture a number nobody set, so each
+    target's series stay distinct under its ``instance=<base_url>``.
+    Returns ``(merged_text, n_responding)`` — unreachable targets simply
+    contribute nothing (their absence IS the signal; the health poll
+    reports them unhealthy separately).  ``extra`` adds local
+    ``(instance, exposition)`` pairs (e.g. the caller's own registry) to
+    the same merge so the output is ONE spec-valid document."""
+    own_session = session is None
+    session = session or aiohttp.ClientSession()
+    pairs: List[Tuple[str, str]] = []
+    n_responding = 0
+    try:
+        async def one(base: str) -> None:
+            nonlocal n_responding
+            try:
+                async with session.get(
+                    f"{base}/metrics",
+                    timeout=aiohttp.ClientTimeout(total=timeout),
+                ) as resp:
+                    if resp.status != 200:
+                        return
+                    text = await resp.text()
+            except (aiohttp.ClientError, asyncio.TimeoutError):
+                return
+            n_responding += 1
+            pairs.append((base, text))
+
+        await asyncio.gather(*(one(b) for b in base_urls))
+    finally:
+        if own_session:
+            await session.close()
+    pairs.sort()  # deterministic output regardless of response order
+    pairs.extend(extra or ())
+    return telemetry.merge_expositions(pairs), n_responding
 
 
 async def poll_endpoints(

@@ -17,15 +17,27 @@ from typing import Any, Dict, List, Optional, Sequence
 from aiohttp import web
 
 import gordo_tpu
+from gordo_tpu import telemetry
 from gordo_tpu.watchman.endpoints_status import (
     EndpointStatus,
     discover_machines_ex,
     poll_endpoints,
+    scrape_metrics,
 )
 
 logger = logging.getLogger(__name__)
 
 WATCHMAN_KEY: "web.AppKey[Watchman]" = web.AppKey("watchman", object)
+
+_POLL_SECONDS = telemetry.histogram(
+    "gordo_watchman_poll_seconds",
+    "Duration of one full endpoint poll cycle",
+)
+_ENDPOINTS_GAUGE = telemetry.gauge(
+    "gordo_watchman_endpoints",
+    "Endpoints by health as of the latest poll",
+    labels=("healthy",),
+)
 
 
 class Watchman:
@@ -88,6 +100,7 @@ class Watchman:
         return targets
 
     async def refresh(self) -> List[EndpointStatus]:
+        t0 = time.monotonic()
         targets = await self._current_targets()
         if self.discover:
             discovered, n_responding = await discover_machines_ex(
@@ -130,6 +143,10 @@ class Watchman:
             if not status.healthy and prev is not None:
                 status.last_seen = prev.last_seen
             self.statuses[status.machine] = status
+        _POLL_SECONDS.observe(time.monotonic() - t0)
+        n_healthy = sum(1 for s in statuses if s.healthy)
+        _ENDPOINTS_GAUGE.set(n_healthy, "true")
+        _ENDPOINTS_GAUGE.set(len(statuses) - n_healthy, "false")
         return statuses
 
     def notify_change(self) -> None:
@@ -216,6 +233,23 @@ async def _healthcheck(request: web.Request) -> web.Response:
     return web.json_response({"gordo-server-version": gordo_tpu.__version__})
 
 
+async def _metrics(request: web.Request) -> web.Response:
+    """The FLEET scrape surface: every target server's ``/metrics`` merged
+    under per-target ``instance`` labels, plus watchman's own series
+    (``instance="watchman"``).  One scrape config covers the whole
+    project — Prometheus points here instead of at N server pods."""
+    watchman: Watchman = request.app[WATCHMAN_KEY]
+    targets = await watchman._current_targets()
+    merged, n_responding = await scrape_metrics(
+        targets,
+        timeout=watchman.request_timeout,
+        extra=[("watchman", telemetry.render())],
+    )
+    resp = web.Response(text=merged, content_type="text/plain")
+    resp.headers["X-Gordo-Scraped-Targets"] = str(n_responding)
+    return resp
+
+
 def build_watchman_app(watchman: Watchman) -> web.Application:
     app = web.Application()
     app[WATCHMAN_KEY] = watchman
@@ -230,6 +264,7 @@ def build_watchman_app(watchman: Watchman) -> web.Application:
     app.on_cleanup.append(_stop)
     app.router.add_get("/", _index)
     app.router.add_get("/healthcheck", _healthcheck)
+    app.router.add_get("/metrics", _metrics)
     return app
 
 

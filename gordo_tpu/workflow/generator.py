@@ -197,6 +197,19 @@ def _labels(project: str, component: str) -> Dict[str, str]:
     }
 
 
+def _scrape_annotations(port: int) -> Dict[str, str]:
+    """Prometheus discovery annotations for a pod exposing ``/metrics``
+    (the de-facto prometheus.io convention most cluster scrape configs
+    key on).  Emitted by default on the server and watchman pod
+    templates; ``--no-scrape-annotations`` opts out for clusters using
+    ServiceMonitors or a different discovery scheme."""
+    return {
+        "prometheus.io/scrape": "true",
+        "prometheus.io/port": str(port),
+        "prometheus.io/path": "/metrics",
+    }
+
+
 def _multihost_builder_docs(
     project: str,
     image: str,
@@ -320,7 +333,15 @@ def _server_deployment(
     image: str,
     replicas: int,
     server_args: Optional[List[str]] = None,
+    scrape_annotations: bool = True,
 ) -> Dict:
+    template_meta: Dict[str, Any] = {
+        "labels": _labels(project, "ml-server"),
+    }
+    if scrape_annotations:
+        template_meta["annotations"] = _scrape_annotations(
+            DEFAULT_SERVER_PORT
+        )
     return {
         "apiVersion": "apps/v1",
         "kind": "Deployment",
@@ -332,7 +353,7 @@ def _server_deployment(
             "replicas": replicas,
             "selector": {"matchLabels": _labels(project, "ml-server")},
             "template": {
-                "metadata": {"labels": _labels(project, "ml-server")},
+                "metadata": template_meta,
                 "spec": {
                     "containers": [
                         {
@@ -414,7 +435,22 @@ def _machine_mapping(project: str, machine: str) -> Dict:
     }
 
 
-def _watchman_deployment(project: str, image: str, machines: List[str]) -> Dict:
+def _watchman_deployment(
+    project: str,
+    image: str,
+    machines: List[str],
+    scrape_annotations: bool = True,
+) -> Dict:
+    template_meta: Dict[str, Any] = {
+        "labels": _labels(project, "watchman"),
+    }
+    if scrape_annotations:
+        # watchman's /metrics is the FLEET scrape surface (it merges every
+        # target server's exposition under instance labels), so clusters
+        # that only scrape one target per project point here
+        template_meta["annotations"] = _scrape_annotations(
+            DEFAULT_WATCHMAN_PORT
+        )
     return {
         "apiVersion": "apps/v1",
         "kind": "Deployment",
@@ -426,7 +462,7 @@ def _watchman_deployment(project: str, image: str, machines: List[str]) -> Dict:
             "replicas": 1,
             "selector": {"matchLabels": _labels(project, "watchman")},
             "template": {
-                "metadata": {"labels": _labels(project, "watchman")},
+                "metadata": template_meta,
                 "spec": {
                     "containers": [
                         {
@@ -457,6 +493,7 @@ def generate_workflow(
     include_plan: bool = True,
     server_args: Optional[List[str]] = None,
     multihost: Optional[int] = None,
+    scrape_annotations: bool = True,
 ) -> List[Dict[str, Any]]:
     """Project config → list of k8s manifest dicts (+ the build plan as a
     ConfigMap so the cluster state carries the bucketing decision).
@@ -470,6 +507,11 @@ def generate_workflow(
     a single-pod Job.  Refused when N exceeds the plan's machine-shard
     count — the extra pods would have empty shards yet still hold every
     barrier, so the spec is a config error, not a scheduling preference.
+
+    ``scrape_annotations`` (default on): stamp ``prometheus.io/*``
+    discovery annotations on the server and watchman pod templates so a
+    conventionally-configured Prometheus scrapes their ``/metrics``
+    without extra config; disable for clusters using ServiceMonitors.
     """
     project = config.project_name
     machines = [m.name for m in config.machines]
@@ -499,9 +541,15 @@ def generate_workflow(
         builder_docs = [_builder_job(project, image, tpu_resources)]
     docs: List[Dict[str, Any]] = [
         *builder_docs,
-        _server_deployment(project, image, server_replicas, server_args),
+        _server_deployment(
+            project, image, server_replicas, server_args,
+            scrape_annotations=scrape_annotations,
+        ),
         _service(project, "ml-server", DEFAULT_SERVER_PORT),
-        _watchman_deployment(project, image, machines),
+        _watchman_deployment(
+            project, image, machines,
+            scrape_annotations=scrape_annotations,
+        ),
         _service(project, "watchman", DEFAULT_WATCHMAN_PORT),
     ]
     docs.extend(_machine_mapping(project, m) for m in machines)

@@ -4,9 +4,12 @@
 This image ships no flake8/ruff/pyflakes and has no network, so the local
 lint gate is built on ``ast``: syntax errors, unused imports, wildcard
 imports, duplicate function/class definitions in a scope, mutable default
-arguments, and ``except:`` bare clauses.  CI additionally runs flake8
-(installable on GitHub runners — see .github/workflows/ci.yml); this
-script is the everywhere-runnable subset.
+arguments, ``except:`` bare clauses, and telemetry metric names violating
+the ``gordo_[a-z_]+`` catalog convention (any literal first argument to a
+``counter``/``gauge``/``histogram`` registration call — the same pattern
+``telemetry.metrics`` enforces at runtime, caught here before anything
+runs).  CI additionally runs flake8 (installable on GitHub runners — see
+.github/workflows/ci.yml); this script is the everywhere-runnable subset.
 
 Usage: python scripts/lint.py PATH [PATH ...]   (exit 1 on findings)
 """
@@ -15,10 +18,17 @@ from __future__ import annotations
 
 import ast
 import os
+import re
 import sys
 from typing import Iterator, List, Tuple
 
 Finding = Tuple[str, int, str]
+
+#: must match gordo_tpu.telemetry.metrics.NAME_RE (kept literal here so
+#: the linter stays import-free and runs on any checkout)
+METRIC_NAME_RE = re.compile(r"^gordo_[a-z_]+$")
+#: registration entrypoints whose first literal argument is a metric name
+METRIC_FACTORIES = {"counter", "gauge", "histogram"}
 
 
 def iter_py_files(paths: List[str]) -> Iterator[str]:
@@ -107,6 +117,26 @@ def lint_file(path: str) -> List[Finding]:
                 findings.append((path, lineno, f"unused import: {name}"))
 
     for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            func = node.func
+            fname = (
+                func.attr if isinstance(func, ast.Attribute)
+                else func.id if isinstance(func, ast.Name)
+                else None
+            )
+            if (
+                fname in METRIC_FACTORIES
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+                and not METRIC_NAME_RE.match(node.args[0].value)
+                and node.lineno not in noqa_lines
+            ):
+                findings.append(
+                    (path, node.lineno,
+                     f"metric name {node.args[0].value!r} violates the "
+                     f"catalog convention {METRIC_NAME_RE.pattern}")
+                )
         if isinstance(node, ast.ImportFrom) and any(
             a.name == "*" for a in node.names
         ):

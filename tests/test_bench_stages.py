@@ -10,7 +10,8 @@ import bench
 
 def test_default_runs_every_stage_in_priority_order():
     assert bench.parse_stages([]) == [
-        "build", "serving", "serving_openloop", "lstm",
+        "build", "serving", "serving_openloop", "telemetry_overhead",
+        "lstm",
     ]
 
 

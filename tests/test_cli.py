@@ -179,3 +179,32 @@ def test_help_lists_all_verbs():
     for verb in ("build", "build-project", "run-server", "run-watchman",
                  "client", "workflow"):
         assert verb in result.output
+
+
+def test_telemetry_dump_merges_snapshot_dir(tmp_path):
+    """`gordo telemetry dump --dir` merges the shard-local snapshots a
+    (multi-host) build wrote and prints Prometheus text; the bare verb
+    prints this process's registry."""
+    from gordo_tpu import telemetry
+
+    reg = telemetry.MetricsRegistry(enabled=True)
+    reg.counter("gordo_cli_test_total", "x").inc(2)
+    snap_dir = tmp_path / "models" / telemetry.SNAPSHOT_DIR
+    reg.write_snapshot(str(snap_dir / "shard-000-of-002.json"))
+    reg.write_snapshot(str(snap_dir / "shard-001-of-002.json"))
+
+    runner = CliRunner()
+    result = runner.invoke(
+        gordo, ["telemetry", "dump", "--dir", str(tmp_path / "models")]
+    )
+    assert result.exit_code == 0, result.output
+    assert "gordo_cli_test_total 4" in result.output  # 2 shards merged
+
+    bare = runner.invoke(gordo, ["telemetry", "dump"])
+    assert bare.exit_code == 0, bare.output
+    assert "# TYPE gordo_events_total counter" in bare.output
+
+    missing = runner.invoke(
+        gordo, ["telemetry", "dump", "--dir", str(tmp_path / "empty")]
+    )
+    assert missing.exit_code != 0

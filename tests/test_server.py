@@ -527,7 +527,9 @@ def test_msgpack_content_negotiation(model_dir):
 
 def test_replay_bench_smoke(model_dir):
     """The replayed-stream HTTP benchmark harness drives a real server and
-    reports coherent numbers for every mode/wire combination."""
+    reports coherent numbers for every mode/wire combination — and its
+    in-run /metrics scrape (the tier-1 lane's Prometheus assertion) comes
+    back valid under load."""
     from gordo_tpu.serve.replay import replay_bench
 
     collection = ModelCollection.from_directory(model_dir, project="testproj")
@@ -539,6 +541,61 @@ def test_replay_bench_smoke(model_dir):
             )
             assert out["samples_per_sec"] > 0, out
             assert out["n_machines"] == 2
+            # every replay doubles as a /metrics scrape assertion: the
+            # instrumented server must expose a parseable exposition with
+            # the per-route request histograms populated
+            scrape = out["metrics_scrape"]
+            assert scrape["status"] == 200, scrape
+            assert scrape["families"] > 0
+            assert scrape["has_request_histogram"], scrape
+
+
+def test_metrics_endpoint_prometheus_exposition(model_dir):
+    """GET /metrics returns valid Prometheus text: per-route/per-codec
+    request histograms from the middleware, request counters by status,
+    and — when the coalescer is on — its queue/policy gauges."""
+
+    async def run():
+        collection = ModelCollection.from_directory(
+            model_dir, project="testproj"
+        )
+        client = TestClient(TestServer(
+            build_app(collection, coalesce_window_ms=5.0,
+                      coalesce_min_concurrency=1, coalesce_knee_batch=4)
+        ))
+        await client.start_server()
+        try:
+            resp = await client.post(
+                "/gordo/v0/testproj/machine-a/anomaly/prediction",
+                json={"X": X_ROWS},
+            )
+            assert resp.status == 200
+            metrics_resp = await client.get("/metrics")
+            return metrics_resp.status, await metrics_resp.text()
+        finally:
+            await client.close()
+
+    status, text = asyncio.run(run())
+    assert status == 200
+    # exposition structure: HELP/TYPE headers then samples, by family
+    assert "# TYPE gordo_server_request_seconds histogram" in text
+    assert "# TYPE gordo_server_requests_total counter" in text
+    # route label is the matched PATTERN ({machine} stays a placeholder:
+    # cardinality bounded by the route table, not the fleet)
+    route = "/gordo/v0/{project}/{machine}/anomaly/prediction"
+    assert f'gordo_server_request_seconds_bucket{{route="{route}"' in text
+    assert f'gordo_server_requests_total{{route="{route}",status="200"}}' in text
+    # collection + coalescer point-in-time gauges refresh at scrape time
+    assert "gordo_server_machines 2" in text
+    assert "gordo_coalesce_batch_cap 4" in text
+    assert "gordo_coalesce_standing_down 0" in text
+    # every metric in the exposition obeys the catalog naming convention
+    import re
+
+    for line in text.splitlines():
+        if line and not line.startswith("#"):
+            name = line.split("{", 1)[0].split(" ", 1)[0]
+            assert re.match(r"^gordo_[a-z_]+$", name), line
 
 
 def test_replay_openloop_mode(model_dir):

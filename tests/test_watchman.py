@@ -109,6 +109,42 @@ def test_watchman_healthcheck():
     assert asyncio.run(main()) == 200
 
 
+def test_watchman_metrics_merges_targets_and_self(model_dir):
+    """Watchman's /metrics is the fleet scrape surface: target servers'
+    expositions merge under instance=<base_url> labels alongside
+    watchman's own series as instance="watchman"."""
+    from aiohttp import web
+
+    from gordo_tpu.serve import ModelCollection, build_app
+
+    async def main():
+        collection = ModelCollection.from_directory(model_dir, project="wm")
+        ml_runner = web.AppRunner(build_app(collection))
+        await ml_runner.setup()
+        site = web.TCPSite(ml_runner, "127.0.0.1", 0)
+        await site.start()
+        port = ml_runner.addresses[0][1]
+        base = f"http://127.0.0.1:{port}"
+        watchman = Watchman("wm", [], [base], poll_interval=3600)
+        client = TestClient(TestServer(build_watchman_app(watchman)))
+        await client.start_server()
+        try:
+            resp = await client.get("/metrics")
+            text = await resp.text()
+            return resp.status, resp.headers, text
+        finally:
+            await client.close()
+            await ml_runner.cleanup()
+
+    status, headers, text = asyncio.run(main())
+    assert status == 200
+    assert headers["X-Gordo-Scraped-Targets"] == "1"
+    # the target's collection gauge arrives tagged with ITS base url
+    assert 'gordo_server_machines{instance="http://127.0.0.1:' in text
+    # watchman's own series ride the same document
+    assert 'instance="watchman"' in text
+
+
 def test_client_discovers_via_watchman(model_dir):
     """Reference behavior: the client gets its machine list from watchman
     and skips unhealthy endpoints."""

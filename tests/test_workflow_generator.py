@@ -237,3 +237,32 @@ def test_generate_workflow_refuses_oversharded_multihost():
         generate_workflow(_config(), multihost=4)  # only 3 machines
     with pytest.raises(ValueError, match="multihost"):
         generate_workflow(_config(), multihost=0)
+
+
+def test_scrape_annotations_on_by_default():
+    """Server and watchman pod templates carry the prometheus.io/*
+    discovery annotations (their /metrics endpoints are the scrape
+    surfaces) pointing at each component's own port."""
+    docs = generate_workflow(_config())
+    deployments = {
+        d["metadata"]["name"]: d for d in docs if d["kind"] == "Deployment"
+    }
+    server_meta = deployments["gordo-server-genproj"]["spec"]["template"][
+        "metadata"
+    ]
+    watchman_meta = deployments["gordo-watchman-genproj"]["spec"][
+        "template"
+    ]["metadata"]
+    for meta, port in ((server_meta, "5555"), (watchman_meta, "5556")):
+        ann = meta["annotations"]
+        assert ann["prometheus.io/scrape"] == "true"
+        assert ann["prometheus.io/port"] == port
+        assert ann["prometheus.io/path"] == "/metrics"
+
+
+def test_scrape_annotations_opt_out():
+    docs = generate_workflow(_config(), scrape_annotations=False)
+    for doc in docs:
+        if doc["kind"] == "Deployment":
+            meta = doc["spec"]["template"]["metadata"]
+            assert "annotations" not in meta
