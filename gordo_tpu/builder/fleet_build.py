@@ -28,10 +28,12 @@ from __future__ import annotations
 
 import logging
 import os
+import shutil
 import threading
 import time
+import uuid
 from concurrent.futures import ThreadPoolExecutor
-from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 from jax.sharding import Mesh
@@ -69,6 +71,111 @@ _DATA_LOAD_SECONDS = telemetry.histogram(
     "gordo_build_data_load_seconds",
     "Per-machine dataset load+assembly seconds (loader pool)",
 )
+
+# -- build-pipeline instruments (docs/perf.md "Build pipeline") -------------
+_PIPE_STAGE_SECONDS = telemetry.histogram(
+    "gordo_build_pipeline_stage_seconds",
+    "Busy seconds per pipeline stage unit "
+    "(load: one machine, device: one chunk, write: one artifact)",
+    labels=("stage",),
+)
+_PIPE_STALL_SECONDS = telemetry.counter(
+    "gordo_build_pipeline_stall_seconds",
+    "Seconds the pipeline drive loop stalled on a stage "
+    "(load: waiting for the loader pool, write: writer queue full)",
+    labels=("stage",),
+)
+_PIPE_WRITER_QUEUE_DEPTH = telemetry.gauge(
+    "gordo_build_pipeline_writer_queue_depth",
+    "Artifact writes queued or in flight in the background writer pool",
+)
+_PIPE_CHUNKS_TOTAL = telemetry.counter(
+    "gordo_build_pipeline_chunks_total",
+    "Fleet chunks driven to completion, by execution path",
+    labels=("path",),  # pipelined | serial
+)
+
+
+def _pipeline_enabled(pipeline: Optional[bool]) -> bool:
+    """Kill switch: ``GORDO_BUILD_PIPELINE=off`` (or ``0``/``false``)
+    forces the serial drive loop; an explicit ``pipeline=`` argument to
+    :func:`build_project` wins over the environment."""
+    if pipeline is not None:
+        return bool(pipeline)
+    return os.environ.get("GORDO_BUILD_PIPELINE", "on").strip().lower() not in (
+        "off", "0", "false",
+    )
+
+
+class _ArtifactWriter:
+    """Background artifact-writer pool — stage C of the build pipeline.
+
+    ``serializer.dump`` (pickle + YAML + JSON per machine) runs off the
+    device critical path on a small thread pool behind a BOUNDED queue:
+    :meth:`submit` blocks once ``max_queued`` writes are outstanding, so
+    a slow disk backpressures the drive loop instead of buffering
+    unbounded pickled fleets.  The write function is expected to place
+    each artifact atomically (scratch dir + rename — see
+    :func:`_write_artifact`) and to do its own failure recording;
+    ``drain()`` blocks until every queued write finished.  The resumable
+    exit-75 path drains BEFORE the shard state transitions, so recorded
+    progress never references a half-written artifact.
+    """
+
+    def __init__(
+        self,
+        write_fn: Callable[..., None],
+        max_workers: int = 1,
+        max_queued: int = 512,
+    ):
+        # one worker by default: artifact pickling is GIL-bound, so extra
+        # writer threads buy no parallelism and cost switch churn on
+        # small hosts (the bench container is 1-core)
+        self._write_fn = write_fn
+        self._pool = ThreadPoolExecutor(
+            max_workers=max_workers, thread_name_prefix="gordo-artifact-writer"
+        )
+        self._slots = threading.BoundedSemaphore(max_queued)
+        self._lock = threading.Lock()
+        self._depth = 0
+        self._futures: List[Any] = []
+
+    def submit(self, items: Sequence[Tuple]) -> None:
+        """Queue one chunk's artifact writes as a single pool task (one
+        handoff per chunk, not per machine).  Blocks for queue slots —
+        one per artifact — when the writer is ``max_queued`` behind."""
+        t0 = time.time()
+        for _ in items:
+            self._slots.acquire()
+        stall = time.time() - t0
+        if stall > 0.001:
+            _PIPE_STALL_SECONDS.inc(stall, "write")
+        with self._lock:
+            self._depth += len(items)
+            _PIPE_WRITER_QUEUE_DEPTH.set(float(self._depth))
+        self._futures.append(self._pool.submit(self._run, list(items)))
+
+    def _run(self, items: List[Tuple]) -> None:
+        for args in items:
+            t0 = time.time()
+            try:
+                self._write_fn(*args)
+            finally:
+                self._slots.release()
+                with self._lock:
+                    self._depth -= 1
+                    _PIPE_WRITER_QUEUE_DEPTH.set(float(self._depth))
+                _PIPE_STAGE_SECONDS.observe(time.time() - t0, "write")
+
+    def drain(self) -> None:
+        """Block until every queued write has completed, then shut the
+        pool down.  Write errors are recorded by the write function, not
+        raised here — a failed dump must fail ONE machine, not the drain."""
+        futures, self._futures = self._futures, []
+        for fut in futures:
+            fut.result()
+        self._pool.shutdown(wait=True)
+
 
 #: fleet programs are chunked so a bucket's stacked arrays stay well inside
 #: device memory (tiny models: the data, not the params, is the footprint).
@@ -163,6 +270,9 @@ class ProjectBuildResult:
         #: (process_id, num_processes) when this was one shard of a
         #: multi-host build
         self.shard: Optional[Tuple[int, int]] = None
+        #: whether the pipelined drive loop ran (False: serial path via
+        #: the GORDO_BUILD_PIPELINE=off kill switch or pipeline=False)
+        self.pipelined: bool = False
 
     def summary(self) -> Dict[str, Any]:
         out = {
@@ -173,6 +283,7 @@ class ProjectBuildResult:
             "failed": dict(self.failed),
             "build_seconds": self.seconds,
             "peak_loaded_machines": self.peak_loaded,
+            "pipelined": self.pipelined,
         }
         if self.auto_pad:
             out["auto_pad_lengths"] = self.auto_pad
@@ -254,6 +365,7 @@ def build_project(
     auto_pad: bool = True,
     auto_pad_budget_seconds: Optional[float] = None,
     shard: Optional[Any] = None,
+    pipeline: Optional[bool] = None,
 ) -> ProjectBuildResult:
     """Build every machine; fleet-bucket the homogeneous ones.
 
@@ -261,6 +373,18 @@ def build_project(
     (2 x the effective bucket size) have arrays resident — the one
     training on device and the one the loader pool is prefetching behind
     it.
+
+    ``pipeline`` (default: env-controlled, on): drive the chunks as a
+    three-stage pipeline — loader pool (prefetch) ∥ device (this thread)
+    ∥ background artifact-writer pool — so dataset loads and
+    ``serializer.dump`` both overlap device compute instead of sitting on
+    the critical path.  Artifacts are written to a scratch dir and
+    atomically renamed into place; completion records (registry, shard
+    state) follow the rename, and the writer queue drains before the
+    resumable exit-75 path transitions the shard state.  Artifact bytes
+    and registry entries are identical to the serial path's.
+    ``GORDO_BUILD_PIPELINE=off`` (kill switch) or ``pipeline=False``
+    preserves the serial drive loop; an explicit argument beats the env.
 
     ``max_bucket_size=None`` (the default) picks a per-signature chunk
     size: ``DEFAULT_MAX_BUCKET`` (512) for dense signatures,
@@ -366,10 +490,14 @@ def build_project(
         if shard_state is not None:
             shard_state.start([m.name for m in machines])
 
+    _done_lock = threading.Lock()
+
     def _done(name: str) -> None:
-        """A machine needs no further work (artifact on disk or cached)."""
+        """A machine needs no further work (artifact on disk or cached).
+        Serialized: the writer pool and the drive loop both record."""
         if shard_state is not None:
-            shard_state.record(name)
+            with _done_lock:
+                shard_state.record(name)
     # alignment/padding changes what data trains (or how it is batched and
     # folded), so it must be part of the cache identity — otherwise an
     # aligned build silently reuses full-parity artifacts (and vice
@@ -481,6 +609,7 @@ def build_project(
             # recent-first relevant, so the truncation drops the head
             X, y = X[len(X) - keep:], y[len(y) - keep:]
         _DATA_LOAD_SECONDS.observe(time.time() - t0)
+        _PIPE_STAGE_SECONDS.observe(time.time() - t0, "load")
         entry = (X, y, dataset.get_metadata(), time.time() - t0)
         tracker.acquire()  # arrays are live from here until freed
         return entry
@@ -507,7 +636,61 @@ def build_project(
         if n:
             tracker.release(n)
 
-    with ThreadPoolExecutor(max_workers=data_workers) as pool:
+    def _run_bucket(key: Tuple, chunk: List[Machine], loaded: Dict[str, Tuple]):
+        """Width-validate + train one chunk on device.  Returns
+        ``(ok_chunk, detectors, fleet_seconds)`` or None when every
+        machine demoted (width mismatch / fleet failure)."""
+        spec = specs[key]
+        widths = key[1]
+        # config said these widths; data disagreeing (exotic provider)
+        # reroutes the machine through the single builder
+        ok_chunk = []
+        for m in chunk:
+            if m.name not in loaded:
+                continue
+            X, y = loaded[m.name][0], loaded[m.name][1]
+            if (X.shape[1], y.shape[1]) != widths:
+                logger.warning(
+                    "Machine %s loaded widths %s != config %s; "
+                    "building single", m.name, (X.shape[1], y.shape[1]),
+                    widths,
+                )
+                _demote_to_single(
+                    m, singles, machine_keys, key_extra, demoted
+                )
+                _free(loaded, [m.name])
+            else:
+                ok_chunk.append(m)
+        if not ok_chunk:
+            return None
+        cv = ok_chunk[0].evaluation.get("cv")
+        t0 = time.time()
+        try:
+            builder = FleetDiffBuilder(
+                spec, cv=cv, mesh=mesh, pad_lengths=pad_lengths
+            )
+            with profiling.trace(f"fleet_bucket/{len(ok_chunk)}"):
+                detectors = builder.build(
+                    [loaded[m.name][0] for m in ok_chunk],
+                    [loaded[m.name][1] for m in ok_chunk],
+                )
+        except Exception:
+            logger.exception("Fleet bucket failed; falling back to singles")
+            for m in ok_chunk:
+                _demote_to_single(
+                    m, singles, machine_keys, key_extra, demoted
+                )
+            _free(loaded, [m.name for m in ok_chunk])
+            return None
+        fleet_seconds = time.time() - t0
+        _BUILD_BUCKET_SECONDS.observe(fleet_seconds)
+        _PIPE_STAGE_SECONDS.observe(fleet_seconds, "device")
+        return ok_chunk, detectors, fleet_seconds
+
+    def _drive_serial(pool) -> None:
+        """The pre-pipeline drive loop (GORDO_BUILD_PIPELINE=off): loads
+        still prefetch one chunk ahead, but artifact dumps run inline on
+        the critical path after each chunk trains."""
         next_futures = _submit(pool, chunks[0][1]) if chunks else None
         for i, (key, chunk) in enumerate(chunks):
             loaded = _collect(chunk, next_futures)
@@ -515,50 +698,11 @@ def build_project(
             next_futures = (
                 _submit(pool, chunks[i + 1][1]) if i + 1 < len(chunks) else None
             )
-            spec = specs[key]
-            widths = key[1]
-            # config said these widths; data disagreeing (exotic provider)
-            # reroutes the machine through the single builder
-            ok_chunk = []
-            for m in chunk:
-                if m.name not in loaded:
-                    continue
-                X, y = loaded[m.name][0], loaded[m.name][1]
-                if (X.shape[1], y.shape[1]) != widths:
-                    logger.warning(
-                        "Machine %s loaded widths %s != config %s; "
-                        "building single", m.name, (X.shape[1], y.shape[1]),
-                        widths,
-                    )
-                    _demote_to_single(
-                        m, singles, machine_keys, key_extra, demoted
-                    )
-                    _free(loaded, [m.name])
-                else:
-                    ok_chunk.append(m)
-            if not ok_chunk:
+            out = _run_bucket(key, chunk, loaded)
+            if out is None:
                 continue
-            cv = ok_chunk[0].evaluation.get("cv")
-            t0 = time.time()
-            try:
-                builder = FleetDiffBuilder(
-                    spec, cv=cv, mesh=mesh, pad_lengths=pad_lengths
-                )
-                with profiling.trace(f"fleet_bucket/{len(ok_chunk)}"):
-                    detectors = builder.build(
-                        [loaded[m.name][0] for m in ok_chunk],
-                        [loaded[m.name][1] for m in ok_chunk],
-                    )
-            except Exception:
-                logger.exception("Fleet bucket failed; falling back to singles")
-                for m in ok_chunk:
-                    _demote_to_single(
-                        m, singles, machine_keys, key_extra, demoted
-                    )
-                _free(loaded, [m.name for m in ok_chunk])
-                continue
-            fleet_seconds = time.time() - t0
-            _BUILD_BUCKET_SECONDS.observe(fleet_seconds)
+            ok_chunk, detectors, fleet_seconds = out
+            _PIPE_CHUNKS_TOTAL.inc(1.0, "serial")
             for m, det in zip(ok_chunk, detectors):
                 _dump_machine(
                     m,
@@ -575,6 +719,92 @@ def build_project(
                 )
                 _done(m.name)
                 _free(loaded, [m.name])  # artifact on disk: arrays drop
+
+    def _drive_pipeline(pool, writer: _ArtifactWriter) -> None:
+        """The pipelined drive loop: loader pool (stage A, prefetching) ∥
+        device compute on this thread (stage B) ∥ artifact-writer pool
+        (stage C).  Metadata assembles at enqueue time so the chunk's
+        arrays free BEFORE the write queues (the 2-chunk peak_loaded
+        bound holds regardless of writer backlog).  This function is a
+        D2H-free zone — ``scripts/lint.py`` rejects blocking
+        device→host calls (jax.device_get / np.asarray / to_host /
+        block_until_ready) in its body."""
+        next_futures = _submit(pool, chunks[0][1]) if chunks else None
+        for i, (key, chunk) in enumerate(chunks):
+            t_wait = time.time()
+            loaded = _collect(chunk, next_futures)
+            _PIPE_STALL_SECONDS.inc(time.time() - t_wait, "load")
+            next_futures = (
+                _submit(pool, chunks[i + 1][1]) if i + 1 < len(chunks) else None
+            )
+            out = _run_bucket(key, chunk, loaded)
+            if out is None:
+                continue
+            ok_chunk, detectors, fleet_seconds = out
+            _PIPE_CHUNKS_TOTAL.inc(1.0, "pipelined")
+            per_machine = fleet_seconds / len(ok_chunk)
+            # machines in a chunk share ONE model config, so their
+            # definition.yaml bytes are identical by construction —
+            # serialize once per chunk instead of per machine (the
+            # byte-parity test pins pipelined == serial per machine, so
+            # a config that DID diverge inside a chunk would be caught)
+            chunk_definition = serializer.render_definition(detectors[0])
+            batch = []
+            for m, det in zip(ok_chunk, detectors):
+                metadata = _machine_metadata(
+                    m,
+                    det,
+                    loaded[m.name],
+                    per_machine,
+                    fleet=True,
+                    align_lengths=align_lengths,
+                    pad_lengths=pad_lengths,
+                    cache_key=machine_keys[m.name],
+                )
+                _free(loaded, [m.name])  # arrays drop at enqueue, not write
+                batch.append(
+                    (m.name, det, metadata, per_machine, chunk_definition)
+                )
+            writer.submit(batch)  # one handoff per chunk
+
+    use_pipeline = _pipeline_enabled(pipeline) and bool(chunks)
+    result.pipelined = use_pipeline
+    tmp_root = os.path.join(output_dir, ".gordo-tmp")
+    writer: Optional[_ArtifactWriter] = None
+
+    def _write_one(name: str, det, metadata: Dict[str, Any],
+                   per_machine: float,
+                   definition: Optional[str] = None) -> None:
+        """Writer-pool task: atomic artifact write + completion records.
+        Failures fail ONE machine (recorded loudly), never the drain."""
+        try:
+            dest = os.path.join(output_dir, name)
+            _write_artifact(
+                det, metadata, dest, model_register_dir,
+                metadata.get("cache_key"), tmp_root=tmp_root,
+                definition=definition,
+            )
+        except Exception as exc:
+            logger.exception("Artifact write failed for %s", name)
+            result.failed[name] = f"write: {exc}"
+            _BUILD_MACHINES_TOTAL.inc(1.0, "failed")
+            return
+        result.artifacts[name] = dest
+        result.fleet_built.append(name)
+        _BUILD_MACHINES_TOTAL.inc(1.0, "fleet")
+        _BUILD_MACHINE_SECONDS.observe(per_machine, "fleet")
+        _done(name)
+
+    with ThreadPoolExecutor(max_workers=data_workers) as pool:
+        if use_pipeline:
+            writer = _ArtifactWriter(_write_one)
+            try:
+                _drive_pipeline(pool, writer)
+            except BaseException:
+                writer.drain()
+                raise
+        else:
+            _drive_serial(pool)
 
     # 4. Single-machine fallback (non-fleetable configs) — one at a time,
     #    each build loading and freeing its own data.
@@ -616,6 +846,14 @@ def build_project(
         _BUILD_MACHINE_SECONDS.observe(time.time() - t_single, "single")
         _done(m.name)
 
+    if writer is not None:
+        # exit-75 / resumable contract: every queued artifact is fully on
+        # disk (or its failure recorded) BEFORE the shard state
+        # transitions and before this function returns — the singles pass
+        # above ran concurrently with the tail of the write queue
+        writer.drain()
+        shutil.rmtree(tmp_root, ignore_errors=True)
+
     if shard_state is not None:
         if result.failed:
             shard_state.mark_resumable(
@@ -650,19 +888,19 @@ def _write_telemetry_snapshot(
         logger.exception("telemetry snapshot write failed: %s", path)
 
 
-def _dump_machine(
+def _machine_metadata(
     m: Machine,
     detector,
     loaded_entry: Tuple,
     fit_seconds: float,
-    output_dir: str,
-    model_register_dir: Optional[str],
-    result: ProjectBuildResult,
     fleet: bool,
     align_lengths: Optional[int] = None,
     pad_lengths: Optional[int] = None,
     cache_key: Optional[str] = None,
-) -> None:
+) -> Dict[str, Any]:
+    """Assemble one machine's artifact metadata — everything except the
+    disk writes, so the pipelined path can free the training arrays at
+    enqueue time and hand the writer pool a closed payload."""
     X, _, dataset_meta, query_seconds = loaded_entry
     metadata = assemble_metadata(
         name=m.name,
@@ -693,9 +931,64 @@ def _dump_machine(
     # detect that this dir was overwritten by a different build
     if cache_key is not None:
         metadata["cache_key"] = cache_key
-    dest = os.path.join(output_dir, m.name)
-    serializer.dump(detector, dest, metadata=metadata)
+    return metadata
+
+
+def _write_artifact(
+    detector,
+    metadata: Dict[str, Any],
+    dest: str,
+    model_register_dir: Optional[str],
+    cache_key: Optional[str],
+    tmp_root: Optional[str] = None,
+    definition: Optional[str] = None,
+) -> None:
+    """Serialize one artifact to ``dest`` and register it.
+
+    ``tmp_root`` set (the pipelined path): the artifact dumps into a
+    scratch dir and renames into place — the rename is atomic, so a kill
+    mid-write leaves either no dir at ``dest`` or a complete artifact,
+    never a partial one.  The registry entry follows the rename.
+    ``tmp_root`` None (serial path): in-place dump, the historical
+    behavior.  ``definition``: pre-rendered definition.yaml text
+    (chunk-shared; see the drive loop).
+    """
+    if tmp_root is None:
+        serializer.dump(detector, dest, metadata=metadata,
+                        definition=definition)
+    else:
+        tmp = os.path.join(
+            tmp_root, f"{os.path.basename(dest)}.{uuid.uuid4().hex[:8]}"
+        )
+        serializer.dump(detector, tmp, metadata=metadata,
+                        definition=definition)
+        if os.path.isdir(dest):  # rebuild over an existing artifact dir
+            shutil.rmtree(dest)
+        os.replace(tmp, dest)
     _register(dest, model_register_dir, cache_key)
+
+
+def _dump_machine(
+    m: Machine,
+    detector,
+    loaded_entry: Tuple,
+    fit_seconds: float,
+    output_dir: str,
+    model_register_dir: Optional[str],
+    result: ProjectBuildResult,
+    fleet: bool,
+    align_lengths: Optional[int] = None,
+    pad_lengths: Optional[int] = None,
+    cache_key: Optional[str] = None,
+) -> None:
+    """Serial-path artifact dump: metadata + write + bookkeeping inline."""
+    metadata = _machine_metadata(
+        m, detector, loaded_entry, fit_seconds, fleet=fleet,
+        align_lengths=align_lengths, pad_lengths=pad_lengths,
+        cache_key=cache_key,
+    )
+    dest = os.path.join(output_dir, m.name)
+    _write_artifact(detector, metadata, dest, model_register_dir, cache_key)
     result.artifacts[m.name] = dest
     result.fleet_built.append(m.name)
     _BUILD_MACHINES_TOTAL.inc(1.0, "fleet")

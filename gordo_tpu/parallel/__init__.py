@@ -22,7 +22,10 @@ from gordo_tpu.parallel.mesh import (
 )
 from gordo_tpu.parallel.fleet import (
     FleetFitResult,
+    StagedFleetFit,
     fleet_fit,
+    fleet_stage,
+    fleet_dispatch,
     fleet_apply,
     fleet_init,
     stack_rows,
@@ -36,7 +39,10 @@ __all__ = [
     "model_sharding",
     "replicated_sharding",
     "FleetFitResult",
+    "StagedFleetFit",
     "fleet_fit",
+    "fleet_stage",
+    "fleet_dispatch",
     "fleet_apply",
     "fleet_init",
     "stack_rows",

@@ -24,7 +24,8 @@ batched GEMMs that can.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, List, Optional, Sequence, Tuple
+import warnings
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -38,6 +39,17 @@ from gordo_tpu.parallel.mesh import (
     pad_to_multiple,
 )
 from gordo_tpu.train.fit import TrainConfig, batch_geometry, make_fit_fn
+
+# The fleet program donates X/y/w/fit_keys alongside params.  Only params
+# can alias an output (same shapes), so XLA reports the rest as "not
+# usable" donations — but donating them is still the point: the staged
+# input buffers free at their last use inside the program instead of
+# surviving until the result fetch, which is what lets bucket N+1's
+# staged arrays coexist with bucket N's compute without doubling device
+# memory.  Silence exactly that advisory.
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable"
+)
 
 
 # ---------------------------------------------------------------------------
@@ -88,13 +100,36 @@ def fold_masks(n_rows: int, splitter) -> Tuple[np.ndarray, np.ndarray]:
 # Fleet fit
 # ---------------------------------------------------------------------------
 
-@dataclasses.dataclass
 class FleetFitResult:
-    """Stacked fit output: leading-axis-``M`` params pytree + loss history."""
+    """Stacked fit output: leading-axis-``M`` params pytree + loss history.
 
-    params: Any              # pytree, every leaf (M, ...)
-    history: np.ndarray      # (M, epochs)
-    n_models: int            # models actually requested (before mesh padding)
+    ``history`` is LAZY: :func:`fleet_dispatch` returns while the device
+    program is still running, holding the on-device ``(m_pad, epochs)``
+    history array; the first ``.history`` access (or :meth:`collect`)
+    performs the blocking D2H fetch and caches the ``(M, epochs)`` host
+    slice.  Dispatching bucket N+1 therefore never waits on bucket N's
+    history transfer.
+    """
+
+    def __init__(self, params: Any, n_models: int, history: Any = None):
+        self.params = params     # pytree, every leaf (m_pad, ...)
+        self.n_models = n_models  # models requested (before mesh padding)
+        self._history = history  # device (m_pad, E) until first access
+
+    @property
+    def history(self) -> np.ndarray:
+        """(M, epochs) loss history — blocking D2H on first access."""
+        if self._history is not None and not isinstance(
+            self._history, np.ndarray
+        ):
+            self._history = np.asarray(self._history)[: self.n_models]
+        return self._history
+
+    def collect(self) -> "FleetFitResult":
+        """Block until the fit finished and the history is on host."""
+        jax.block_until_ready(self.params)
+        _ = self.history
+        return self
 
     def unstack_params(self) -> List[Any]:
         """Split the stacked pytree into per-machine host pytrees."""
@@ -115,6 +150,29 @@ def _pad_models(arr: np.ndarray, m_pad: int) -> np.ndarray:
     return np.concatenate([arr, reps], axis=0)
 
 
+def _pad_stacked(
+    arr: np.ndarray, m_pad: int, n_total: int, repeat_last: bool = True
+) -> np.ndarray:
+    """Grow ``(m, n, ...)`` to ``(m_pad, n_total, ...)`` in ONE
+    preallocated buffer: row padding is zeros (weight-masked out of the
+    loss), model padding repeats the last machine (zero-weight dummies).
+
+    Replaces the former row-``np.concatenate`` followed by a
+    model-``np.concatenate``: the payload is copied once instead of
+    twice, and the transient peak host footprint drops from ~2x the
+    stacked bucket (old array + concatenated copy, twice over) to the
+    final buffer alone.
+    """
+    m, n = arr.shape[:2]
+    if m == m_pad and n == n_total:
+        return arr
+    out = np.zeros((m_pad, n_total) + arr.shape[2:], arr.dtype)
+    out[:m, :n] = arr
+    if repeat_last and m_pad != m:
+        out[m:, :n] = arr[-1]
+    return out
+
+
 def fleet_keys(seeds: np.ndarray) -> Tuple[jax.Array, jax.Array]:
     """Per-machine (init_key, fit_key) pairs, derived EXACTLY like the
     single-model path (``train.fit.fit``: split of ``PRNGKey(seed)``) so a
@@ -128,6 +186,172 @@ def fleet_init(module, init_keys: jax.Array, sample_x: np.ndarray):
     """vmapped param init: one rng per machine -> stacked params pytree."""
     return jax.vmap(lambda k: module.init(k, jnp.asarray(sample_x))["params"])(
         init_keys
+    )
+
+
+@dataclasses.dataclass
+class StagedFleetFit:
+    """One bucket's fleet-fit inputs, already padded and in flight to the
+    device (``jax.device_put`` is asynchronous: constructing this does not
+    block on the H2D copy).  Produced by :func:`fleet_stage`, consumed
+    exactly once by :func:`fleet_dispatch` — dispatch donates every buffer
+    to the device program, so a staged batch cannot be dispatched twice.
+    """
+
+    params: Any          # pytree, leaves (m_pad, ...)
+    X: jax.Array         # (m_pad, n_total, ...)
+    y: jax.Array         # (m_pad, n_total, ...)
+    w: jax.Array         # (m_pad, n_total)
+    fit_keys: jax.Array  # (m_pad, 2)
+    n_models: int        # models requested (before mesh padding)
+    steps: int
+    bs: int
+    consumed: bool = False
+
+
+def _validate_fleet_params(params: Any, m: int, m_pad: int) -> None:
+    """Caller-supplied params must already span the PADDED model axis —
+    the program is traced at ``m_pad`` lanes, and a silent shape mismatch
+    surfaces as an impenetrable vmap error deep inside XLA."""
+    bad = sorted(
+        {
+            str(getattr(leaf, "shape", ())[:1])
+            for leaf in jax.tree.leaves(params)
+            if getattr(leaf, "ndim", 0) < 1 or leaf.shape[0] != m_pad
+        }
+    )
+    if bad:
+        raise ValueError(
+            f"caller-supplied params must have leading model axis {m_pad} "
+            f"({m} machine(s) padded to the fleet width), got leading "
+            f"shape(s) {bad}; initialise with fleet_init over {m_pad} keys "
+            "or pad each leaf (the padded lanes are zero-weight dummies)"
+        )
+
+
+def fleet_stage(
+    module,
+    X: np.ndarray,
+    y: np.ndarray,
+    w: np.ndarray,
+    cfg: TrainConfig,
+    seeds: Optional[np.ndarray] = None,
+    mesh: Optional[Mesh] = None,
+    params: Optional[Any] = None,
+) -> StagedFleetFit:
+    """Stage one bucket's stacked data onto the device(s), asynchronously.
+
+    Host-side work happens here — single-copy row/model padding
+    (:func:`_pad_stacked`), seed/params validation, key derivation — then
+    ``jax.device_put`` starts the H2D transfer and returns immediately.
+    Staging bucket N+1 while bucket N's dispatched program runs overlaps
+    its transfer with device compute.
+    """
+    X = np.asarray(X, np.float32)
+    y = np.asarray(y, np.float32)
+    w = np.asarray(w, np.float32)
+    m, n = X.shape[:2]
+
+    steps, bs, n_pad = batch_geometry(n, cfg.batch_size)
+    n_total = n + n_pad
+    m_pad = m
+    if mesh is not None:
+        m_pad = pad_to_multiple(m, mesh.shape[MODEL_AXIS])
+
+    Xp = _pad_stacked(X, m_pad, n_total)
+    yp = _pad_stacked(y, m_pad, n_total)
+    wp = _pad_stacked(w, m_pad, n_total, repeat_last=False)
+
+    if seeds is None:
+        seeds = np.arange(m_pad, dtype=np.uint32)
+    else:
+        seeds = np.asarray(seeds, np.uint32)
+        if seeds.shape[0] not in (m, m_pad):
+            raise ValueError(
+                f"seeds must have one entry per machine ({m}; or {m_pad} "
+                f"including mesh padding), got {seeds.shape[0]}"
+            )
+        seeds = _pad_models(seeds, m_pad)
+
+    ms = model_sharding(mesh) if mesh is not None else None
+    if ms is not None:
+        Xd, yd, wd = jax.device_put((Xp, yp, wp), ms)
+    else:
+        Xd, yd, wd = jax.device_put((Xp, yp, wp))
+
+    init_keys, fit_keys = fleet_keys(seeds)
+    if params is None:
+        params = fleet_init(module, init_keys, Xd[0, :1])
+    else:
+        _validate_fleet_params(params, m, m_pad)
+        # private copy: dispatch donates the staged leaves, and the
+        # caller's pytree must stay usable afterwards
+        params = jax.tree.map(jnp.array, params)
+    if ms is not None:
+        params = jax.device_put(params, ms)
+
+    return StagedFleetFit(
+        params=params, X=Xd, y=yd, w=wd, fit_keys=fit_keys,
+        n_models=m, steps=steps, bs=bs,
+    )
+
+
+#: jitted (and donation-annotated) fleet programs, keyed on the static
+#: trace inputs — without this cache every fleet_dispatch re-traced a
+#: fresh vmap closure (the pre-pipeline fleet_fit did exactly that)
+_FLEET_PROGRAMS: Dict[Tuple, Any] = {}
+
+
+def _fleet_program(module, cfg: TrainConfig, steps: int, bs: int, mesh):
+    key = (module, cfg, steps, bs, mesh)
+    cached = _FLEET_PROGRAMS.get(key)
+    if cached is not None:
+        return cached
+    vfit = jax.vmap(make_fit_fn(module, cfg, steps, bs))
+    # every argument is donated: out params alias the input params
+    # buffers, and X/y/w/fit_keys free at their last device use instead
+    # of outliving the program (see the module-level warning filter)
+    if mesh is not None:
+        ms = model_sharding(mesh)
+        jitted = jax.jit(
+            vfit,
+            in_shardings=(ms, ms, ms, ms, ms),
+            out_shardings=(ms, ms),
+            donate_argnums=(0, 1, 2, 3, 4),
+        )
+    else:
+        jitted = jax.jit(vfit, donate_argnums=(0, 1, 2, 3, 4))
+    if len(_FLEET_PROGRAMS) >= 64:  # bound growth across many configs
+        _FLEET_PROGRAMS.pop(next(iter(_FLEET_PROGRAMS)))
+    _FLEET_PROGRAMS[key] = jitted
+    return jitted
+
+
+def fleet_dispatch(
+    module,
+    staged: StagedFleetFit,
+    cfg: TrainConfig,
+    mesh: Optional[Mesh] = None,
+) -> FleetFitResult:
+    """Launch the fleet program on a staged bucket; returns immediately.
+
+    The staged buffers are DONATED to the program (freed at their last
+    device use); the returned :class:`FleetFitResult` holds device arrays
+    and fetches the history lazily — call :meth:`FleetFitResult.collect`
+    (or read ``.history``) to block.
+    """
+    if staged.consumed:
+        raise RuntimeError(
+            "StagedFleetFit already dispatched: its buffers were donated "
+            "to the device program; stage the data again"
+        )
+    staged.consumed = True
+    fitted = _fleet_program(module, cfg, staged.steps, staged.bs, mesh)
+    out_params, history = fitted(
+        staged.params, staged.X, staged.y, staged.w, staged.fit_keys
+    )
+    return FleetFitResult(
+        params=out_params, n_models=staged.n_models, history=history
     )
 
 
@@ -148,60 +372,17 @@ def fleet_fit(
     mesh's ``"models"`` axis (M is padded up to a multiple of its size with
     zero-weight dummies); rows replicate within a model shard — the ``data``
     mesh axis serves :func:`fit_data_parallel` instead.
+
+    This is the blocking convenience wrapper over the pipelined surface:
+    :func:`fleet_stage` (async H2D) → :func:`fleet_dispatch` (async
+    compute, donated buffers) → :meth:`FleetFitResult.collect`.  Callers
+    building many buckets should drive the three stages themselves so
+    bucket N+1 stages while bucket N computes.
     """
-    X = np.asarray(X, np.float32)
-    y = np.asarray(y, np.float32)
-    w = np.asarray(w, np.float32)
-    m, n = X.shape[:2]
-
-    # Pad rows to a whole number of minibatches (masked out of the loss).
-    steps, bs, n_pad = batch_geometry(n, cfg.batch_size)
-    if n_pad:
-        X = np.concatenate([X, np.zeros((m, n_pad) + X.shape[2:], X.dtype)], axis=1)
-        y = np.concatenate([y, np.zeros((m, n_pad) + y.shape[2:], y.dtype)], axis=1)
-        w = np.concatenate([w, np.zeros((m, n_pad), w.dtype)], axis=1)
-
-    # Pad the model axis to the mesh's fleet width.
-    m_pad = m
-    if mesh is not None:
-        m_pad = pad_to_multiple(m, mesh.shape[MODEL_AXIS])
-        if m_pad != m:
-            X = _pad_models(X, m_pad)
-            y = _pad_models(y, m_pad)
-            w = np.concatenate(
-                [w, np.zeros((m_pad - m, w.shape[1]), w.dtype)], axis=0
-            )
-
-    if seeds is None:
-        seeds = np.arange(m_pad, dtype=np.uint32)
-    else:
-        seeds = _pad_models(np.asarray(seeds, np.uint32), m_pad)
-
-    init_keys, fit_keys = fleet_keys(seeds)
-    if params is None:
-        params = fleet_init(module, init_keys, X[0, :1])
-
-    fit_fn = make_fit_fn(module, cfg, steps, bs)
-    vfit = jax.vmap(fit_fn)
-
-    if mesh is not None:
-        ms = model_sharding(mesh)
-        fitted = jax.jit(
-            vfit,
-            in_shardings=(ms, ms, ms, ms, ms),
-            out_shardings=(ms, ms),
-        )
-    else:
-        fitted = jax.jit(vfit)
-
-    out_params, history = fitted(
-        params, jnp.asarray(X), jnp.asarray(y), jnp.asarray(w), fit_keys
+    staged = fleet_stage(
+        module, X, y, w, cfg, seeds=seeds, mesh=mesh, params=params
     )
-    return FleetFitResult(
-        params=out_params,
-        history=np.asarray(history)[:m],
-        n_models=m,
-    )
+    return fleet_dispatch(module, staged, cfg, mesh=mesh).collect()
 
 
 def fleet_apply(

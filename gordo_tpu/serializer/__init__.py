@@ -41,21 +41,42 @@ DEFINITION_FILE = "definition.yaml"
 MODEL_FILE = "model.pkl"
 
 
-def dump(model: Any, dest_dir: str, metadata: Optional[dict] = None) -> str:
-    """Serialize ``model`` (+ metadata) into ``dest_dir``; returns the dir."""
+def dump(
+    model: Any,
+    dest_dir: str,
+    metadata: Optional[dict] = None,
+    definition: Optional[str] = None,
+) -> str:
+    """Serialize ``model`` (+ metadata) into ``dest_dir``; returns the dir.
+
+    ``definition``: pre-serialized ``definition.yaml`` text (see
+    :func:`render_definition`) written verbatim instead of re-deriving it
+    — the fleet writer pool computes it once per homogeneous chunk
+    (machines in a chunk share one model config, so the bytes are
+    identical by construction) rather than walking the same config
+    hundreds of times.
+    """
     os.makedirs(dest_dir, exist_ok=True)
     with open(os.path.join(dest_dir, MODEL_FILE), "wb") as f:
         pickle.dump(model, f)
-    try:
-        definition = into_definition(model)
+    if definition is None:
+        definition = render_definition(model)
+    if definition is not None:
         with open(os.path.join(dest_dir, DEFINITION_FILE), "w") as f:
-            yaml.safe_dump(definition, f, sort_keys=False)
-    except Exception:  # definition round-trip is best-effort convenience
-        pass
+            f.write(definition)
     if metadata is not None:
         with open(os.path.join(dest_dir, METADATA_FILE), "w") as f:
             json.dump(metadata, f, indent=2, default=str)
     return dest_dir
+
+
+def render_definition(model: Any) -> Optional[str]:
+    """The ``definition.yaml`` text for ``model``, or None when the model
+    doesn't round-trip (best-effort convenience, as before)."""
+    try:
+        return yaml.safe_dump(into_definition(model), sort_keys=False)
+    except Exception:
+        return None
 
 
 def load(source_dir: str) -> Any:

@@ -20,6 +20,7 @@ them across stacked models and shards them over the device mesh.
 from __future__ import annotations
 
 import dataclasses
+import warnings
 from functools import partial
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -27,6 +28,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+
+# _fit_jit donates params/X/y/w.  Only params can alias an output, so XLA
+# reports X/y/w as "not usable" donations — donating them is still the
+# point: the padded training set frees at its last use inside the program
+# instead of surviving until the result fetch.  Silence that advisory.
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable"
+)
 
 OPTIMIZERS: Dict[str, Callable[..., optax.GradientTransformation]] = {
     "adam": optax.adam,
@@ -232,7 +241,15 @@ def make_fit_fn(module, cfg: TrainConfig, steps: int, bs: int) -> Callable:
 # two estimators built from the same factory kwargs produce EQUAL modules and
 # hit the same compiled executable (per-instance bound methods would not —
 # every CV fold / fleet member would recompile).
-@partial(jax.jit, static_argnames=("module", "cfg", "steps", "bs"))
+# params/X/y/w are DONATED: the fitted params alias the incoming params
+# buffers, and the (padded) training set frees at its last device use —
+# callers must hand over buffers they no longer need (fit() guarantees
+# this for its own callers by copying anything the caller still owns).
+@partial(
+    jax.jit,
+    static_argnames=("module", "cfg", "steps", "bs"),
+    donate_argnums=(4, 5, 6, 7),
+)
 def _fit_jit(module, cfg: TrainConfig, steps: int, bs: int,
              params, X, y, w, rng):
     return make_fit_fn(module, cfg, steps, bs)(params, X, y, w, rng)
@@ -247,11 +264,23 @@ def fit(module, X, y, cfg: TrainConfig,
     fits with the same shapes/config reuse the compiled program.
     """
     rng = rng if rng is not None else jax.random.PRNGKey(0)
+    X_in, y_in, params_in = X, y, params
     X = jnp.asarray(X, jnp.float32)
     y = jnp.asarray(y, jnp.float32)
     if params is None:
         init_rng, rng = jax.random.split(rng)
         params = init_params(module, init_rng, X[:1])
     Xp, yp, w, steps, bs = _pad_batches(X, y, cfg.batch_size)
+    # _fit_jit donates params/X/y/w; a donated buffer is deleted, so never
+    # hand over one the CALLER may still hold.  jnp.asarray copies host
+    # arrays and padding copies device arrays — only an unpadded
+    # caller-owned jax array (or caller-supplied params, or y aliasing X)
+    # can reach the donated slots, so copy exactly those cases.
+    if Xp is X_in:
+        Xp = jnp.array(Xp)
+    if yp is y_in or yp is Xp:
+        yp = jnp.array(yp)
+    if params_in is not None:
+        params = jax.tree.map(jnp.array, params)
     params, history = _fit_jit(module, cfg, steps, bs, params, Xp, yp, w, rng)
     return params, np.asarray(history)

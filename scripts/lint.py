@@ -30,6 +30,24 @@ METRIC_NAME_RE = re.compile(r"^gordo_[a-z_]+$")
 #: registration entrypoints whose first literal argument is a metric name
 METRIC_FACTORIES = {"counter", "gauge", "histogram"}
 
+#: latency-critical drive loops, by file basename → function names: the
+#: build-pipeline drive loop and the coalescer's drain thread.  A
+#: blocking device→host transfer there stalls EVERY stage behind it
+#: (the drain thread can't gather the next batch; the drive loop can't
+#: stage the next chunk), so direct D2H calls are design bugs in these
+#: scopes — results must flow through the writer/finish pools instead.
+#: ``# noqa`` opts a line out, as elsewhere.
+D2H_FORBIDDEN_SCOPES = {
+    "fleet_build.py": {"_drive_pipeline"},
+    "coalesce.py": {"_run", "_drain"},
+}
+#: attribute calls that force a blocking device→host transfer
+D2H_BLOCKING_ATTRS = {"device_get", "block_until_ready"}
+#: bare-name calls that do the same (gordo_tpu.utils.trees.to_host)
+D2H_BLOCKING_NAMES = {"to_host"}
+#: modules whose ``.asarray(...)`` materializes a jax array on host
+D2H_ASARRAY_MODULES = {"np", "numpy"}
+
 
 def iter_py_files(paths: List[str]) -> Iterator[str]:
     for path in paths:
@@ -75,6 +93,47 @@ class _ImportTracker(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+def _d2h_findings(path: str, tree: ast.AST, noqa_lines: set) -> List[Finding]:
+    """Flag blocking device→host calls inside the pipeline drive loop and
+    the coalescer drain thread (see ``D2H_FORBIDDEN_SCOPES``): direct
+    ``jax.device_get`` / ``.block_until_ready()`` / ``np.asarray`` (which
+    materializes a jax array on host) / ``to_host`` calls in those
+    function bodies."""
+    scopes = D2H_FORBIDDEN_SCOPES.get(os.path.basename(path))
+    if not scopes:
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name not in scopes:
+            continue
+        for call in ast.walk(node):
+            if not isinstance(call, ast.Call):
+                continue
+            func = call.func
+            bad = None
+            if isinstance(func, ast.Attribute):
+                if func.attr in D2H_BLOCKING_ATTRS:
+                    bad = func.attr
+                elif (
+                    func.attr == "asarray"
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id in D2H_ASARRAY_MODULES
+                ):
+                    bad = f"{func.value.id}.asarray"
+            elif isinstance(func, ast.Name) and func.id in D2H_BLOCKING_NAMES:
+                bad = func.id
+            if bad and call.lineno not in noqa_lines:
+                findings.append(
+                    (path, call.lineno,
+                     f"blocking D2H call {bad}() inside {node.name}() — "
+                     "this scope is a pipeline drive loop/drain thread; "
+                     "route results through the writer/finish pool")
+                )
+    return findings
+
+
 def lint_file(path: str) -> List[Finding]:
     findings: List[Finding] = []
     with open(path, encoding="utf-8") as f:
@@ -115,6 +174,8 @@ def lint_file(path: str) -> List[Finding]:
         for name, lineno in tracker.imports:
             if name not in tracker.used and lineno not in noqa_lines:
                 findings.append((path, lineno, f"unused import: {name}"))
+
+    findings.extend(_d2h_findings(path, tree, noqa_lines))
 
     for node in ast.walk(tree):
         if isinstance(node, ast.Call):

@@ -1,7 +1,9 @@
-"""bench.py stage selection (``--stage``): the CLI surface that lets an
-operator (or scripts/tpu_first.sh on a freshly healed tunnel) run ONE
-stage — e.g. serving_openloop — without paying for the rest.  Parsing
-only; the stages themselves run in the driver bench."""
+"""bench.py CLI surface: ``--stage`` selection (the knob that lets an
+operator — or scripts/tpu_first.sh on a freshly healed tunnel — run ONE
+stage without paying for the rest) and ``--round`` persistence wiring.
+Parsing only; the stages themselves run in the driver bench."""
+
+import json
 
 import pytest
 
@@ -10,14 +12,20 @@ import bench
 
 def test_default_runs_every_stage_in_priority_order():
     assert bench.parse_stages([]) == [
-        "build", "serving", "serving_openloop", "telemetry_overhead",
-        "lstm",
+        "build", "build_pipeline", "serving", "serving_openloop",
+        "telemetry_overhead", "lstm",
     ]
 
 
 def test_single_stage_selection():
     assert bench.parse_stages(["--stage", "serving_openloop"]) == [
         "serving_openloop"
+    ]
+
+
+def test_build_pipeline_stage_selectable():
+    assert bench.parse_stages(["--stage", "build_pipeline"]) == [
+        "build_pipeline"
     ]
 
 
@@ -32,3 +40,44 @@ def test_multi_stage_selection_is_canonically_ordered():
 def test_unknown_stage_rejected():
     with pytest.raises(SystemExit):
         bench.parse_stages(["--stage", "nope"])
+
+
+def test_round_flag_and_env(monkeypatch):
+    monkeypatch.delenv("BENCH_ROUND", raising=False)
+    assert bench.parse_cli([])[1] is None
+    assert bench.parse_cli(["--round", "9"])[1] == 9
+    monkeypatch.setenv("BENCH_ROUND", "7")
+    assert bench.parse_cli([])[1] == 7
+    # explicit flag beats the env
+    assert bench.parse_cli(["--round", "9"])[1] == 9
+
+
+def test_persist_round_atomic_write(tmp_path, monkeypatch):
+    """The round artifact lands complete via tmp+rename, and a write
+    failure is loud (nonzero exit code), not silent — the r6 round file
+    was referenced from CHANGES.md but never actually committed."""
+    monkeypatch.setattr(bench, "_REPO_DIR", str(tmp_path))
+    monkeypatch.setattr(bench, "_ROUND", 42)
+    monkeypatch.setattr(bench, "_round_write_failed", False)
+    doc = {"metric": "x", "value": 1.0}
+    bench.persist_round(doc)
+    path = tmp_path / "BENCH_r42.json"
+    assert path.exists()
+    assert json.loads(path.read_text()) == doc
+    assert bench.exit_code() == 0
+    # no stray tmp files
+    assert [p.name for p in tmp_path.iterdir()] == ["BENCH_r42.json"]
+
+    # unwritable target -> loud failure, nonzero exit
+    monkeypatch.setattr(bench, "_REPO_DIR", str(tmp_path / "nope" / "deeper"))
+    bench.persist_round(doc)
+    assert bench.exit_code() == 1
+
+
+def test_persist_round_noop_without_round(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "_REPO_DIR", str(tmp_path))
+    monkeypatch.setattr(bench, "_ROUND", None)
+    monkeypatch.setattr(bench, "_round_write_failed", False)
+    bench.persist_round({"metric": "x"})
+    assert list(tmp_path.iterdir()) == []
+    assert bench.exit_code() == 0

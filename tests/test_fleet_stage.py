@@ -1,0 +1,142 @@
+"""The pipelined fleet-fit surface (ISSUE 4): ``fleet_stage`` (async H2D)
+→ ``fleet_dispatch`` (donated buffers, async compute) → ``collect``
+(lazy history fetch), plus the single-copy stacked padding and the
+caller-params/seeds validation.  Fast lane: tiny module, two compiles."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gordo_tpu.parallel import fleet_mesh
+from gordo_tpu.parallel.fleet import (
+    StagedFleetFit,
+    _pad_models,
+    _pad_stacked,
+    fleet_dispatch,
+    fleet_fit,
+    fleet_init,
+    fleet_keys,
+    fleet_stage,
+)
+from gordo_tpu.registry import lookup_factory
+from gordo_tpu.train.fit import TrainConfig, fit
+
+M, N, F = 3, 40, 4
+CFG = TrainConfig(epochs=2, batch_size=32)
+
+
+@pytest.fixture(scope="module")
+def module():
+    return lookup_factory("AutoEncoder", "feedforward_hourglass")(
+        n_features=F, n_features_out=F
+    )
+
+
+@pytest.fixture()
+def data():
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((M, N, F)).astype(np.float32)
+    w = np.ones((M, N), np.float32)
+    return X, w
+
+
+class TestPadStacked:
+    def test_matches_the_old_double_concatenate(self):
+        rng = np.random.default_rng(1)
+        X = rng.standard_normal((3, 10, 2)).astype(np.float32)
+        for m_pad, n_total in ((3, 10), (4, 16), (3, 16), (8, 10)):
+            old = X
+            if n_total > 10:
+                old = np.concatenate(
+                    [old, np.zeros((3, n_total - 10, 2), np.float32)], axis=1
+                )
+            old = _pad_models(old, m_pad)
+            assert np.array_equal(old, _pad_stacked(X, m_pad, n_total))
+
+    def test_no_pad_returns_the_same_buffer(self):
+        X = np.ones((2, 5, 3), np.float32)
+        assert _pad_stacked(X, 2, 5) is X
+
+    def test_weights_never_repeat_the_last_machine(self):
+        w = np.ones((2, 5), np.float32)
+        out = _pad_stacked(w, 4, 8, repeat_last=False)
+        assert out[:2, :5].sum() == 10 and out.sum() == 10
+
+
+class TestStageDispatchCollect:
+    def test_matches_blocking_fleet_fit(self, module, data):
+        X, w = data
+        seeds = np.arange(M, dtype=np.uint32)
+        blocking = fleet_fit(module, X, X, w, CFG, seeds=seeds)
+        staged = fleet_stage(module, X, X, w, CFG, seeds=seeds)
+        assert isinstance(staged, StagedFleetFit)
+        res = fleet_dispatch(module, staged, CFG)
+        # history is lazy: still a device array until first access
+        assert not isinstance(res._history, np.ndarray)
+        res.collect()
+        assert isinstance(res._history, np.ndarray)
+        assert res.history.shape == (M, CFG.epochs)
+        assert np.array_equal(blocking.history, res.history)
+        for a, b in zip(
+            jax.tree.leaves(blocking.params), jax.tree.leaves(res.params)
+        ):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    def test_staged_batch_dispatches_exactly_once(self, module, data):
+        X, w = data
+        staged = fleet_stage(module, X, X, w, CFG)
+        fleet_dispatch(module, staged, CFG).collect()
+        with pytest.raises(RuntimeError, match="donated"):
+            fleet_dispatch(module, staged, CFG)
+
+    def test_history_property_slices_off_mesh_padding(self, module, data):
+        X, w = data
+        mesh = fleet_mesh()  # conftest pins 8 virtual devices; M=3 pads to 8
+        res = fleet_fit(module, X, X, w, CFG, mesh=mesh)
+        assert res.history.shape == (M, CFG.epochs)
+        assert len(res.unstack_params()) == M
+
+
+class TestCallerInputValidation:
+    def test_params_leading_axis_must_match_padded_fleet(self, module, data):
+        X, w = data
+        mesh = fleet_mesh()
+        init_keys, _ = fleet_keys(np.arange(M, dtype=np.uint32))
+        params3 = fleet_init(module, init_keys, jnp.asarray(X[0, :1]))
+        with pytest.raises(ValueError, match="leading model axis 8"):
+            fleet_fit(module, X, X, w, CFG, mesh=mesh, params=params3)
+
+    def test_correctly_padded_params_accepted_and_caller_copy_survives(
+        self, module, data
+    ):
+        X, w = data
+        mesh = fleet_mesh()
+        init_keys, _ = fleet_keys(np.arange(8, dtype=np.uint32))
+        params8 = fleet_init(module, init_keys, jnp.asarray(X[0, :1]))
+        res = fleet_fit(module, X, X, w, CFG, mesh=mesh, params=params8)
+        assert res.history.shape == (M, CFG.epochs)
+        # dispatch donated a COPY: the caller's pytree is still usable
+        for leaf in jax.tree.leaves(params8):
+            np.asarray(leaf)
+
+    def test_seeds_length_validated(self, module, data):
+        X, w = data
+        with pytest.raises(ValueError, match="one entry per machine"):
+            fleet_fit(
+                module, X, X, w, CFG, seeds=np.arange(5, dtype=np.uint32)
+            )
+
+
+class TestFitDonationSafety:
+    def test_caller_arrays_and_params_survive_fit(self, module):
+        """train.fit.fit donates into _fit_jit but must never delete a
+        buffer the caller still holds — including the X-aliases-y case
+        (AutoEncoder targets) and caller-supplied params."""
+        rng = np.random.default_rng(2)
+        Xj = jnp.asarray(rng.standard_normal((32, F)).astype(np.float32))
+        params, hist = fit(module, Xj, Xj, CFG)
+        float(Xj.sum())  # would raise if the buffer had been donated
+        params2, hist2 = fit(module, Xj, Xj, CFG, params=params)
+        np.asarray(jax.tree.leaves(params)[0])  # caller params intact
+        assert hist.shape == hist2.shape == (CFG.epochs,)
