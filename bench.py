@@ -764,6 +764,117 @@ def bench_telemetry_overhead(out: dict) -> None:
         shutil.rmtree(art_dir, ignore_errors=True)
 
 
+def bench_cold_start(out: dict) -> None:
+    """ISSUE 5 acceptance: cold-start elimination, measured end to end.
+
+    Protocol (docs/perf.md "Cold start"): build a small project once
+    (artifacts + warmup manifest on disk), then fork FRESH processes —
+    the quantity under test only exists in a process with empty compile
+    caches — via ``python -m gordo_tpu.compile.coldstart``:
+
+    - ``cold`` × K: no warmup; the first request eats the compile.
+    - ``warm`` × K: manifest-driven AOT warmup first; the first request
+      pays dispatch only.  p99 over the K per-process first requests
+      (each process contributes exactly one first request).
+    - cached restart: two ``warm`` runs sharing a persistent compile
+      cache (``GORDO_COMPILE_CACHE=force`` + a scratch
+      ``GORDO_COMPILE_CACHE_DIR`` — force because this container's CPU
+      backend is excluded by default; back-to-back runs on one machine
+      are the trusted single-machine case the override exists for).
+      Run 1 populates, run 2 must go ready measurably faster, with the
+      ``gordo_compile_cache_hits_total{cache="persistent"}`` counters
+      from run 2's exposition attested into the result doc.
+
+    Gates: warmed first-request p99 at least 5x below unwarmed, and
+    cached-restart time-to-ready below the uncached one.
+    """
+    from gordo_tpu.builder.fleet_build import build_project
+
+    trials = int(os.environ.get("BENCH_COLD_TRIALS", "5"))
+    rows = 256
+    art_dir = tempfile.mkdtemp(prefix="gordo-bench-cold-art-")
+    cache_dir = tempfile.mkdtemp(prefix="gordo-bench-cold-cache-")
+
+    def child(mode: str, env_extra: dict) -> dict:
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.pop("GORDO_COMPILE_CACHE_DIR", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.update(env_extra)
+        res = subprocess.run(
+            [sys.executable, "-m", "gordo_tpu.compile.coldstart",
+             "--artifacts", art_dir, "--mode", mode, "--rows", str(rows)],
+            env=env, stdout=subprocess.PIPE, text=True, timeout=300,
+        )
+        line = (res.stdout or "").strip().splitlines()
+        doc = json.loads(line[-1]) if line else {}
+        if res.returncode != 0 or "error" in doc:
+            raise RuntimeError(
+                f"cold-start child {mode} rc={res.returncode}: "
+                f"{doc.get('error', 'no output')}"
+            )
+        return doc
+
+    try:
+        machines = make_machines(8, n_tags=4, prefix="bench-cold")
+        result = build_project(machines, art_dir)
+        if result.failed:
+            raise RuntimeError(f"cold-start build failed: {result.failed}")
+
+        no_disk = {"GORDO_COMPILE_CACHE": "0"}
+        cold_runs = [child("cold", no_disk) for _ in range(trials)]
+        warm_runs = [child("warm", no_disk) for _ in range(trials)]
+        cold_p99 = float(np.percentile(
+            [r["first_request_s"] for r in cold_runs], 99
+        ))
+        warm_p99 = float(np.percentile(
+            [r["first_request_s"] for r in warm_runs], 99
+        ))
+        out["cold_start_trials"] = trials
+        out["cold_start_unwarmed_first_request_p99_ms"] = round(
+            cold_p99 * 1e3, 2
+        )
+        out["cold_start_warmed_first_request_p99_ms"] = round(
+            warm_p99 * 1e3, 2
+        )
+        out["cold_start_first_request_speedup"] = round(
+            cold_p99 / max(warm_p99, 1e-9), 2
+        )
+        out["cold_start_warmed_5x_ok"] = cold_p99 >= 5.0 * warm_p99
+        log(f"cold_start first request: unwarmed p99 {cold_p99 * 1e3:.0f}ms "
+            f"vs warmed p99 {warm_p99 * 1e3:.0f}ms "
+            f"({cold_p99 / max(warm_p99, 1e-9):.1f}x)")
+
+        # cached restart: populate the persistent cache, then restart.
+        # min-compile-time 0: the bench's deliberately small programs
+        # must exercise the disk round-trip the fleet's multi-second
+        # programs get by default.
+        disk = {"GORDO_COMPILE_CACHE": "force",
+                "GORDO_COMPILE_CACHE_DIR": cache_dir,
+                "GORDO_COMPILE_CACHE_MIN_SECONDS": "0"}
+        populate = child("warm", disk)
+        restart = child("warm", disk)
+        out["cold_start_time_to_ready_uncached_s"] = populate[
+            "time_to_ready_s"
+        ]
+        out["cold_start_time_to_ready_cached_s"] = restart["time_to_ready_s"]
+        out["cold_start_cached_restart_ok"] = (
+            restart["time_to_ready_s"] < populate["time_to_ready_s"]
+        )
+        hits = [
+            line for line in restart.get("compile_metrics", ())
+            if 'cache="persistent"' in line and "hits" in line
+        ]
+        out["cold_start_cache_hit_metrics"] = hits
+        out["cold_start_metrics_scrape"] = restart.get("compile_metrics")
+        log(f"cold_start time-to-ready: uncached "
+            f"{populate['time_to_ready_s']:.2f}s vs cached restart "
+            f"{restart['time_to_ready_s']:.2f}s; persistent hits: {hits}")
+    finally:
+        shutil.rmtree(art_dir, ignore_errors=True)
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
+
 def init_devices(attempts: int = 5, backoff_s: float = 2.0):
     """Initialize the jax backend with bounded retry.
 
@@ -885,7 +996,7 @@ def run_stage_bounded(
 #: stage registry order == run order == metric priority (a mid-run wedge
 #: costs the least important remaining numbers)
 STAGES = ("build", "build_pipeline", "serving", "serving_openloop",
-          "telemetry_overhead", "lstm")
+          "telemetry_overhead", "cold_start", "lstm")
 
 
 def parse_cli(argv: "list[str]") -> "tuple[list[str], int | None]":
@@ -1014,6 +1125,10 @@ def main(argv: "list[str] | None" = None) -> None:
         "telemetry_overhead": (
             lambda: bench_telemetry_overhead(out),
             lambda: min(remaining() * 0.7, 360),
+        ),
+        "cold_start": (
+            lambda: bench_cold_start(out),
+            lambda: min(remaining() * 0.7, 420),
         ),
         "lstm": (
             lambda: bench_lstm_build(mesh, out),

@@ -18,14 +18,13 @@ scorer; threshold derivation applies the same function per fold.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, Dict, Optional
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pandas as pd
 
+from gordo_tpu import compile as compile_plane
 from gordo_tpu.anomaly.base import AnomalyDetectorBase
 from gordo_tpu.models.utils import make_base_dataframe
 from gordo_tpu.ops.scalers import BaseTransform, MinMaxScaler
@@ -39,7 +38,7 @@ from gordo_tpu.utils.trees import to_host
 SMOOTHING_WINDOW = 6
 
 
-@partial(jax.jit, static_argnames=("scaler_cls",))
+@compile_plane.jit(name="anomaly.scores", static_argnames=("scaler_cls",))
 def scores_fn(scaler_cls, scaler_stats, y, y_pred):
     """Pure scoring: per-tag scaled |diff| and total L2 score."""
     y_s = scaler_cls.apply(scaler_stats, y)

@@ -26,6 +26,7 @@ the high-water mark so tests can hold the bound.
 
 from __future__ import annotations
 
+import hashlib
 import logging
 import os
 import shutil
@@ -636,6 +637,28 @@ def build_project(
         if n:
             tracker.release(n)
 
+    #: warmup-manifest entries, one per successfully fleet-built chunk —
+    #: the (signature, bucket) record the serve plane pre-compiles from
+    manifest_entries: List[Dict[str, Any]] = []
+
+    def _record_manifest(key: Tuple, ok_chunk: List[Machine]) -> None:
+        spec = specs[key]
+        widths = key[1]
+        manifest_entries.append(
+            {
+                "signature": hashlib.md5(
+                    repr(spec.signature).encode()
+                ).hexdigest()[:16],
+                "machines": [m.name for m in ok_chunk],
+                "n_machines": len(ok_chunk),
+                "n_features": int(widths[0]),
+                "n_outputs": int(widths[1]),
+                "lookback": int(
+                    getattr(spec.estimator_proto, "lookback_window", 1) or 1
+                ),
+            }
+        )
+
     def _run_bucket(key: Tuple, chunk: List[Machine], loaded: Dict[str, Tuple]):
         """Width-validate + train one chunk on device.  Returns
         ``(ok_chunk, detectors, fleet_seconds)`` or None when every
@@ -702,6 +725,7 @@ def build_project(
             if out is None:
                 continue
             ok_chunk, detectors, fleet_seconds = out
+            _record_manifest(key, ok_chunk)
             _PIPE_CHUNKS_TOTAL.inc(1.0, "serial")
             for m, det in zip(ok_chunk, detectors):
                 _dump_machine(
@@ -741,6 +765,7 @@ def build_project(
             if out is None:
                 continue
             ok_chunk, detectors, fleet_seconds = out
+            _record_manifest(key, ok_chunk)
             _PIPE_CHUNKS_TOTAL.inc(1.0, "pipelined")
             per_machine = fleet_seconds / len(ok_chunk)
             # machines in a chunk share ONE model config, so their
@@ -864,6 +889,16 @@ def build_project(
     result.seconds = time.time() - t_start
     result.peak_loaded = tracker.peak
     _write_telemetry_snapshot(output_dir, result.shard)
+    try:
+        # the (signature, bucket) set this build materialized — what the
+        # server (or `gordo warmup`) pre-compiles before going ready.  A
+        # fully-cached re-run records nothing and keeps the existing
+        # manifest; a partial rebuild merges into it.
+        from gordo_tpu.compile import write_warmup_manifest
+
+        write_warmup_manifest(output_dir, manifest_entries, shard=result.shard)
+    except Exception:  # the manifest is a hint, never a build failure
+        logger.exception("warmup manifest write failed")
     return result
 
 

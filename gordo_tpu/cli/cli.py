@@ -545,6 +545,104 @@ def client_download_model(ctx, output_dir, machine_names):
 
 
 # ---------------------------------------------------------------------------
+# warmup (compile plane)
+# ---------------------------------------------------------------------------
+
+@gordo.command("warmup")
+@click.option("--dir", "model_dir", default=None,
+              help="Artifact dir (a machine's, or a project output dir): "
+                   "pre-compile its serving programs from the build's "
+                   "warmup manifest and print per-program compile seconds. "
+                   "Exits non-zero on any compile failure, so an init "
+                   "container can gate rollout on it.")
+@click.option("--url", "server_url", default=None,
+              help="Poll a running server's /healthz until its startup "
+                   "warmup reports ready (exit non-zero on timeout or a "
+                   "warmup failure) — the remote twin of --dir for pods "
+                   "that warm themselves via run-server --warmup.")
+@click.option("--rows", "row_sizes", multiple=True, type=int,
+              help="Request row bucket(s) to pre-compile for (repeatable); "
+                   "default: the manifest's row buckets, else 256 and "
+                   "2048.")
+@click.option("--timeout", default=600.0, show_default=True,
+              help="--url mode: seconds to wait for the ready state.")
+def warmup_cmd(model_dir, server_url, row_sizes, timeout):
+    """Pre-compile serving programs (the cold-start gate).
+
+    ``--dir``: AOT-compile every (signature, row bucket) program for the
+    artifacts — run it in a kubernetes init container sharing
+    ``GORDO_COMPILE_CACHE_DIR`` with the server, and the server's own
+    warmup loads every program from the persistent cache in milliseconds.
+    ``--url``: wait for a self-warming server to report ready.
+    """
+    if bool(model_dir) == bool(server_url):
+        raise click.UsageError("provide exactly one of --dir or --url")
+    if model_dir:
+        from gordo_tpu.compile import warmup_collection
+        from gordo_tpu.serve.server import ModelCollection
+        from gordo_tpu.utils.compile_cache import (
+            enable_persistent_compile_cache,
+        )
+
+        enable_persistent_compile_cache()
+        try:
+            collection = ModelCollection.from_directory(model_dir)
+        except FileNotFoundError as exc:
+            raise click.ClickException(str(exc))
+        stats = warmup_collection(
+            collection, row_sizes=[int(r) for r in row_sizes] or None
+        )
+        for p in stats["programs"]:
+            click.echo(
+                f"{p['program']} rows={p['rows']}: {p['seconds']:.3f}s"
+                + ("  (cached)" if p["seconds"] == 0.0 else "")
+            )
+        click.echo(
+            f"warmup: {stats['buckets']} bucket(s), "
+            f"{len(stats['programs'])} program signature(s), "
+            f"{stats.get('compile_seconds', 0.0):.2f}s compiling, "
+            f"{stats['errors']} error(s)"
+        )
+        if stats["errors"]:
+            sys.exit(1)
+        return
+
+    # --url: poll /healthz until the server reports ready
+    import time as time_mod
+    import urllib.error
+    import urllib.request
+
+    url = server_url.rstrip("/")
+    if not url.endswith("/healthz"):
+        url += "/healthz"
+    deadline = time_mod.monotonic() + timeout
+    last_state = None
+    while time_mod.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                doc = json.loads(resp.read().decode())
+        except (urllib.error.URLError, OSError, ValueError):
+            doc = None  # not up yet — keep polling
+        state = (doc or {}).get("state")
+        if state != last_state and state is not None:
+            click.echo(f"{url}: {state}", err=True)
+            last_state = state
+        if state == "ready":
+            if doc.get("warmup_error") or doc.get("warmup_errors"):
+                raise click.ClickException(
+                    "server is ready but its warmup reported errors: "
+                    f"{doc.get('warmup_error') or doc.get('warmup_errors')}"
+                )
+            click.echo("ready")
+            return
+        time_mod.sleep(1.0)
+    raise click.ClickException(
+        f"server at {url} did not report ready within {timeout:.0f}s "
+        f"(last state: {last_state})"
+    )
+
+
+# ---------------------------------------------------------------------------
 # telemetry
 # ---------------------------------------------------------------------------
 

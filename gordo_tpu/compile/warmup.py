@@ -1,0 +1,261 @@
+"""Warmup manifest: the build plane tells the serve plane what to compile.
+
+``builder/fleet_build.py`` records the ``(signature, bucket)`` set each
+project build materialized — one entry per fleet chunk, carrying the
+machine names and the shape facts (widths, lookback) that determine the
+serving program family.  On startup the server pre-compiles from that
+manifest (:func:`warmup_collection`) through the compile plane's AOT path
+— ``lower(shapes).compile()``, no input data, no execution — and only
+then flips ``/healthz`` from ``warming`` to ``ready``, so the first
+request is never the compiling request.
+
+Layout mirrors the telemetry snapshots: ``<output_dir>/.gordo-warmup/``
+holds one JSON per build shard (multi-host shards each write their own
+file; a re-run overwrites only its own), and the reader merges them.
+A collection without a manifest still warms — the fleet scorer derives
+every bucket from the loaded models; the manifest adds the row-bucket
+hints and the per-program accounting the ``gordo warmup`` gate prints.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+logger = logging.getLogger(__name__)
+
+#: directory (under a build's output dir) where per-shard warmup
+#: manifests land
+WARMUP_DIR = ".gordo-warmup"
+
+#: request row buckets pre-compiled by default: the smallest serving
+#: bucket and the replayed-stream request shape (serve.scorer.MIN_BUCKET
+#: and the 2048-row bench/replay size)
+DEFAULT_ROW_BUCKETS = (256, 2048)
+
+MANIFEST_VERSION = 1
+
+
+def _shard_path(output_dir: str, shard) -> str:
+    pid, n = shard or (0, 1)
+    return os.path.join(
+        output_dir, WARMUP_DIR, f"shard-{pid:03d}-of-{n:03d}.json"
+    )
+
+
+def write_warmup_manifest(
+    output_dir: str,
+    entries: List[Dict[str, Any]],
+    shard=None,
+    row_buckets: Optional[Sequence[int]] = None,
+) -> Optional[str]:
+    """Write (merge) this build's warmup manifest shard file.
+
+    ``entries``: one dict per fleet chunk —
+    ``{"signature", "machines", "n_machines", "n_features", "n_outputs",
+    "lookback"}``.  Entries already on disk for machines NOT rebuilt this
+    run are kept (a partial rebuild must not unlearn the rest of the
+    project); entries overlapping the new machine set are replaced.
+    Returns the path written, or None when there was nothing to record
+    (a fully-cached re-run keeps the existing manifest untouched).
+    """
+    if not entries:
+        return None
+    path = _shard_path(output_dir, shard)
+    rebuilt = {name for e in entries for name in e.get("machines", ())}
+    kept: List[Dict[str, Any]] = []
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+        for e in doc.get("programs", ()):
+            if not rebuilt.intersection(e.get("machines", ())):
+                kept.append(e)
+    except (OSError, ValueError):
+        pass
+    doc = {
+        "version": MANIFEST_VERSION,
+        "row_buckets": sorted(
+            set(int(r) for r in (row_buckets or DEFAULT_ROW_BUCKETS))
+        ),
+        "programs": kept + list(entries),
+    }
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as fh:
+            json.dump(doc, fh, indent=1)
+        os.replace(tmp, path)
+    except OSError:
+        logger.exception("warmup manifest write failed: %s", path)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+    return path
+
+
+def load_warmup_manifest(path: str) -> Optional[Dict[str, Any]]:
+    """Merge every shard manifest under ``path`` (a build output dir, or
+    its ``.gordo-warmup/`` subdir directly).  Returns
+    ``{"row_buckets": [...], "programs": [...]}`` or None when no
+    manifest exists."""
+    candidates = [os.path.join(path, WARMUP_DIR), path]
+    directory = next((d for d in candidates if os.path.isdir(d)), None)
+    if directory is None:
+        return None
+    row_buckets: set = set()
+    programs: List[Dict[str, Any]] = []
+    for name in sorted(os.listdir(directory)):
+        if not name.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(directory, name)) as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            logger.warning("unreadable warmup manifest: %s", name)
+            continue
+        row_buckets.update(int(r) for r in doc.get("row_buckets", ()))
+        programs.extend(doc.get("programs", ()))
+    if not programs and not row_buckets:
+        return None
+    return {
+        "row_buckets": sorted(row_buckets) or list(DEFAULT_ROW_BUCKETS),
+        "programs": programs,
+    }
+
+
+def warmup_collection(
+    collection,
+    row_sizes: Optional[Sequence[int]] = None,
+    manifest: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Pre-compile a model collection's serving programs AOT.
+
+    Per structural bucket, per row bucket: the full stacked dispatch (the
+    ``_bulk`` route's program), the 1-machine subset dispatch (the
+    coalescer's common case), and the per-machine fused program — all via
+    ``Program.warm`` (lower+compile from shape structs; nothing
+    executes).  Returns stats including a ``programs`` list of
+    ``{"program", "rows", "seconds"}`` — the per-program compile accounting
+    the ``gordo warmup`` CLI prints — with ``seconds == 0.0`` marking a
+    signature that was already compiled (in-process or via the persistent
+    cache the XLA layer consults underneath).
+
+    Errors are counted, logged, and carried in ``stats["errors"]``; they
+    never raise — a warmup failure must not take down server startup
+    (the CLI gate turns the count into its exit code instead).
+    """
+    from gordo_tpu.serve.scorer import MIN_BUCKET, _bucket_rows
+
+    t0 = time.monotonic()
+    stats: Dict[str, Any] = {
+        "buckets": 0, "fallbacks": 0, "errors": 0, "programs": [],
+    }
+    if manifest is None and getattr(collection, "source_dir", None):
+        manifest = load_warmup_manifest(collection.source_dir)
+    if not row_sizes:
+        row_sizes = (manifest or {}).get("row_buckets") or [MIN_BUCKET, 2048]
+    try:
+        fleet = collection.fleet_scorer
+    except Exception:
+        logger.exception("Warmup: fleet scorer construction failed")
+        stats["errors"] += 1
+        return stats
+
+    for bucket in fleet.buckets:
+        ok = True
+        rows_set = sorted(
+            {_bucket_rows(max(int(r), bucket.lookback + 1)) for r in row_sizes}
+        )
+        try:
+            for label, rows, secs in bucket.warm_programs(rows_set):
+                stats["programs"].append(
+                    {"program": label, "rows": rows, "seconds": round(secs, 3)}
+                )
+        except Exception:
+            logger.exception(
+                "Warmup failed for bucket %s", bucket.names[:3]
+            )
+            stats["errors"] += 1
+            ok = False
+        # one per-machine fused program warms every machine sharing the
+        # architecture (flax modules hash structurally)
+        entry = collection.get(bucket.names[0])
+        if entry is not None and entry.scorer.fused:
+            n_feat = bucket.n_features or 1
+            for rows in rows_set:
+                try:
+                    for label, secs in entry.scorer.warm_programs(
+                        rows, n_feat
+                    ):
+                        stats["programs"].append(
+                            {
+                                "program": label,
+                                "rows": rows,
+                                "seconds": round(secs, 3),
+                            }
+                        )
+                except Exception:
+                    logger.exception(
+                        "Warmup failed for machine program %s rows=%d",
+                        bucket.names[0], rows,
+                    )
+                    stats["errors"] += 1
+                    ok = False
+            # one EXECUTED dispatch at the smallest row bucket: the AOT
+            # compiles above land the executables, but the first real
+            # dispatch still pays one-time runtime costs (backend thread
+            # pools, buffer paths) — ~30ms measured on CPU — that must
+            # not land on the first request either
+            if entry.scorer.is_anomaly:
+                try:
+                    import numpy as np
+
+                    entry.scorer.anomaly_arrays(
+                        np.zeros((rows_set[0], n_feat), np.float32)
+                    )
+                except Exception:
+                    logger.debug(
+                        "Warmup exercise skipped for %s",
+                        bucket.names[0], exc_info=True,
+                    )
+        if ok:
+            stats["buckets"] += 1
+
+    # fallback (non-fused) machines have no AOT program; executing their
+    # own scoring path once still lands whatever jit compiles it needs
+    for name in fleet.fallbacks:
+        entry = collection.get(name)
+        if entry is None:
+            continue
+        try:
+            import numpy as np
+
+            rows = max(MIN_BUCKET, getattr(entry.scorer, "offset", 0) + 1)
+            n_feat = len(entry.tags) or 1
+            X = np.zeros((rows, n_feat), np.float32)
+            if entry.scorer.is_anomaly:
+                entry.scorer.anomaly_arrays(X)
+            else:
+                entry.scorer.predict(X)
+            stats["fallbacks"] += 1
+        except Exception:
+            # fallback models often fail on zeros (e.g. missing thresholds
+            # raise by design) — debug-level, not an operational error
+            logger.debug("Warmup skipped fallback %s", name, exc_info=True)
+
+    stats["seconds"] = round(time.monotonic() - t0, 2)
+    stats["compile_seconds"] = round(
+        sum(p["seconds"] for p in stats["programs"]), 3
+    )
+    logger.info(
+        "Compile-plane warmup: %d bucket(s), %d program signature(s), "
+        "%.2fs compiling, %d error(s)",
+        stats["buckets"], len(stats["programs"]),
+        stats["compile_seconds"], stats["errors"],
+    )
+    return stats

@@ -47,11 +47,19 @@ def worker_env(
     local_devices: int = 2,
     barrier_timeout: Optional[float] = None,
     base_env: Optional[Dict[str, str]] = None,
+    compile_cache_dir: Optional[str] = None,
 ) -> Dict[str, str]:
     """Environment for one simulated worker: the ``GORDO_*`` multi-host
     contract plus a CPU backend with ``local_devices`` virtual devices
     (set BEFORE the child's jax initializes — the whole reason launching
-    is process-granular)."""
+    is process-granular).
+
+    ``compile_cache_dir``: point every worker's persistent XLA
+    compilation cache (``GORDO_COMPILE_CACHE_DIR``) at one shared path,
+    so the N forked processes compile each fleet program ONCE between
+    them instead of N times — the same wiring the generated multi-host
+    Indexed Job gets from its shared cache volume.
+    """
     env = dict(os.environ if base_env is None else base_env)
     env[ENV_COORDINATOR] = coordinator
     env[ENV_NUM_PROCESSES] = str(num_processes)
@@ -59,6 +67,8 @@ def worker_env(
     env[ENV_LOCAL_DEVICES] = str(local_devices)
     if barrier_timeout is not None:
         env[ENV_BARRIER_TIMEOUT] = str(barrier_timeout)
+    if compile_cache_dir is not None:
+        env["GORDO_COMPILE_CACHE_DIR"] = compile_cache_dir
     env["JAX_PLATFORMS"] = "cpu"
     # replace (not append) any inherited device-count flag: each worker
     # must see exactly its own count
@@ -78,6 +88,7 @@ def launch_workers(
     local_devices: int = 2,
     barrier_timeout: Optional[float] = None,
     stdout_dir: Optional[str] = None,
+    compile_cache_dir: Optional[str] = None,
 ) -> List[subprocess.Popen]:
     """Fork ``num_processes`` copies of ``argv`` wired as one multi-host
     job.  Returns the live Popen list (index == process_id).
@@ -92,6 +103,7 @@ def launch_workers(
         env = worker_env(
             pid, num_processes, coordinator,
             local_devices=local_devices, barrier_timeout=barrier_timeout,
+            compile_cache_dir=compile_cache_dir,
         )
         if stdout_dir:
             os.makedirs(stdout_dir, exist_ok=True)

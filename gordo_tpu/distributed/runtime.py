@@ -291,7 +291,12 @@ class DistributedRuntime:
         x = jax.make_array_from_process_local_data(
             sharding, full[mine], full.shape
         )
-        y = jax.jit(lambda a: a * 2.0, out_shardings=sharding)(x)
+        from gordo_tpu import compile as compile_plane
+
+        y = compile_plane.jit(
+            lambda a: a * 2.0, name="runtime.mesh_check",
+            out_shardings=sharding,
+        )(x)
         # every process checks ITS addressable shards came back right
         for shard in y.addressable_shards:
             np.testing.assert_array_equal(

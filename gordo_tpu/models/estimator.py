@@ -38,7 +38,9 @@ from gordo_tpu.utils.trees import param_count, to_host
 def _predict_jit_for(module):
     """One jitted apply per structurally-distinct module (flax modules are
     frozen dataclasses: equal factory output hashes equal)."""
-    return jax.jit(module.apply)
+    from gordo_tpu import compile as compile_plane
+
+    return compile_plane.jit(module.apply, name="estimator.predict")
 
 
 class BaseJaxEstimator(ParamsMixin, GordoBase):

@@ -49,6 +49,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
+from gordo_tpu import compile as compile_plane
 from gordo_tpu.anomaly.diff import SMOOTHING_WINDOW, DiffBasedAnomalyDetector
 from gordo_tpu.models.estimator import BaseJaxEstimator
 from gordo_tpu.ops.scalers import (
@@ -254,21 +255,6 @@ def _model_axis_pad(m: int, mesh) -> int:
     if mesh is not None:
         m_pad = pad_to_multiple(m_pad, mesh.shape[MODEL_AXIS])
     return m_pad
-
-
-def _program_cache_get(key):
-    """LRU lookup in the shared jitted-program cache (touch on hit)."""
-    cached = _EXACT_PROGRAMS.pop(key, None)
-    if cached is not None:
-        _EXACT_PROGRAMS[key] = cached  # re-insert as newest
-    return cached
-
-
-def _program_cache_put(key, jitted):
-    if len(_EXACT_PROGRAMS) >= 128:  # bound growth across many-length fleets
-        _EXACT_PROGRAMS.pop(next(iter(_EXACT_PROGRAMS)))
-    _EXACT_PROGRAMS[key] = jitted
-    return jitted
 
 
 # ---------------------------------------------------------------------------
@@ -622,10 +608,12 @@ class FleetDiffBuilder:
 # The exact compiled program (cached across equal-signature length-groups)
 # ---------------------------------------------------------------------------
 
-#: jitted program per (module, scalers, windowing, cfg, folds, mesh) — the
-#: closure must be cached so repeat builds (bench warm runs, CV re-runs) hit
-#: jax's compile cache instead of re-tracing a fresh closure every call.
-_EXACT_PROGRAMS: Dict[Tuple, Any] = {}
+# One jitted program per (module, scalers, windowing, cfg, folds, mesh) —
+# the closure must be cached so repeat builds (bench warm runs, CV re-runs)
+# hit jax's compile cache instead of re-tracing a fresh closure every call.
+# The cache itself lives in the compile plane (`compile.cached_closure`):
+# one LRU and one `gordo_compiled_programs` gauge across the whole stack,
+# replacing the private _EXACT_PROGRAMS dict this module used to keep.
 
 
 def _exact_fleet_program(
@@ -664,9 +652,6 @@ def _exact_fleet_program(
         folds_digest,
         mesh,
     )
-    cached = _program_cache_get(key)
-    if cached is not None:
-        return cached
 
     from gordo_tpu.ops import metrics as jmetrics
     from gordo_tpu.train.fit import batch_geometry
@@ -788,7 +773,12 @@ def _exact_fleet_program(
             out = jax.lax.with_sharding_constraint(out, model_sharding(mesh))
         return out
 
-    return _program_cache_put(key, jax.jit(program))
+    # closure construction above is cheap; on a cache hit the factory is
+    # never called and the PREVIOUSLY jitted closure (whose trace/compile
+    # caches are warm) is returned
+    return compile_plane.cached_closure(
+        key, lambda: compile_plane.jit(program, name="fleet.exact")
+    )
 
 
 def _padded_fleet_program(
@@ -840,9 +830,6 @@ def _padded_fleet_program(
         folds_digest,
         mesh,
     )
-    cached = _program_cache_get(key)
-    if cached is not None:
-        return cached
 
     from gordo_tpu.ops.metrics import WEIGHTED_METRICS
     from gordo_tpu.train.fit import batch_geometry, make_fit_fn
@@ -990,4 +977,6 @@ def _padded_fleet_program(
             out = jax.lax.with_sharding_constraint(out, model_sharding(mesh))
         return out
 
-    return _program_cache_put(key, jax.jit(program))
+    return compile_plane.cached_closure(
+        key, lambda: compile_plane.jit(program, name="fleet.padded")
+    )

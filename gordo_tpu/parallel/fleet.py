@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import dataclasses
 import warnings
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -296,35 +296,35 @@ def fleet_stage(
     )
 
 
-#: jitted (and donation-annotated) fleet programs, keyed on the static
-#: trace inputs — without this cache every fleet_dispatch re-traced a
-#: fresh vmap closure (the pre-pipeline fleet_fit did exactly that)
-_FLEET_PROGRAMS: Dict[Tuple, Any] = {}
-
-
+# Jitted (and donation-annotated) fleet programs, keyed on the static
+# trace inputs — without this cache every fleet_dispatch re-traced a
+# fresh vmap closure (the pre-pipeline fleet_fit did exactly that).  The
+# cache is the compile plane's shared closure LRU.
 def _fleet_program(module, cfg: TrainConfig, steps: int, bs: int, mesh):
-    key = (module, cfg, steps, bs, mesh)
-    cached = _FLEET_PROGRAMS.get(key)
-    if cached is not None:
-        return cached
-    vfit = jax.vmap(make_fit_fn(module, cfg, steps, bs))
-    # every argument is donated: out params alias the input params
-    # buffers, and X/y/w/fit_keys free at their last device use instead
-    # of outliving the program (see the module-level warning filter)
-    if mesh is not None:
-        ms = model_sharding(mesh)
-        jitted = jax.jit(
-            vfit,
-            in_shardings=(ms, ms, ms, ms, ms),
-            out_shardings=(ms, ms),
-            donate_argnums=(0, 1, 2, 3, 4),
+    from gordo_tpu import compile as compile_plane
+
+    key = ("fleet.fit", module, cfg, steps, bs, mesh)
+
+    def build():
+        vfit = jax.vmap(make_fit_fn(module, cfg, steps, bs))
+        # every argument is donated: out params alias the input params
+        # buffers, and X/y/w/fit_keys free at their last device use
+        # instead of outliving the program (see the module-level warning
+        # filter)
+        if mesh is not None:
+            ms = model_sharding(mesh)
+            return compile_plane.jit(
+                vfit,
+                name="fleet.fit_sharded",
+                in_shardings=(ms, ms, ms, ms, ms),
+                out_shardings=(ms, ms),
+                donate_argnums=(0, 1, 2, 3, 4),
+            )
+        return compile_plane.jit(
+            vfit, name="fleet.fit", donate_argnums=(0, 1, 2, 3, 4)
         )
-    else:
-        jitted = jax.jit(vfit, donate_argnums=(0, 1, 2, 3, 4))
-    if len(_FLEET_PROGRAMS) >= 64:  # bound growth across many configs
-        _FLEET_PROGRAMS.pop(next(iter(_FLEET_PROGRAMS)))
-    _FLEET_PROGRAMS[key] = jitted
-    return jitted
+
+    return compile_plane.cached_closure(key, build)
 
 
 def fleet_dispatch(
@@ -392,13 +392,21 @@ def fleet_apply(
     mesh: Optional[Mesh] = None,
 ) -> jnp.ndarray:
     """vmapped forward pass: stacked params (M, ...) x inputs (M, N, ...)."""
-    vapply = jax.vmap(lambda p, x: module.apply({"params": p}, x))
-    if mesh is not None:
-        ms = model_sharding(mesh)
-        return jax.jit(vapply, in_shardings=(ms, ms), out_shardings=ms)(
-            params, jnp.asarray(X)
-        )
-    return jax.jit(vapply)(params, jnp.asarray(X))
+    from gordo_tpu import compile as compile_plane
+
+    key = ("fleet.apply", module, mesh)
+
+    def build():
+        vapply = jax.vmap(lambda p, x: module.apply({"params": p}, x))
+        if mesh is not None:
+            ms = model_sharding(mesh)
+            return compile_plane.jit(
+                vapply, name="fleet.apply_sharded",
+                in_shardings=(ms, ms), out_shardings=ms,
+            )
+        return compile_plane.jit(vapply, name="fleet.apply")
+
+    return compile_plane.cached_closure(key, build)(params, jnp.asarray(X))
 
 
 # ---------------------------------------------------------------------------
@@ -436,12 +444,18 @@ def fit_data_parallel(
     params = module.init(init_rng, jnp.asarray(X[:1]))["params"]
     fit_fn = make_fit_fn(module, cfg, steps, bs)
 
+    from gordo_tpu import compile as compile_plane
+
     rows = NamedSharding(mesh, P(DATA_AXIS))
     repl = NamedSharding(mesh, P())
-    fitted = jax.jit(
-        fit_fn,
-        in_shardings=(repl, rows, rows, rows, repl),
-        out_shardings=(repl, repl),
+    fitted = compile_plane.cached_closure(
+        ("fleet.data_parallel_fit", module, cfg, steps, bs, mesh),
+        lambda: compile_plane.jit(
+            fit_fn,
+            name="fleet.data_parallel_fit",
+            in_shardings=(repl, rows, rows, rows, repl),
+            out_shardings=(repl, repl),
+        ),
     )
     out_params, history = fitted(
         params, jnp.asarray(X), jnp.asarray(y), jnp.asarray(w), rng

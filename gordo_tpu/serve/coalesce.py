@@ -55,6 +55,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from gordo_tpu import compile as compile_plane
 from gordo_tpu import telemetry
 
 logger = logging.getLogger(__name__)
@@ -410,6 +411,13 @@ class CoalescingScorer:
                 1.0, "no_gain" if self._knee_no_gain else "standdown"
             )
             return False
+        if compile_plane.warming():
+            # startup warmup still compiling: queue behind it rather than
+            # dispatch direct — a direct dispatch would block an executor
+            # thread on its own cold compile of the very program the
+            # warmup is about to land, while queued riders share ONE
+            # compile when the drain gets to them
+            return True
         if self.inflight < self.min_concurrency:
             self.n_bypassed += 1
             _BYPASSED_TOTAL.inc(1.0, "low_concurrency")

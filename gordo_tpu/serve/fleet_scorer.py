@@ -17,13 +17,13 @@ from __future__ import annotations
 
 import threading
 from collections import OrderedDict
-from functools import partial
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from gordo_tpu import compile as compile_plane
 from gordo_tpu.anomaly.diff import scores_fn
 from gordo_tpu.ops.windows import make_windows
 from gordo_tpu.serve.scorer import (
@@ -97,13 +97,14 @@ _STATIC_ARGS = (
     "with_thresholds", "smooth_window",
 )
 
-_fleet_score_program = partial(jax.jit, static_argnames=_STATIC_ARGS)(
-    _fleet_score_core
+#: the full-bucket stacked program, compile-plane-owned: warmup
+#: AOT-compiles it per (bucket signature, row bucket) before readiness
+_fleet_score_program = compile_plane.program(
+    "serve.fleet", _fleet_score_core, static_argnames=_STATIC_ARGS
 )
 
 
-@partial(jax.jit, static_argnames=_STATIC_ARGS)
-def _fleet_score_subset_program(
+def _fleet_score_subset_core(
     module,
     scaler_classes,
     mode,
@@ -137,6 +138,12 @@ def _fleet_score_subset_program(
         None if agg_thresholds is None else agg_thresholds[idx],
         X,
     )
+
+
+_fleet_score_subset_program = compile_plane.program(
+    "serve.fleet_subset", _fleet_score_subset_core,
+    static_argnames=_STATIC_ARGS,
+)
 
 
 class _Bucket:
@@ -286,6 +293,24 @@ class _Bucket:
             self._stack_bufs.move_to_end(shape)
         return buf
 
+    def _program_prefix(self) -> Tuple:
+        """The stacked programs' leading arguments — dispatch and AOT
+        warmup must assemble them identically (same objects, same static
+        values) or warmed executables would never be looked up."""
+        return (
+            self.module,
+            self.scaler_classes,
+            self.mode,
+            self.lookback,
+            self.det_cls,
+            self.with_thresholds,
+            self.smooth_window,
+            self.scaler_stats,
+            self.params,
+            self.det_stats,
+            self.agg_thresholds,
+        )
+
     def score(self, X_stack: np.ndarray) -> Dict[str, np.ndarray]:
         if self.mesh is not None:
             # host array straight to its shards (committed sharding -> XLA
@@ -298,39 +323,50 @@ class _Bucket:
             )
         else:
             X = jnp.asarray(X_stack, jnp.float32)
-        return _fleet_score_program(
-            self.module,
-            self.scaler_classes,
-            self.mode,
-            self.lookback,
-            self.det_cls,
-            self.with_thresholds,
-            self.smooth_window,
-            self.scaler_stats,
-            self.params,
-            self.det_stats,
-            self.agg_thresholds,
-            X,
-        )
+        return _fleet_score_program(*self._program_prefix(), X)
 
     def score_subset(
         self, X_stack: np.ndarray, idx: np.ndarray
     ) -> Dict[str, np.ndarray]:
         return _fleet_score_subset_program(
-            self.module,
-            self.scaler_classes,
-            self.mode,
-            self.lookback,
-            self.det_cls,
-            self.with_thresholds,
-            self.smooth_window,
-            self.scaler_stats,
-            self.params,
-            self.det_stats,
-            self.agg_thresholds,
+            *self._program_prefix(),
             jnp.asarray(idx, jnp.int32),
             jnp.asarray(X_stack, jnp.float32),
         )
+
+    def warm_programs(
+        self, row_sizes: "List[int]"
+    ) -> "List[Tuple[str, int, float]]":
+        """AOT-compile this bucket's stacked dispatch family for each row
+        bucket: the full-bucket program (the ``_bulk`` route) and — for
+        multi-machine buckets — the 1-machine subset gather (the
+        coalescer's common case).  Shape structs only; nothing executes.
+        Returns ``[(label, rows, compile_seconds), ...]``."""
+        n_feat = self.n_features or 1
+        out: "List[Tuple[str, int, float]]" = []
+        for rows in row_sizes:
+            x_kw = {}
+            if self.mesh is not None:
+                x_kw["sharding"] = self._x_sharding
+            X_full = jax.ShapeDtypeStruct(
+                (self.m_pad, int(rows), n_feat), jnp.float32, **x_kw
+            )
+            out.append((
+                "serve.fleet/full", int(rows),
+                _fleet_score_program.warm(*self._program_prefix(), X_full),
+            ))
+            if len(self.names) > 1:
+                idx = jax.ShapeDtypeStruct((1,), jnp.int32)
+                X_sub = jax.ShapeDtypeStruct(
+                    (1, int(rows), n_feat), jnp.float32
+                )
+                out.append((
+                    "serve.fleet/subset", int(rows),
+                    _fleet_score_subset_program.warm(
+                        *self._program_prefix(), idx, X_sub
+                    ),
+                ))
+        return out
 
 
 def _signature(chain: Dict[str, Any]) -> Optional[Tuple]:

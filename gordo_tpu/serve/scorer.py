@@ -20,13 +20,13 @@ that don't match run through their own (slower, host-side) ``.anomaly`` /
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from gordo_tpu import compile as compile_plane
 from gordo_tpu.anomaly.base import AnomalyDetectorBase
 from gordo_tpu.anomaly.diff import DiffBasedAnomalyDetector, scores_fn
 from gordo_tpu.models.estimator import (
@@ -168,8 +168,7 @@ def _rolling_median_blocked(
     return out[:, 0] if squeeze else out
 
 
-@partial(jax.jit, static_argnames=("module", "scaler_classes", "mode", "lookback", "det_cls", "with_anomaly", "smooth_window", "smooth_block"))
-def _score_program(
+def _score_program_fn(
     module,
     scaler_classes,
     mode,
@@ -214,6 +213,43 @@ def _score_program(
     return out
 
 
+#: the per-machine fused serving program, owned by the compile plane: the
+#: server's startup warmup AOT-compiles it per (signature, row bucket)
+#: before the readiness flip, so the first request never traces
+_score_program = compile_plane.program(
+    "serve.score",
+    _score_program_fn,
+    static_argnames=(
+        "module", "scaler_classes", "mode", "lookback", "det_cls",
+        "with_anomaly", "smooth_window", "smooth_block",
+    ),
+)
+
+
+def _program_args(
+    c: Dict[str, Any], X: Any, with_anomaly: bool, smooth_block: int
+) -> Tuple[Tuple, Dict[str, Any]]:
+    """The ONE assembly of ``_score_program``'s arguments — the dispatch
+    path (``_run``) and the AOT warmup (``warm_programs``) must agree on
+    every static value and pytree layout, or the warmed executable would
+    never be the one a request looks up."""
+    det = c["detector"]
+    args = (
+        c["module"],
+        tuple(cls for cls, _ in c["scalers"]),
+        c["mode"],
+        c["lookback"],
+        det["scaler_cls"] if det else None,
+        bool(with_anomaly and det),
+        det["window"] if (det and with_anomaly) else 0,
+        tuple(stats for _, stats in c["scalers"]),
+        c["params"],
+        det["scaler_stats"] if det else None,
+        X,
+    )
+    return args, {"smooth_block": smooth_block}
+
+
 class CompiledScorer:
     """Callable scoring surface over one model; jitted when possible."""
 
@@ -238,23 +274,31 @@ class CompiledScorer:
             X = np.concatenate(
                 [X, np.tile(X[-1:], (bucket - n, 1))]  # repeat-last padding
             )
-        det = c["detector"]
-        out = _score_program(
-            c["module"],
-            tuple(cls for cls, _ in c["scalers"]),
-            c["mode"],
-            c["lookback"],
-            det["scaler_cls"] if det else None,
-            bool(with_anomaly and det),
-            det["window"] if (det and with_anomaly) else 0,
-            tuple(stats for _, stats in c["scalers"]),
-            c["params"],
-            det["scaler_stats"] if det else None,
-            jnp.asarray(X, jnp.float32),
-            smooth_block=smooth_block,
+        args, kw = _program_args(
+            c, jnp.asarray(X, jnp.float32), with_anomaly, smooth_block
         )
+        out = _score_program(*args, **kw)
         n_valid = n - self.offset
         return {k: np.asarray(v)[:n_valid] for k, v in out.items()}
+
+    def warm_programs(self, rows: int, n_features: int) -> List[Tuple[str, float]]:
+        """AOT-compile this machine's fused program(s) for one row bucket
+        — shape structs only, nothing executes.  Returns
+        ``[(label, compile_seconds), ...]`` (0.0 = already compiled)."""
+        if not self.fused:
+            return []
+        X = jax.ShapeDtypeStruct((int(rows), int(n_features)), jnp.float32)
+        det = self.chain["detector"]
+        out: List[Tuple[str, float]] = []
+        variants = [("serve.score/predict", False)]
+        if self.is_anomaly and det is not None and not (
+            det["feature_thresholds"] is None and det["require_thresholds"]
+        ):
+            variants.append(("serve.score/anomaly", True))
+        for label, with_anomaly in variants:
+            args, kw = _program_args(self.chain, X, with_anomaly, 0)
+            out.append((label, _score_program.warm(*args, **kw)))
+        return out
 
     def _require_rows(self, X: np.ndarray) -> None:
         """Windowed models consume ``offset`` rows; fewer input rows than

@@ -650,6 +650,33 @@ async def readiness(request: web.Request) -> web.Response:
     return web.json_response({"ready": True})
 
 
+async def healthz(request: web.Request) -> web.Response:
+    """Liveness + warmup-state surface: 200 always (the process is up),
+    with ``state`` reporting ``warming`` while the startup warmup is
+    still pre-compiling serving programs and ``ready`` after — what
+    ``gordo warmup --url`` polls, and the human-readable twin of the
+    ``/ready`` readiness gate (which speaks HTTP status for kubernetes).
+    """
+    fut = request.app.get(WARMUP_TASK_KEY)
+    state = "warming" if (fut is not None and not fut.done()) else "ready"
+    doc: Dict[str, Any] = {
+        "state": state,
+        "gordo-server-version": gordo_tpu.__version__,
+    }
+    if state == "ready" and fut is not None:
+        # a FAILED warmup still goes ready (the pod can serve; programs
+        # compile lazily) but says so, so the init-container gate can tell
+        exc = None if fut.cancelled() else fut.exception()
+        if exc is not None:
+            doc["warmup_error"] = str(exc)
+        elif fut.done():
+            res = fut.result()
+            doc["warmup_errors"] = int(res.get("errors", 0)) if isinstance(
+                res, dict
+            ) else 0
+    return web.json_response(doc)
+
+
 async def metrics_endpoint(request: web.Request) -> web.Response:
     """Prometheus scrape surface (mounted at ``/metrics``, where every
     scraper looks by default).  Point-in-time gauges (collection size,
@@ -699,86 +726,22 @@ def warmup_scorers(
     collection: ModelCollection,
     row_sizes: Optional[List[int]] = None,
 ) -> Dict[str, Any]:
-    """Precompile the serving programs so early requests don't pay jit
+    """Precompile the serving programs so early requests don't pay
     compilation (~20-40s cold on TPU).
 
-    Per structural bucket, per row size in ``row_sizes`` (default: the
-    minimum bucket and the 2048-row bucket — the replayed-stream request
-    shape): one full-bucket stacked dispatch (the ``_bulk`` route's
-    program) and one per-machine fused program, plus one single-machine
-    subset dispatch (the coalescer's common case).  Programs are keyed by
-    power-of-two row bucket, so request sizes outside ``row_sizes`` still
-    compile on first use.  Flax modules hash structurally, so one machine
-    per bucket warms every machine sharing its architecture.  Errors are
-    logged, never raised: a warmup failure must not take down startup.
+    Delegates to the compile plane (:func:`gordo_tpu.compile.
+    warmup_collection`): per structural bucket, per row bucket, the full
+    stacked dispatch, the 1-machine subset gather, and the per-machine
+    fused program are AOT-compiled (``lower(shapes).compile()`` — no
+    input data, nothing executes).  Row buckets come from ``row_sizes``,
+    else the build's warmup manifest under the collection's source dir,
+    else the defaults (the minimum serving bucket and the 2048-row
+    replayed-stream shape).  Errors are logged and counted, never raised:
+    a warmup failure must not take down startup.
     """
-    from gordo_tpu.serve.scorer import MIN_BUCKET
+    from gordo_tpu.compile import warmup_collection
 
-    if not row_sizes:  # None or an explicit empty list
-        row_sizes = [MIN_BUCKET, 2048]
-    t0 = time.monotonic()
-    stats = {"buckets": 0, "fallbacks": 0, "errors": 0}
-    try:
-        fleet = collection.fleet_scorer
-    except Exception:
-        logger.exception("Warmup: fleet scorer construction failed")
-        stats["errors"] += 1
-        return stats
-    for bucket in fleet.buckets:
-        n_feat = bucket.n_features or 1
-        ok = True
-        for rows in sorted({max(r, bucket.lookback + 1) for r in row_sizes}):
-            X = np.zeros((rows, n_feat), np.float32)
-            try:
-                fleet.score_all({n: X for n in bucket.names})  # full bucket
-                entry = collection.get(bucket.names[0])
-                if entry is not None and entry.scorer.is_anomaly:
-                    entry.scorer.anomaly_arrays(X)  # per-machine route
-            except Exception:
-                logger.exception(
-                    "Warmup failed for bucket %s rows=%d",
-                    bucket.names[:3], rows,
-                )
-                stats["errors"] += 1
-                ok = False
-        if len(bucket.names) > 1:
-            try:  # 1-machine subset dispatch (coalescer's common case)
-                fleet.score_all(
-                    {
-                        bucket.names[0]: np.zeros(
-                            (max(row_sizes[0], bucket.lookback + 1), n_feat),
-                            np.float32,
-                        )
-                    }
-                )
-            except Exception:
-                logger.exception(
-                    "Warmup subset failed for bucket %s", bucket.names[:3]
-                )
-                stats["errors"] += 1
-                ok = False
-        if ok:
-            stats["buckets"] += 1
-    for name in fleet.fallbacks:
-        entry = collection.get(name)
-        if entry is None:
-            continue
-        try:
-            rows = max(MIN_BUCKET, getattr(entry.scorer, "offset", 0) + 1)
-            n_feat = len(entry.tags) or 1
-            X = np.zeros((rows, n_feat), np.float32)
-            if entry.scorer.is_anomaly:
-                entry.scorer.anomaly_arrays(X)
-            else:
-                entry.scorer.predict(X)
-            stats["fallbacks"] += 1
-        except Exception:
-            # fallback models often fail on zeros (e.g. missing thresholds
-            # raise by design) — debug-level, not an operational error
-            logger.debug("Warmup skipped fallback %s", name, exc_info=True)
-    stats["seconds"] = round(time.monotonic() - t0, 2)
-    logger.info("Serving warmup done: %s", stats)
-    return stats
+    return warmup_collection(collection, row_sizes=row_sizes)
 
 
 def build_app(
@@ -815,6 +778,7 @@ def build_app(
     app[COLLECTION_KEY] = collection
 
     if warmup:
+        from gordo_tpu import compile as compile_plane
 
         async def _warmup(app: web.Application):
             # a DAEMON thread, not the loop's executor: compiles can't be
@@ -855,7 +819,12 @@ def build_app(
                     _resolve(lambda e=exc: fut.set_exception(e))
                 else:
                     _resolve(lambda: fut.set_result(res))
+                finally:
+                    # /healthz flips to "ready" and the coalescer stops
+                    # queueing riders behind the warmup
+                    compile_plane.set_warming(False)
 
+            compile_plane.set_warming(True)
             threading.Thread(
                 target=runner, name="gordo-warmup", daemon=True
             ).start()
@@ -912,6 +881,8 @@ def build_app(
     # scrape surface at the conventional root path (no project segment:
     # one process = one scrape target, whatever it hosts)
     app.router.add_get("/metrics", metrics_endpoint)
+    # liveness + warmup state at the conventional root path too
+    app.router.add_get("/healthz", healthz)
     p = f"{API_PREFIX}/{{project}}"
     app.router.add_get(f"{p}/", project_index)
     app.router.add_get(f"{p}/ready", readiness)

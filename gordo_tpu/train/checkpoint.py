@@ -33,12 +33,13 @@ import logging
 import os
 import pickle
 import shutil
-from functools import partial
 from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from gordo_tpu import compile as compile_plane
 
 from gordo_tpu.train.fit import (
     TrainConfig,
@@ -161,7 +162,10 @@ def load_checkpoint(
 
 # Static-keyed like fit._fit_jit so CV folds / repeat fits with the same
 # (module, cfg, shapes) reuse one compiled executable per chunk size.
-@partial(jax.jit, static_argnames=("module", "cfg", "steps", "bs"))
+@compile_plane.jit(
+    name="train.stateful_fit",
+    static_argnames=("module", "cfg", "steps", "bs"),
+)
 def _stateful_fit_jit(module, cfg: TrainConfig, steps: int, bs: int,
                       params, opt_state, X, y, w, epoch_keys):
     return make_stateful_fit_fn(module, cfg, steps, bs)(

@@ -21,13 +21,14 @@ from __future__ import annotations
 
 import dataclasses
 import warnings
-from functools import partial
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+
+from gordo_tpu import compile as compile_plane
 
 # _fit_jit donates params/X/y/w.  Only params can alias an output, so XLA
 # reports X/y/w as "not usable" donations — donating them is still the
@@ -245,14 +246,17 @@ def make_fit_fn(module, cfg: TrainConfig, steps: int, bs: int) -> Callable:
 # buffers, and the (padded) training set frees at its last device use —
 # callers must hand over buffers they no longer need (fit() guarantees
 # this for its own callers by copying anything the caller still owns).
-@partial(
-    jax.jit,
+def _fit_jit_fn(module, cfg: TrainConfig, steps: int, bs: int,
+                params, X, y, w, rng):
+    return make_fit_fn(module, cfg, steps, bs)(params, X, y, w, rng)
+
+
+_fit_jit = compile_plane.jit(
+    _fit_jit_fn,
+    name="train.fit",
     static_argnames=("module", "cfg", "steps", "bs"),
     donate_argnums=(4, 5, 6, 7),
 )
-def _fit_jit(module, cfg: TrainConfig, steps: int, bs: int,
-             params, X, y, w, rng):
-    return make_fit_fn(module, cfg, steps, bs)(params, X, y, w, rng)
 
 
 def fit(module, X, y, cfg: TrainConfig,

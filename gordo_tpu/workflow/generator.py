@@ -42,6 +42,11 @@ DEFAULT_WATCHMAN_PORT = 5556
 #: jax.distributed coordination-service port on process 0 of a multi-host
 #: builder Job (the conventional jax coordinator port)
 DEFAULT_COORDINATOR_PORT = 8476
+#: where the shared persistent XLA compilation cache mounts in builder and
+#: server pods — one PVC per project, so a restarted server (or any worker
+#: of a --multihost Indexed Job) loads executables its peers already
+#: compiled instead of re-paying every cold compile
+COMPILE_CACHE_MOUNT = "/compile-cache"
 
 
 def unique_tags(machines: List[Machine]) -> List[str]:
@@ -276,6 +281,19 @@ def _multihost_builder_docs(
     return [job, headless]
 
 
+def _compile_cache_volume(project: str) -> Dict:
+    return {
+        "name": "compile-cache",
+        "persistentVolumeClaim": {
+            "claimName": f"gordo-compile-cache-{project}"
+        },
+    }
+
+
+def _compile_cache_env() -> Dict[str, str]:
+    return {"name": "GORDO_COMPILE_CACHE_DIR", "value": COMPILE_CACHE_MOUNT}
+
+
 def _builder_job(project: str, image: str, tpu_resources: Dict[str, Any]) -> Dict:
     return {
         "apiVersion": "batch/v1",
@@ -302,11 +320,18 @@ def _builder_job(project: str, image: str, tpu_resources: Dict[str, Any]) -> Dic
                             ],
                             "env": [
                                 {"name": "PROJECT_NAME", "value": project},
+                                # shared persistent XLA compile cache: a
+                                # retried Job (and every worker of a
+                                # --multihost Indexed Job, which extends
+                                # this template) reuses peers' compiles
+                                _compile_cache_env(),
                             ],
                             "resources": tpu_resources,
                             "volumeMounts": [
                                 {"name": "models", "mountPath": "/models"},
                                 {"name": "project-config", "mountPath": "/config"},
+                                {"name": "compile-cache",
+                                 "mountPath": COMPILE_CACHE_MOUNT},
                             ],
                         }
                     ],
@@ -321,6 +346,7 @@ def _builder_job(project: str, image: str, tpu_resources: Dict[str, Any]) -> Dic
                             "name": "project-config",
                             "configMap": {"name": f"gordo-config-{project}"},
                         },
+                        _compile_cache_volume(project),
                     ],
                 },
             },
@@ -370,6 +396,12 @@ def _server_deployment(
                                 "--warmup",
                                 *(server_args or []),
                             ],
+                            # the warmup loads executables the builder (or
+                            # a previous server incarnation) already put in
+                            # the shared compile cache — a rescheduled pod
+                            # goes ready in cache-load time, not compile
+                            # time
+                            "env": [_compile_cache_env()],
                             "ports": [{"containerPort": DEFAULT_SERVER_PORT}],
                             "readinessProbe": {
                                 # /ready returns 503 until the startup
@@ -384,6 +416,8 @@ def _server_deployment(
                             "volumeMounts": [
                                 {"name": "models", "mountPath": "/models",
                                  "readOnly": True},
+                                {"name": "compile-cache",
+                                 "mountPath": COMPILE_CACHE_MOUNT},
                             ],
                         }
                     ],
@@ -394,6 +428,7 @@ def _server_deployment(
                                 "claimName": f"gordo-models-{project}"
                             },
                         },
+                        _compile_cache_volume(project),
                     ],
                 },
             },

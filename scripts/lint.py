@@ -48,6 +48,46 @@ D2H_BLOCKING_NAMES = {"to_host"}
 #: modules whose ``.asarray(...)`` materializes a jax array on host
 D2H_ASARRAY_MODULES = {"np", "numpy"}
 
+#: the ONE module family allowed to touch jax.jit directly: the compile
+#: plane (gordo_tpu/compile/) owns every jitted program in the stack —
+#: register through compile.program (AOT serving path) or compile.jit
+#: (passthrough) instead.  Tests are allowlisted (they jit ad-hoc probe
+#: functions); ``# noqa`` opts a line out, as elsewhere.
+JIT_ALLOWED_DIR = os.path.join("gordo_tpu", "compile")
+
+
+def _jit_allowed(path: str) -> bool:
+    norm = os.path.normpath(path)
+    parts = norm.split(os.sep)
+    if "tests" in parts or os.path.basename(norm).startswith("test_"):
+        return True
+    return JIT_ALLOWED_DIR in norm
+
+
+def _jit_findings(path: str, tree: ast.AST, noqa_lines: set) -> List[Finding]:
+    """Flag ``jax.jit`` references (decorator, call, or partial argument)
+    outside the compile plane: on-first-call jit tracing is exactly the
+    cold-start ambush the compile plane exists to schedule away, and a
+    program it doesn't know about can't be warmed, counted, or evicted."""
+    if _jit_allowed(path):
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Attribute)
+            and node.attr == "jit"
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "jax"
+            and node.lineno not in noqa_lines
+        ):
+            findings.append(
+                (path, node.lineno,
+                 "bare jax.jit outside gordo_tpu/compile/ — register the "
+                 "program with the compile plane (compile.program for the "
+                 "AOT serving path, compile.jit as a passthrough)")
+            )
+    return findings
+
 
 def iter_py_files(paths: List[str]) -> Iterator[str]:
     for path in paths:
@@ -176,6 +216,7 @@ def lint_file(path: str) -> List[Finding]:
                 findings.append((path, lineno, f"unused import: {name}"))
 
     findings.extend(_d2h_findings(path, tree, noqa_lines))
+    findings.extend(_jit_findings(path, tree, noqa_lines))
 
     for node in ast.walk(tree):
         if isinstance(node, ast.Call):

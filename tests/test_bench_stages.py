@@ -13,8 +13,12 @@ import bench
 def test_default_runs_every_stage_in_priority_order():
     assert bench.parse_stages([]) == [
         "build", "build_pipeline", "serving", "serving_openloop",
-        "telemetry_overhead", "lstm",
+        "telemetry_overhead", "cold_start", "lstm",
     ]
+
+
+def test_cold_start_stage_selectable():
+    assert bench.parse_stages(["--stage", "cold_start"]) == ["cold_start"]
 
 
 def test_single_stage_selection():
@@ -81,3 +85,22 @@ def test_persist_round_noop_without_round(tmp_path, monkeypatch):
     bench.persist_round({"metric": "x"})
     assert list(tmp_path.iterdir()) == []
     assert bench.exit_code() == 0
+
+
+@pytest.mark.slow
+def test_cold_start_stage_smoke(monkeypatch):
+    """The CI slow-lane cold_start smoke (ISSUE 5 satellite): one trial of
+    the full stage — build, forked cold/warm children, cached restart —
+    must produce the acceptance fields with the gates holding on CPU."""
+    monkeypatch.setenv("BENCH_COLD_TRIALS", "1")
+    out = {}
+    bench.bench_cold_start(out)
+    assert out["cold_start_warmed_5x_ok"] is True
+    assert (
+        out["cold_start_unwarmed_first_request_p99_ms"]
+        >= 5.0 * out["cold_start_warmed_first_request_p99_ms"]
+    )
+    assert out["cold_start_cached_restart_ok"] is True
+    assert out["cold_start_cache_hit_metrics"], (
+        "persistent-cache hits must be attested in the child's exposition"
+    )

@@ -1,0 +1,454 @@
+"""Compile plane: AOT program registry, warmup manifest round-trip,
+warming→ready readiness, persistent-cache reuse across a process restart.
+
+The acceptance-critical pins (ISSUE 5):
+
+- serving results byte-identical with warmup on vs off (the AOT
+  executable and the jit path are the same HLO);
+- build → manifest → server pre-compile round-trip: what the builder
+  records is what warmup compiles, and the first request after warmup
+  dispatches a cache HIT, not a compile;
+- ``/healthz`` reports ``warming`` under concurrent traffic and flips to
+  ``ready`` exactly when the warmup future resolves;
+- a forked process pointed at the same ``GORDO_COMPILE_CACHE_DIR``
+  reuses the parent population's compiles (slow lane).
+"""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from gordo_tpu import compile as compile_plane
+from gordo_tpu import telemetry
+from gordo_tpu.builder import build_project
+from gordo_tpu.compile import (
+    load_warmup_manifest,
+    warmup_collection,
+    write_warmup_manifest,
+)
+from gordo_tpu.serve import ModelCollection, build_app
+from gordo_tpu.workflow import NormalizedConfig
+
+PROJECT = {
+    "machines": [
+        {
+            "name": f"cp-machine-{i}",
+            "dataset": {
+                "type": "RandomDataset",
+                "tags": ["tag-1", "tag-2", "tag-3"],
+                "train_start_date": "2017-12-25T06:00:00Z",
+                "train_end_date": "2017-12-27T06:00:00Z",
+            },
+        }
+        for i in range(3)
+    ],
+    "globals": {
+        "model": {
+            "gordo_tpu.anomaly.diff.DiffBasedAnomalyDetector": {
+                "base_estimator": {
+                    "gordo_tpu.pipeline.Pipeline": {
+                        "steps": [
+                            "gordo_tpu.ops.scalers.MinMaxScaler",
+                            {
+                                "gordo_tpu.models.estimator.AutoEncoder": {
+                                    "kind": "feedforward_hourglass",
+                                    "epochs": 2,
+                                    "batch_size": 64,
+                                }
+                            },
+                        ]
+                    }
+                }
+            }
+        }
+    },
+}
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    out = tmp_path_factory.mktemp("cp-artifacts")
+    cfg = NormalizedConfig(PROJECT, "cpproj")
+    result = build_project(cfg.machines, str(out))
+    assert not result.failed
+    return str(out)
+
+
+# ---------------------------------------------------------------------------
+# Program registry
+# ---------------------------------------------------------------------------
+
+def test_program_aot_matches_jit_bitwise():
+    import jax.numpy as jnp
+
+    def f(mode, stats, x):
+        y = x * stats["a"] + stats["b"]
+        return {"out": y if mode == "double" else -y}
+
+    prog = compile_plane.Program("test.parity", f, static_argnames=("mode",))
+    stats = {"a": jnp.full((4,), 1.5), "b": jnp.full((4,), -0.25)}
+    x = np.random.default_rng(0).standard_normal((8, 4)).astype(np.float32)
+    via_plane = prog("double", stats, x)
+    via_jit = prog._jitted("double", stats, x)
+    np.testing.assert_array_equal(
+        np.asarray(via_plane["out"]), np.asarray(via_jit["out"])
+    )
+
+
+def test_program_warm_precompiles_and_call_hits():
+    import jax
+    import jax.numpy as jnp
+
+    def g(x):
+        return x + 1.0
+
+    prog = compile_plane.Program("test.warm", g)
+    sds = jax.ShapeDtypeStruct((5,), jnp.float32)
+    first = prog.warm(sds)
+    assert first > 0.0  # compiled now
+    assert prog.warm(sds) == 0.0  # second warm is a no-op
+    reg = telemetry.REGISTRY.snapshot()
+    before = _counter(reg, "gordo_compile_cache_hits_total", "programs")
+    out = prog(np.arange(5, dtype=np.float32))
+    np.testing.assert_array_equal(
+        np.asarray(out), np.arange(5, dtype=np.float32) + 1.0
+    )
+    after = _counter(
+        telemetry.REGISTRY.snapshot(), "gordo_compile_cache_hits_total",
+        "programs",
+    )
+    assert after == before + 1  # the real call hit the warmed executable
+
+
+def _counter(snapshot, name, label_value):
+    metric = snapshot["metrics"].get(name) or {}
+    for key, value in metric.get("series", {}).items():
+        if label_value in json.loads(key):
+            return value
+    return 0.0
+
+
+def test_registry_lru_evicts_executables():
+    import jax
+    import jax.numpy as jnp
+
+    reg = compile_plane.CompileRegistry(max_executables=2)
+
+    def h(x):
+        return x * 3.0
+
+    prog = compile_plane.Program("test.evict", h, registry=reg)
+    for n in (2, 3, 4):
+        prog.warm(jax.ShapeDtypeStruct((n,), jnp.float32))
+    assert reg.n_executables() == 2  # the first signature evicted
+
+
+def test_cached_closure_shares_one_policy():
+    calls = []
+
+    def factory():
+        calls.append(1)
+        return object()
+
+    a = compile_plane.cached_closure(("test.closure", 1), factory)
+    b = compile_plane.cached_closure(("test.closure", 1), factory)
+    assert a is b and len(calls) == 1
+
+
+def test_plane_kill_switch_uses_plain_jit(monkeypatch):
+    monkeypatch.setenv("GORDO_COMPILE_PLANE", "off")
+
+    def f(x):
+        return x - 2.0
+
+    prog = compile_plane.Program("test.off", f)
+    out = prog(np.arange(3, dtype=np.float32))
+    np.testing.assert_array_equal(
+        np.asarray(out), np.arange(3, dtype=np.float32) - 2.0
+    )
+    assert prog._registry._get_executable is not None  # nothing cached:
+    # plain-jit dispatch leaves the AOT cache untouched for this call
+    # (the registry may hold entries from other tests; assert via name)
+    assert not any(
+        key[0] == "test.off" for key in prog._registry._executables
+    )
+
+
+# ---------------------------------------------------------------------------
+# warmup manifest round-trip
+# ---------------------------------------------------------------------------
+
+def test_build_writes_warmup_manifest(model_dir):
+    manifest = load_warmup_manifest(model_dir)
+    assert manifest is not None
+    machines = {
+        name for entry in manifest["programs"] for name in entry["machines"]
+    }
+    assert machines == {f"cp-machine-{i}" for i in range(3)}
+    entry = manifest["programs"][0]
+    assert entry["n_features"] == 3 and entry["n_outputs"] == 3
+    assert entry["signature"]
+    assert manifest["row_buckets"] == [256, 2048]
+
+
+def test_manifest_merge_keeps_disjoint_entries(tmp_path):
+    out = str(tmp_path)
+    write_warmup_manifest(
+        out, [{"signature": "aaa", "machines": ["m1"], "n_machines": 1,
+               "n_features": 2, "n_outputs": 2, "lookback": 1}]
+    )
+    # a later partial rebuild of a DIFFERENT machine merges, not clobbers
+    write_warmup_manifest(
+        out, [{"signature": "bbb", "machines": ["m2"], "n_machines": 1,
+               "n_features": 2, "n_outputs": 2, "lookback": 1}]
+    )
+    # rebuilding m1 replaces its entry
+    write_warmup_manifest(
+        out, [{"signature": "ccc", "machines": ["m1"], "n_machines": 1,
+               "n_features": 2, "n_outputs": 2, "lookback": 1}]
+    )
+    manifest = load_warmup_manifest(out)
+    by_machine = {e["machines"][0]: e["signature"]
+                  for e in manifest["programs"]}
+    assert by_machine == {"m1": "ccc", "m2": "bbb"}
+    # an empty (fully-cached) re-run leaves the manifest untouched
+    assert write_warmup_manifest(out, []) is None
+    assert load_warmup_manifest(out)["programs"] == manifest["programs"]
+
+
+def test_warmup_collection_precompiles_from_manifest(model_dir):
+    collection = ModelCollection.from_directory(model_dir, project="cpproj")
+    stats = warmup_collection(collection)
+    assert stats["errors"] == 0
+    assert stats["buckets"] == 1
+    labels = {p["program"] for p in stats["programs"]}
+    assert "serve.fleet/full" in labels
+    assert "serve.fleet/subset" in labels
+    assert "serve.score/anomaly" in labels
+    # manifest row buckets drove the warm set
+    rows = {p["rows"] for p in stats["programs"]}
+    assert rows == {256, 2048}
+
+
+def test_serving_results_identical_warmup_on_vs_off(model_dir):
+    """The acceptance parity pin: a warmed collection returns byte-for-
+    byte what an unwarmed one does (same machines, same request)."""
+    rng = np.random.default_rng(7)
+    X = rng.standard_normal((300, 3)).astype(np.float32)
+
+    warmed = ModelCollection.from_directory(model_dir, project="cpproj")
+    assert warmup_collection(warmed)["errors"] == 0
+    res_warm = warmed.fleet_scorer.score_all(
+        {name: X for name in warmed.entries}
+    )
+    cold = ModelCollection.from_directory(model_dir, project="cpproj")
+    res_cold = cold.fleet_scorer.score_all(
+        {name: X for name in cold.entries}
+    )
+    assert set(res_warm) == set(res_cold)
+    for name in res_warm:
+        for key in res_warm[name]:
+            np.testing.assert_array_equal(
+                np.asarray(res_warm[name][key]),
+                np.asarray(res_cold[name][key]),
+                err_msg=f"{name}/{key} diverged between warmup on and off",
+            )
+    # per-machine route parity too
+    e_warm = warmed.get(sorted(warmed.entries)[0])
+    e_cold = cold.get(sorted(cold.entries)[0])
+    a, b = e_warm.scorer.anomaly_arrays(X), e_cold.scorer.anomaly_arrays(X)
+    for key in a:
+        np.testing.assert_array_equal(np.asarray(a[key]), np.asarray(b[key]))
+
+
+# ---------------------------------------------------------------------------
+# warming → ready readiness under concurrent requests
+# ---------------------------------------------------------------------------
+
+def test_healthz_warming_to_ready_under_concurrent_requests(
+    model_dir, monkeypatch
+):
+    """/healthz says ``warming`` while the warmup thread runs, requests
+    issued DURING warming still succeed, and the state flips to ``ready``
+    (with the compile plane's warming flag cleared) when it finishes."""
+    from gordo_tpu.serve import server as server_mod
+
+    release = threading.Event()
+    started = threading.Event()
+
+    def slow_warmup(collection, row_sizes=None):
+        started.set()
+        assert compile_plane.warming()  # the flag is up while we compile
+        release.wait(timeout=30)
+        return {"buckets": 1, "fallbacks": 0, "errors": 0, "programs": []}
+
+    monkeypatch.setattr(server_mod, "warmup_scorers", slow_warmup)
+
+    async def runner():
+        collection = ModelCollection.from_directory(
+            model_dir, project="cpproj"
+        )
+        client = TestClient(TestServer(build_app(collection, warmup=True)))
+        await client.start_server()
+        try:
+            assert started.wait(timeout=10)
+            # concurrent traffic during warming: state reports warming,
+            # scoring requests still serve (they compile lazily)
+            X = np.zeros((300, 3), np.float32).tolist()
+            health, ready, score = await asyncio.gather(
+                client.get("/healthz"),
+                client.get("/gordo/v0/cpproj/ready"),
+                client.post(
+                    "/gordo/v0/cpproj/cp-machine-0/anomaly/prediction",
+                    json={"X": X},
+                ),
+            )
+            assert (await health.json())["state"] == "warming"
+            assert ready.status == 503
+            assert score.status == 200
+            release.set()
+            await _wait(client.app[server_mod.WARMUP_TASK_KEY])
+            health2 = await client.get("/healthz")
+            doc = await health2.json()
+            assert doc["state"] == "ready"
+            assert doc["warmup_errors"] == 0
+            assert (await client.get("/gordo/v0/cpproj/ready")).status == 200
+            assert not compile_plane.warming()
+        finally:
+            release.set()
+            await client.close()
+
+    async def _wait(fut):
+        while not fut.done():
+            await asyncio.sleep(0.01)
+
+    asyncio.run(runner())
+
+
+def test_coalescer_queues_while_warming(monkeypatch):
+    """During warmup the coalescer coalesces unconditionally (queue
+    behind the shared compile) instead of bypass-dispatching a cold
+    compile per executor thread."""
+    from gordo_tpu.serve.coalesce import CoalescingScorer
+
+    co = CoalescingScorer(lambda: None, knee_batch=4)
+    try:
+        co.inflight = 1  # below min_concurrency: would normally bypass
+        compile_plane.set_warming(True)
+        try:
+            assert co.should_coalesce() is True
+        finally:
+            compile_plane.set_warming(False)
+        assert co.should_coalesce() is False  # back to the adaptive bypass
+    finally:
+        co.close()
+
+
+# ---------------------------------------------------------------------------
+# CLI gate
+# ---------------------------------------------------------------------------
+
+def test_gordo_warmup_dir_cli(model_dir):
+    from click.testing import CliRunner
+
+    from gordo_tpu.cli.cli import gordo
+
+    res = CliRunner().invoke(gordo, ["warmup", "--dir", model_dir])
+    assert res.exit_code == 0, res.output
+    assert "serve.fleet/full" in res.output
+    assert "error(s)" in res.output
+
+
+def test_gordo_warmup_dir_cli_fails_on_compile_error(model_dir, monkeypatch):
+    from click.testing import CliRunner
+
+    from gordo_tpu.cli.cli import gordo
+
+    def broken(collection, row_sizes=None, manifest=None):
+        return {"buckets": 0, "fallbacks": 0, "errors": 2, "programs": [],
+                "compile_seconds": 0.0}
+
+    monkeypatch.setattr("gordo_tpu.compile.warmup_collection", broken)
+    res = CliRunner().invoke(gordo, ["warmup", "--dir", model_dir])
+    assert res.exit_code == 1
+
+
+def test_gordo_warmup_requires_exactly_one_target():
+    from click.testing import CliRunner
+
+    from gordo_tpu.cli.cli import gordo
+
+    assert CliRunner().invoke(gordo, ["warmup"]).exit_code != 0
+    assert CliRunner().invoke(
+        gordo, ["warmup", "--dir", "x", "--url", "http://y"]
+    ).exit_code != 0
+
+
+# ---------------------------------------------------------------------------
+# persistent-cache reuse across a forked-process restart (slow lane)
+# ---------------------------------------------------------------------------
+
+_CHILD = r"""
+import json, os, sys, time
+import jax, jax.numpy as jnp
+from gordo_tpu.utils.compile_cache import enable_persistent_compile_cache
+from gordo_tpu import compile as compile_plane, telemetry
+
+assert enable_persistent_compile_cache(), "cache must engage under force"
+
+def f(x):
+    return jnp.tanh(x @ x.T).sum()
+
+prog = compile_plane.Program("test.persist", f)
+t0 = time.perf_counter()
+prog.warm(jax.ShapeDtypeStruct((64, 64), jnp.float32))
+dt = time.perf_counter() - t0
+hits = misses = 0
+for line in telemetry.render().splitlines():
+    if line.startswith('gordo_compile_cache_hits_total{cache="persistent"}'):
+        hits = float(line.rsplit(" ", 1)[1])
+    if line.startswith('gordo_compile_cache_misses_total{cache="persistent"}'):
+        misses = float(line.rsplit(" ", 1)[1])
+print(json.dumps({"compile_s": dt, "hits": hits, "misses": misses}))
+"""
+
+
+@pytest.mark.slow
+def test_persistent_cache_reused_across_forked_restart(tmp_path):
+    """Two fresh processes sharing GORDO_COMPILE_CACHE_DIR: the first
+    populates the on-disk cache (a persistent miss), the restart loads
+    the executable from disk (a persistent hit, attested by the
+    compile-plane counters) — the forked-worker / server-restart reuse
+    path of ISSUE 5, in miniature."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        # force: CPU is excluded by default (AOT feature-mismatch hazard);
+        # back-to-back children on one machine are the trusted case
+        "GORDO_COMPILE_CACHE": "force",
+        "GORDO_COMPILE_CACHE_DIR": str(tmp_path / "xla"),
+        "GORDO_COMPILE_CACHE_MIN_SECONDS": "0",
+    })
+
+    def run():
+        res = subprocess.run(
+            [sys.executable, "-c", _CHILD], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            timeout=180,
+        )
+        assert res.returncode == 0, res.stderr[-2000:]
+        return json.loads(res.stdout.strip().splitlines()[-1])
+
+    first = run()
+    assert first["misses"] >= 1  # populated the disk cache
+    restart = run()
+    assert restart["hits"] >= 1, restart  # the restart loaded from disk
+    assert os.listdir(str(tmp_path / "xla"))  # entries actually on disk

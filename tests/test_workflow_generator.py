@@ -266,3 +266,43 @@ def test_scrape_annotations_opt_out():
         if doc["kind"] == "Deployment":
             meta = doc["spec"]["template"]["metadata"]
             assert "annotations" not in meta
+
+
+def test_compile_cache_volume_on_builder_and_server():
+    """Builder Job and server Deployment share one per-project compile
+    cache: GORDO_COMPILE_CACHE_DIR points both at the same mounted PVC,
+    so a rescheduled server loads executables the builder (or a previous
+    server) already compiled (ISSUE 5 satellite)."""
+    docs = generate_workflow(_config())
+    job = next(d for d in docs if d["kind"] == "Job")
+    dep = next(
+        d for d in docs
+        if d["kind"] == "Deployment"
+        and d["metadata"]["name"].startswith("gordo-server-")
+    )
+    for doc in (job, dep):
+        pod = doc["spec"]["template"]["spec"]
+        container = pod["containers"][0]
+        env = {e["name"]: e["value"] for e in container["env"]}
+        assert env["GORDO_COMPILE_CACHE_DIR"] == "/compile-cache"
+        mounts = {m["name"]: m for m in container["volumeMounts"]}
+        assert mounts["compile-cache"]["mountPath"] == "/compile-cache"
+        assert not mounts["compile-cache"].get("readOnly")
+        volumes = {v["name"]: v for v in pod["volumes"]}
+        assert volumes["compile-cache"]["persistentVolumeClaim"][
+            "claimName"
+        ] == "gordo-compile-cache-genproj"
+
+
+def test_multihost_workers_share_the_compile_cache_path():
+    """Every worker of a --multihost Indexed Job extends the builder
+    template, so all N processes point at the SAME cache path and each
+    fleet program compiles once per fleet, not once per process."""
+    docs = generate_workflow(_config(), multihost=2)
+    job = next(d for d in docs if d["kind"] == "Job")
+    env = {
+        e["name"]: e["value"]
+        for e in job["spec"]["template"]["spec"]["containers"][0]["env"]
+    }
+    assert env["GORDO_COMPILE_CACHE_DIR"] == "/compile-cache"
+    assert env["GORDO_NUM_PROCESSES"] == "2"
