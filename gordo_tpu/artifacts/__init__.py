@@ -1,0 +1,318 @@
+"""Artifact plane: the ONE loading/writing API over both artifact formats.
+
+v1 (compatibility): one directory per machine — ``model.pkl`` +
+``metadata.json`` + ``definition.yaml`` (``gordo_tpu.serializer``).
+v2: memory-mapped bucket packs — one page-aligned tensor pack per
+(signature, bucket) chunk plus a JSON index (``gordo_tpu.artifacts.pack``).
+
+Everything that touches artifacts on disk goes through here: the build
+writer stage (:func:`pack.write_pack` per chunk, or per-machine v1
+dumps), the server's collection load (:func:`discover`), the registry's
+cache lookups (:func:`resolve_cached`), and the conversion tools
+(:func:`repack` / :func:`unpack`).  ``scripts/lint.py`` rejects direct
+per-machine artifact path construction outside this package and the
+serializer/builder write path, so new call sites can't silently grow a
+third layout.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from gordo_tpu import serializer
+from gordo_tpu.artifacts.pack import (  # noqa: F401
+    ENV_FORMAT,
+    FORMATS,
+    PACK_REF_PREFIX,
+    PACKS_DIR,
+    PackCorruptError,
+    PackError,
+    PackStore,
+    delta_write,
+    device_put_count,
+    flatten_model,
+    is_pack_ref,
+    machine_ref,
+    packs_dir,
+    parse_ref,
+    resolve_format,
+    to_device,
+    write_pack,
+)
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "ENV_FORMAT", "FORMATS", "PACKS_DIR", "PACK_REF_PREFIX",
+    "PackError", "PackCorruptError", "PackStore",
+    "ArtifactRef", "discover", "open_store", "is_artifact_dir",
+    "machines_on_disk", "resolve_cached", "resolve_format",
+    "machine_ref", "parse_ref", "is_pack_ref",
+    "write_pack", "delta_write", "flatten_model", "to_device",
+    "device_put_count", "repack", "unpack", "store_info", "packs_dir",
+]
+
+
+def is_artifact_dir(path: str) -> bool:
+    """True when ``path`` is a v1 per-machine artifact directory."""
+    return os.path.exists(os.path.join(path, serializer.MODEL_FILE))
+
+
+class ArtifactRef:
+    """One machine's artifact behind a format-independent handle.
+
+    ``kind`` is ``"pack"`` (a slot of a v2 pack) or ``"dir"`` (a v1
+    per-machine directory); ``ref`` is the addressable location (the
+    pack ref string, or the directory path).  ``stat()`` returns the
+    (mtime, size) reload signal the server's rescan compares.
+    """
+
+    def __init__(self, name: str, kind: str, ref: str,
+                 store: Optional[PackStore] = None, directory: str = ""):
+        self.name = name
+        self.kind = kind
+        self.ref = ref
+        self._store = store
+        self._directory = directory
+
+    def load_model(self) -> Any:
+        if self.kind == "pack":
+            return self._store.load_model(self.name)
+        return serializer.load(self._directory)
+
+    def load_metadata(self) -> Dict[str, Any]:
+        if self.kind == "pack":
+            return self._store.load_metadata(self.name)
+        return serializer.load_metadata(self._directory)
+
+    def stat(self) -> Tuple[float, int]:
+        if self.kind == "pack":
+            return self._store.stat(self.name)
+        try:
+            st = os.stat(
+                os.path.join(self._directory, serializer.MODEL_FILE)
+            )
+            return st.st_mtime, st.st_size
+        except OSError:
+            return 0.0, -1
+
+
+def open_store(path: str) -> Optional[PackStore]:
+    """The :class:`PackStore` for ``path`` (a build output dir, or its
+    ``.gordo-packs/`` directly); None when no v2 index exists.  A present
+    but corrupt index raises :class:`PackCorruptError` — loudly."""
+    candidates = [path, packs_dir(path)]
+    for directory in candidates:
+        if os.path.exists(os.path.join(directory, "index.json")):
+            return PackStore(directory)
+    return None
+
+
+def discover(path: str) -> Tuple[Optional[PackStore], List[ArtifactRef]]:
+    """Every machine artifact under ``path``, both formats unified.
+
+    v2 pack machines come from the index; v1 per-machine dirs fill in
+    anything not packed (a mixed output dir — fleet chunks packed,
+    non-fleetable singles as dirs — is the normal v2 build result).  A
+    machine present in both resolves to its pack entry: the index is
+    authoritative, leftovers are stale.  ``path`` may also be a single
+    machine's artifact dir (the v1 single-machine serve case).
+    """
+    refs: List[ArtifactRef] = []
+    store = open_store(path)
+    packed: Set[str] = set()
+    if store is not None:
+        for name in store.names():
+            refs.append(ArtifactRef(name, "pack", machine_ref(path, name),
+                                    store=store))
+            packed.add(name)
+    if os.path.isdir(path):
+        if is_artifact_dir(path):
+            name = os.path.basename(os.path.normpath(path))
+            refs.append(ArtifactRef(name, "dir", path, directory=path))
+        else:
+            for child in sorted(os.listdir(path)):
+                sub = os.path.join(path, child)
+                if child not in packed and is_artifact_dir(sub):
+                    refs.append(
+                        ArtifactRef(child, "dir", sub, directory=sub)
+                    )
+    return store, refs
+
+
+def machines_on_disk(path: str) -> Set[str]:
+    """Machine names with a live artifact under ``path`` (pack index rows
+    plus v1 dirs) — what the warmup-manifest pruning checks rows
+    against, so stale (signature, bucket) rows drop when a partial
+    rebuild shrinks a bucket."""
+    try:
+        _, refs = discover(path)
+    except PackError:
+        logger.exception("machines_on_disk: unreadable pack index in %s", path)
+        return set()
+    return {r.name for r in refs}
+
+
+#: memoized stores for registry lookups — ``resolve_cached`` runs once per
+#: machine on a cached re-run (10k+ calls), and each open re-validates
+#: every pack.  Keyed by packs dir, invalidated on index (mtime, size).
+_STORE_CACHE: Dict[str, Tuple[Tuple[float, int], PackStore]] = {}
+
+
+def _cached_store(directory: str) -> Optional[PackStore]:
+    index_path = os.path.join(directory, "index.json")
+    try:
+        st = os.stat(index_path)
+        stamp = (st.st_mtime, st.st_size)
+    except OSError:
+        _STORE_CACHE.pop(directory, None)
+        return None
+    hit = _STORE_CACHE.get(directory)
+    if hit is not None and hit[0] == stamp:
+        return hit[1]
+    store = PackStore(directory)
+    _STORE_CACHE[directory] = (stamp, store)
+    return store
+
+
+def resolve_cached(ref: str, cache_key: str) -> Optional[str]:
+    """Registry-lookup verification for a pack ref: the machine must
+    still be in the index, its recorded cache key must match, and its
+    pack must validate.  Returns the ref on a hit, None on any miss —
+    the same contract ``lookup_cached_artifact`` applies to v1 dirs."""
+    try:
+        directory, name = parse_ref(ref)
+        store = _cached_store(directory)
+    except (ValueError, PackError, OSError) as exc:
+        logger.warning("pack ref %s failed to resolve: %s", ref, exc)
+        return None
+    if store is None or name not in store:
+        return None
+    stored = store.cache_key(name)
+    if stored is not None and stored != cache_key:
+        logger.warning(
+            "pack slot for %s was overwritten by a different build "
+            "(stored key %s != %s); treating as cache miss",
+            name, stored, cache_key,
+        )
+        return None
+    return ref
+
+
+# ---------------------------------------------------------------------------
+# conversion (both directions — the parity suite round-trips through these)
+# ---------------------------------------------------------------------------
+
+def repack(
+    output_dir: str,
+    max_bucket_size: int = 512,
+    keep_dirs: bool = False,
+) -> Dict[str, Any]:
+    """Convert a v1 output dir to v2 packs in place.
+
+    Machines whose models share a serving-chain signature group into
+    (signature, bucket) chunks of at most ``max_bucket_size`` and pack
+    together; machines the chain extractor can't fuse stay as v1 dirs
+    (the mixed layout every v2 reader handles).  Converted dirs are
+    removed unless ``keep_dirs`` — the index is authoritative either
+    way.  Returns a summary dict.
+    """
+    # serve.scorer imports artifacts' sibling modules; import lazily to
+    # keep this package import-light
+    import jax
+
+    from gordo_tpu.serve.scorer import _extract_chain
+
+    store, refs = discover(output_dir)
+    groups: Dict[Any, List[Tuple[str, Any, Dict, Optional[str]]]] = {}
+    skipped: List[str] = []
+    for ref in refs:
+        if ref.kind != "dir":
+            continue
+        model = ref.load_model()
+        metadata = ref.load_metadata()
+        chain = _extract_chain(model)
+        if chain is None:
+            skipped.append(ref.name)
+            continue
+        sig = (
+            type(model).__name__,
+            tuple(type(cls).__name__ for cls, _ in chain["scalers"]),
+            chain["mode"], chain["lookback"],
+            tuple(
+                tuple(a.shape) for a in jax.tree.leaves(chain["params"])
+            ),
+        )
+        definition = None
+        def_path = os.path.join(ref.ref, serializer.DEFINITION_FILE)
+        if os.path.exists(def_path):
+            with open(def_path) as fh:
+                definition = fh.read()
+        groups.setdefault(sig, []).append(
+            (ref.name, model, metadata, definition)
+        )
+
+    n_packs, packed = 0, []
+    for members in groups.values():
+        for start in range(0, len(members), max_bucket_size):
+            chunk = members[start: start + max_bucket_size]
+            names = [m[0] for m in chunk]
+            write_pack(
+                output_dir,
+                names,
+                [m[1] for m in chunk],
+                [m[2] for m in chunk],
+                definition=chunk[0][3],
+                cache_keys={
+                    m[0]: m[2].get("cache_key")
+                    for m in chunk if m[2].get("cache_key")
+                },
+            )
+            n_packs += 1
+            packed.extend(names)
+    if not keep_dirs:
+        for name in packed:
+            shutil.rmtree(os.path.join(output_dir, name), ignore_errors=True)
+    return {
+        "packed": sorted(packed), "packs": n_packs,
+        "kept_as_dirs": sorted(skipped),
+    }
+
+
+def unpack(output_dir: str, dest_dir: str) -> List[str]:
+    """Export every packed machine back to v1 per-machine dirs under
+    ``dest_dir`` (the compatibility direction of the parity contract:
+    pack → dirs → load must score bit-identically)."""
+    store = open_store(output_dir)
+    if store is None:
+        raise PackError(f"no pack index under {output_dir}")
+    written = []
+    for name in store.names():
+        serializer.dump(
+            store.load_model(name),
+            os.path.join(dest_dir, name),
+            metadata=store.load_metadata(name) or None,
+            definition=store.definition(name),
+        )
+        written.append(name)
+    return written
+
+
+def store_info(path: str) -> Dict[str, Any]:
+    """Human/CLI summary of the artifacts under ``path``."""
+    store, refs = discover(path)
+    info: Dict[str, Any] = {
+        "format": "v2-packs" if store is not None else "v1-dirs",
+        "machines": len(refs),
+        "dir_machines": sum(1 for r in refs if r.kind == "dir"),
+    }
+    if store is not None:
+        info.update(
+            packs=len(store.packs),
+            packed_machines=len(store.machines),
+            pack_bytes=store.total_bytes(),
+        )
+    return info

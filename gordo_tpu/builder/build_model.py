@@ -24,7 +24,7 @@ from typing import Any, Dict, Optional, Tuple
 import numpy as np
 
 import gordo_tpu
-from gordo_tpu import serializer
+from gordo_tpu import artifacts, serializer
 from gordo_tpu.dataset.base import GordoBaseDataset
 from gordo_tpu.utils import disk_registry, profiling
 
@@ -177,6 +177,20 @@ def lookup_cached_artifact(
     cached = disk_registry.get_value(model_register_dir, cache_key)
     if not cached:
         return None
+    if artifacts.is_pack_ref(cached):
+        # v2: the registry records a pack ref; resolve it through the
+        # pack index (machine present + stamped cache key matches +
+        # pack validates) — the same verify-the-pointer contract as the
+        # v1 dir checks below
+        resolved = artifacts.resolve_cached(cached, cache_key)
+        if resolved is None:
+            logger.warning(
+                "Registry entry for %s points at a stale/invalid pack "
+                "ref %s; rebuilding", name, cached,
+            )
+            return None
+        logger.info("Cache hit for %s (key %s): %s", name, cache_key, cached)
+        return resolved
     if not os.path.exists(os.path.join(cached, serializer.MODEL_FILE)):
         logger.warning(
             "Registry entry for %s points at missing artifact %s; rebuilding",

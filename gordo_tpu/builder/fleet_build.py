@@ -39,7 +39,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 import numpy as np
 from jax.sharding import Mesh
 
-from gordo_tpu import serializer, telemetry
+from gordo_tpu import artifacts, serializer, telemetry
 from gordo_tpu.builder.build_model import (
     assemble_metadata,
     build_model,
@@ -274,6 +274,9 @@ class ProjectBuildResult:
         #: whether the pipelined drive loop ran (False: serial path via
         #: the GORDO_BUILD_PIPELINE=off kill switch or pipeline=False)
         self.pipelined: bool = False
+        #: artifact format this build wrote ("v1" per-machine dirs, "v2"
+        #: memory-mapped bucket packs — see gordo_tpu/artifacts/)
+        self.artifact_format: str = "v1"
 
     def summary(self) -> Dict[str, Any]:
         out = {
@@ -285,6 +288,7 @@ class ProjectBuildResult:
             "build_seconds": self.seconds,
             "peak_loaded_machines": self.peak_loaded,
             "pipelined": self.pipelined,
+            "artifact_format": self.artifact_format,
         }
         if self.auto_pad:
             out["auto_pad_lengths"] = self.auto_pad
@@ -367,8 +371,19 @@ def build_project(
     auto_pad_budget_seconds: Optional[float] = None,
     shard: Optional[Any] = None,
     pipeline: Optional[bool] = None,
+    artifact_format: Optional[str] = None,
 ) -> ProjectBuildResult:
     """Build every machine; fleet-bucket the homogeneous ones.
+
+    ``artifact_format``: ``"v1"`` writes the historical one-directory-
+    per-machine layout; ``"v2"`` writes one memory-mapped parameter pack
+    per fleet chunk (``gordo_tpu/artifacts/``) — the writer stage emits
+    ONE pack + index update per (signature, bucket) chunk instead of
+    per-machine pickles, the registry records pack refs, and the server
+    loads each pack with a single whole-pack device transfer.  Machines
+    on the single-machine fallback path still write v1 dirs (the mixed
+    layout every reader handles).  Default: ``GORDO_ARTIFACT_FORMAT``,
+    else v1.
 
     Streaming and memory-bounded: at most TWO chunks of machines
     (2 x the effective bucket size) have arrays resident — the one
@@ -453,6 +468,8 @@ def build_project(
         )
     machines = [_as_machine(m) for m in machines]
     result = ProjectBuildResult()
+    artifact_fmt = artifacts.resolve_format(artifact_format)
+    result.artifact_format = artifact_fmt
     tracker = _LoadTracker()
     # the auto-pad decision runs over the FULL machine list, before any
     # shard filtering: every process of a multi-host build (and a later
@@ -727,6 +744,11 @@ def build_project(
             ok_chunk, detectors, fleet_seconds = out
             _record_manifest(key, ok_chunk)
             _PIPE_CHUNKS_TOTAL.inc(1.0, "serial")
+            if artifact_fmt == "v2":
+                _write_chunk_pack(
+                    *_chunk_payload(ok_chunk, detectors, fleet_seconds, loaded)
+                )
+                continue
             for m, det in zip(ok_chunk, detectors):
                 _dump_machine(
                     m,
@@ -767,6 +789,13 @@ def build_project(
             ok_chunk, detectors, fleet_seconds = out
             _record_manifest(key, ok_chunk)
             _PIPE_CHUNKS_TOTAL.inc(1.0, "pipelined")
+            if artifact_fmt == "v2":
+                # v2: the chunk IS the write unit — one pack per chunk
+                # rides the writer queue as a single item
+                writer.submit([
+                    _chunk_payload(ok_chunk, detectors, fleet_seconds, loaded)
+                ])
+                continue
             per_machine = fleet_seconds / len(ok_chunk)
             # machines in a chunk share ONE model config, so their
             # definition.yaml bytes are identical by construction —
@@ -820,9 +849,60 @@ def build_project(
         _BUILD_MACHINE_SECONDS.observe(per_machine, "fleet")
         _done(name)
 
+    def _chunk_payload(ok_chunk, detectors, fleet_seconds, loaded) -> Tuple:
+        """Assemble a v2 chunk's write payload (metadata closes over the
+        training arrays, so they free HERE — at enqueue — keeping the
+        2-chunk peak_loaded bound independent of writer backlog)."""
+        per_machine = fleet_seconds / len(ok_chunk)
+        chunk_definition = serializer.render_definition(detectors[0])
+        metadatas = []
+        for m, det in zip(ok_chunk, detectors):
+            metadatas.append(_machine_metadata(
+                m, det, loaded[m.name], per_machine, fleet=True,
+                align_lengths=align_lengths, pad_lengths=pad_lengths,
+                cache_key=machine_keys[m.name],
+            ))
+            _free(loaded, [m.name])
+        names = [m.name for m in ok_chunk]
+        return names, list(detectors), metadatas, per_machine, chunk_definition
+
+    def _write_chunk_pack(names, detectors, metadatas, per_machine,
+                          definition: Optional[str] = None) -> None:
+        """v2 writer task: ONE pack + index update per fleet chunk.  A
+        pack-level failure falls back to per-machine v1 artifacts — the
+        chunk must not lose machines to a packing edge case."""
+        try:
+            artifacts.write_pack(
+                output_dir, names, detectors, metadatas,
+                definition=definition,
+                cache_keys={
+                    n: machine_keys[n] for n in names if n in machine_keys
+                },
+            )
+        except Exception:
+            logger.exception(
+                "Pack write failed for chunk %s...; falling back to "
+                "per-machine artifacts", names[:3],
+            )
+            for name, det, metadata in zip(names, detectors, metadatas):
+                _write_one(name, det, metadata, per_machine, definition)
+            return
+        for name in names:
+            result.artifacts[name] = artifacts.machine_ref(output_dir, name)
+            result.fleet_built.append(name)
+            _BUILD_MACHINES_TOTAL.inc(1.0, "fleet")
+            _BUILD_MACHINE_SECONDS.observe(per_machine, "fleet")
+            _register(
+                artifacts.machine_ref(output_dir, name),
+                model_register_dir, machine_keys.get(name),
+            )
+            _done(name)
+
     with ThreadPoolExecutor(max_workers=data_workers) as pool:
         if use_pipeline:
-            writer = _ArtifactWriter(_write_one)
+            writer = _ArtifactWriter(
+                _write_chunk_pack if artifact_fmt == "v2" else _write_one
+            )
             try:
                 _drive_pipeline(pool, writer)
             except BaseException:
@@ -893,10 +973,18 @@ def build_project(
         # the (signature, bucket) set this build materialized — what the
         # server (or `gordo warmup`) pre-compiles before going ready.  A
         # fully-cached re-run records nothing and keeps the existing
-        # manifest; a partial rebuild merges into it.
+        # manifest; a partial rebuild merges into it, pruned against the
+        # machines that actually exist on disk so a shrunk bucket can't
+        # leave stale (signature, bucket) rows behind.
         from gordo_tpu.compile import write_warmup_manifest
 
-        write_warmup_manifest(output_dir, manifest_entries, shard=result.shard)
+        write_warmup_manifest(
+            output_dir, manifest_entries, shard=result.shard,
+            live_machines=(
+                artifacts.machines_on_disk(output_dir)
+                | set(result.artifacts)
+            ),
+        )
     except Exception:  # the manifest is a hint, never a build failure
         logger.exception("warmup manifest write failed")
     return result
@@ -1035,6 +1123,9 @@ def _register(
 ) -> None:
     """Registry write under the key computed ONCE in step 1 — the stamp in
     metadata, the registry entry, and the next run's lookup must all agree
-    or the overwrite-detection breaks."""
+    or the overwrite-detection breaks.  v2 pack refs record verbatim (the
+    pack index, not a per-machine path, is the unit the registry points
+    at); v1 artifact dirs record as absolute paths, as always."""
     if model_register_dir and key:
-        disk_registry.write_key(model_register_dir, key, os.path.abspath(dest))
+        value = dest if artifacts.is_pack_ref(dest) else os.path.abspath(dest)
+        disk_registry.write_key(model_register_dir, key, value)

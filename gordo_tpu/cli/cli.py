@@ -177,12 +177,20 @@ def build(output_dir, name, model_config, data_config, metadata,
                    "compile bill, auto-enable --pad-lengths at a computed "
                    "alignment (loudly logged) instead of paying one XLA "
                    "compile per distinct row count.")
+@click.option("--artifact-format", default=None,
+              type=click.Choice(["v1", "v2"]),
+              help="v1: one directory per machine (compatibility default). "
+                   "v2: one memory-mapped parameter pack per fleet chunk + "
+                   "index (gordo_tpu/artifacts/) — O(chunks) files instead "
+                   "of O(machines), zero-copy server loads. Default: "
+                   "GORDO_ARTIFACT_FORMAT, else v1. The generated k8s "
+                   "builder runs v2.")
 @click.option("--replace-cache", is_flag=True)
 def build_project_cmd(machine_config, project_name, output_dir,
                       model_register_dir, max_bucket_size, data_parallel,
                       data_workers, align_lengths, pad_lengths,
                       machines_filter, multihost, barrier_timeout, auto_pad,
-                      replace_cache):
+                      artifact_format, replace_cache):
     """Build EVERY machine in the project config — homogeneous machines
     train as single mesh-sharded fleet programs (the TPU-native
     replacement for the reference's one-pod-per-machine Argo DAG)."""
@@ -216,7 +224,7 @@ def build_project_cmd(machine_config, project_name, output_dir,
         _run_multihost_build(
             dist_cfg, machines, output_dir, model_register_dir,
             replace_cache, max_bucket_size, data_parallel, data_workers,
-            align_lengths, pad_lengths, auto_pad,
+            align_lengths, pad_lengths, auto_pad, artifact_format,
         )
         return
 
@@ -242,6 +250,7 @@ def build_project_cmd(machine_config, project_name, output_dir,
         align_lengths=align_lengths,
         pad_lengths=pad_lengths,
         auto_pad=auto_pad,
+        artifact_format=artifact_format,
     )
     click.echo(json.dumps(result.summary()))
     if result.failed:
@@ -250,7 +259,8 @@ def build_project_cmd(machine_config, project_name, output_dir,
 
 def _run_multihost_build(dist_cfg, machines, output_dir, model_register_dir,
                          replace_cache, max_bucket_size, data_parallel,
-                         data_workers, align_lengths, pad_lengths, auto_pad):
+                         data_workers, align_lengths, pad_lengths, auto_pad,
+                         artifact_format=None):
     """One worker of an N-process build: init jax.distributed, build this
     process's shard, barrier at the edges.  A barrier timeout (dead peer)
     exits EXIT_SHARD_RESUMABLE with this shard's state file resumable —
@@ -327,6 +337,7 @@ def _run_multihost_build(dist_cfg, machines, output_dir, model_register_dir,
         align_lengths=align_lengths,
         pad_lengths=pad_lengths,
         auto_pad=auto_pad,
+        artifact_format=artifact_format,
         shard=shard,
     )
     try:
@@ -640,6 +651,75 @@ def warmup_cmd(model_dir, server_url, row_sizes, timeout):
         f"server at {url} did not report ready within {timeout:.0f}s "
         f"(last state: {last_state})"
     )
+
+
+# ---------------------------------------------------------------------------
+# artifacts (format v2 pack tooling)
+# ---------------------------------------------------------------------------
+
+@gordo.group("artifacts")
+def artifacts_group():
+    """Artifact-plane tooling: inspect, repack (v1 → v2), unpack (v2 → v1)."""
+
+
+@artifacts_group.command("info")
+@click.option("--dir", "output_dir", required=True,
+              help="A build output dir (either format, or mixed).")
+def artifacts_info(output_dir):
+    """Print what backs the artifacts under --dir (format, machine and
+    pack counts, pack bytes) as JSON."""
+    from gordo_tpu import artifacts
+
+    try:
+        click.echo(json.dumps(artifacts.store_info(output_dir), indent=1))
+    except artifacts.PackError as exc:
+        raise click.ClickException(str(exc))
+
+
+@artifacts_group.command("repack")
+@click.option("--dir", "output_dir", required=True,
+              help="A v1 (or mixed) build output dir to convert in place.")
+@click.option("--max-bucket-size", default=512, show_default=True,
+              help="Max machines per pack (the (signature, bucket) chunk "
+                   "size).")
+@click.option("--keep-dirs", is_flag=True,
+              help="Leave the converted per-machine dirs on disk (the pack "
+                   "index is authoritative either way).")
+def artifacts_repack(output_dir, max_bucket_size, keep_dirs):
+    """Convert v1 per-machine dirs to v2 memory-mapped packs in place.
+    Machines whose models can't fuse into a stacked serving chain stay
+    as v1 dirs — every reader handles the mixed layout."""
+    from gordo_tpu import artifacts
+
+    try:
+        summary = artifacts.repack(
+            output_dir, max_bucket_size=max_bucket_size, keep_dirs=keep_dirs
+        )
+    except artifacts.PackError as exc:
+        raise click.ClickException(str(exc))
+    click.echo(json.dumps(
+        {"packs": summary["packs"],
+         "packed": len(summary["packed"]),
+         "kept_as_dirs": summary["kept_as_dirs"]}
+    ))
+
+
+@artifacts_group.command("unpack")
+@click.option("--dir", "output_dir", required=True,
+              help="A v2 build output dir (its pack index is read).")
+@click.option("--dest", required=True,
+              help="Directory to write v1 per-machine artifact dirs into.")
+def artifacts_unpack(output_dir, dest):
+    """Export every packed machine back to v1 per-machine dirs (the
+    compatibility direction: external tooling that walks artifact dirs
+    keeps working against an export)."""
+    from gordo_tpu import artifacts
+
+    try:
+        written = artifacts.unpack(output_dir, dest)
+    except artifacts.PackError as exc:
+        raise click.ClickException(str(exc))
+    click.echo(json.dumps({"unpacked": len(written), "dest": dest}))
 
 
 # ---------------------------------------------------------------------------

@@ -205,6 +205,25 @@ class Client:
         )
         return list(body.get("machines", []))
 
+    async def artifact_info_async(
+        self, session: aiohttp.ClientSession
+    ) -> Dict[str, Any]:
+        """What backs the server's collection — ``artifact-format``
+        (``v2-packs`` | ``v1-dirs``) plus pack count/bytes when packed.
+        Lets operators confirm a rollout actually serves from the new
+        pack format without shelling into the pod."""
+        body = await get_json(
+            session, self._project_url(), retries=self.n_retries,
+            timeout=self.timeout,
+        )
+        return {
+            k: v for k, v in body.items()
+            if k.startswith("artifact-")
+        }
+
+    def artifact_info(self) -> Dict[str, Any]:
+        return _run(self._with_session(self.artifact_info_async))
+
     async def machine_metadata_async(
         self, session: aiohttp.ClientSession, machine: str
     ) -> Dict[str, Any]:

@@ -51,6 +51,7 @@ def write_warmup_manifest(
     entries: List[Dict[str, Any]],
     shard=None,
     row_buckets: Optional[Sequence[int]] = None,
+    live_machines: Optional[set] = None,
 ) -> Optional[str]:
     """Write (merge) this build's warmup manifest shard file.
 
@@ -59,6 +60,13 @@ def write_warmup_manifest(
     "lookback"}``.  Entries already on disk for machines NOT rebuilt this
     run are kept (a partial rebuild must not unlearn the rest of the
     project); entries overlapping the new machine set are replaced.
+
+    ``live_machines``: when given, kept rows PRUNE to it — machines no
+    longer present in the build output drop out of their rows, and rows
+    left empty drop entirely.  Without pruning, a partial rebuild that
+    shrinks a bucket union-merges stale (signature, bucket) rows forever
+    and warmup keeps compiling for machines that no longer exist.
+
     Returns the path written, or None when there was nothing to record
     (a fully-cached re-run keeps the existing manifest untouched).
     """
@@ -71,8 +79,19 @@ def write_warmup_manifest(
         with open(path) as fh:
             doc = json.load(fh)
         for e in doc.get("programs", ()):
-            if not rebuilt.intersection(e.get("machines", ())):
-                kept.append(e)
+            if rebuilt.intersection(e.get("machines", ())):
+                continue
+            if live_machines is not None:
+                machines = [
+                    m for m in e.get("machines", ()) if m in live_machines
+                ]
+                if not machines:
+                    continue  # the whole row went stale — drop it
+                if len(machines) != len(e.get("machines", ())):
+                    e = dict(e)
+                    e["machines"] = machines
+                    e["n_machines"] = len(machines)
+            kept.append(e)
     except (OSError, ValueError):
         pass
     doc = {

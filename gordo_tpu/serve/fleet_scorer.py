@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from gordo_tpu import artifacts
 from gordo_tpu import compile as compile_plane
 from gordo_tpu.anomaly.diff import scores_fn
 from gordo_tpu.ops.windows import make_windows
@@ -162,6 +163,7 @@ class _Bucket:
         names: List[str],
         chains: List[Dict[str, Any]],
         mesh: Optional[Any] = None,
+        prestacked: Optional[Dict[str, Any]] = None,
     ):
         self.names = names
         c0 = chains[0]
@@ -176,6 +178,41 @@ class _Bucket:
             c["detector"]["feature_thresholds"] is not None for c in chains
         )
 
+        from gordo_tpu.parallel.mesh import MODEL_AXIS
+
+        self.mesh = (
+            mesh
+            if mesh is not None and mesh.shape.get(MODEL_AXIS, 1) > 1
+            else None
+        )
+        #: stacked machine-axis length on device (== len(names) without a
+        #: mesh; padded to a shard multiple with one)
+        self.m_pad = len(names)
+
+        if prestacked is not None:
+            self._init_prestacked(prestacked)
+        else:
+            self._init_stacking(chains)
+        #: authoritative input width (detector scaler stats are per-feature
+        #: arrays), used to reject malformed requests per machine instead
+        #: of letting one bad array sink a whole stacked dispatch
+        det_leaves = jax.tree.leaves(self.det_stats)
+        self.n_features = (
+            int(det_leaves[0].shape[-1]) if det_leaves else None
+        )
+        #: pinned host stacking buffers keyed by (machines, rows, features),
+        #: reused across score_all calls while request shapes repeat;
+        #: LRU-bounded so a long-lived server with varied request shapes
+        #: can't accumulate unbounded host memory; guarded by _lock —
+        #: concurrent bulk requests run score_all from executor threads
+        self._stack_bufs: "OrderedDict[Tuple[int, int, int], np.ndarray]" = (
+            OrderedDict()
+        )
+        self._lock = threading.Lock()
+
+    def _init_stacking(self, chains: List[Dict[str, Any]]) -> None:
+        """The v1 path: per-machine chain arrays stack leaf by leaf (one
+        host gather + implicit transfer per leaf)."""
         stack = lambda trees: jax.tree.map(  # noqa: E731
             lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *trees
         )
@@ -212,33 +249,16 @@ class _Bucket:
             self.thresholds_np = None
             self.agg_thresholds_np = None
             self.agg_thresholds = None
-        #: authoritative input width (detector scaler stats are per-feature
-        #: arrays), used to reject malformed requests per machine instead
-        #: of letting one bad array sink a whole stacked dispatch
-        det_leaves = jax.tree.leaves(self.det_stats)
-        self.n_features = (
-            int(det_leaves[0].shape[-1]) if det_leaves else None
-        )
-
-        from gordo_tpu.parallel.mesh import MODEL_AXIS
-
-        self.mesh = (
-            mesh
-            if mesh is not None and mesh.shape.get(MODEL_AXIS, 1) > 1
-            else None
-        )
-        #: stacked machine-axis length on device (== len(names) without a
-        #: mesh; padded to a shard multiple with one)
-        self.m_pad = len(names)
         if self.mesh is not None:
             from gordo_tpu.parallel.mesh import (
+                MODEL_AXIS,
                 model_sharding,
                 pad_to_multiple,
             )
 
             shards = self.mesh.shape[MODEL_AXIS]
-            self.m_pad = pad_to_multiple(len(names), shards)
-            pad = self.m_pad - len(names)
+            self.m_pad = pad_to_multiple(len(self.names), shards)
+            pad = self.m_pad - len(self.names)
 
             def shard(tree):
                 def one(a):
@@ -258,15 +278,71 @@ class _Bucket:
             if self.agg_thresholds is not None:
                 self.agg_thresholds = shard(self.agg_thresholds)
             self._x_sharding = model_sharding(self.mesh, 2)
-        #: pinned host stacking buffers keyed by (machines, rows, features),
-        #: reused across score_all calls while request shapes repeat;
-        #: LRU-bounded so a long-lived server with varied request shapes
-        #: can't accumulate unbounded host memory; guarded by _lock —
-        #: concurrent bulk requests run score_all from executor threads
-        self._stack_bufs: "OrderedDict[Tuple[int, int, int], np.ndarray]" = (
-            OrderedDict()
+
+    def _init_prestacked(self, prestacked: Dict[str, Any]) -> None:
+        """The v2 pack path: the artifact store already holds this
+        bucket's arrays stacked (M_pack, ...) and memory-mapped per
+        (signature, bucket) pack, so each pack ships to the device as
+        ONE ``artifacts.to_device`` call — zero host copies — and a
+        multi-pack bucket concatenates the transferred trees on device.
+        Dispatch geometry (the stacked machine-axis length) is identical
+        to the v1 stacking path's, so scoring stays bitwise-equal to a
+        v1 load of the same models.
+        """
+        self.thresholds_np = (
+            prestacked["feature_thresholds"] if self.with_thresholds else None
         )
-        self._lock = threading.Lock()
+        self.agg_thresholds_np = (
+            prestacked["agg"] if self.with_thresholds else None
+        )
+        pack_hosts = prestacked["packs"]
+        if self.mesh is not None:
+            from gordo_tpu.parallel.mesh import (
+                MODEL_AXIS,
+                model_sharding,
+                pad_to_multiple,
+            )
+
+            shards = self.mesh.shape[MODEL_AXIS]
+            self.m_pad = pad_to_multiple(len(self.names), shards)
+            pad = self.m_pad - len(self.names)
+
+            def assemble(*parts):
+                a = (
+                    parts[0] if len(parts) == 1
+                    else np.concatenate(parts, axis=0)
+                )
+                if pad:
+                    a = np.concatenate([a, np.repeat(a[:1], pad, axis=0)])
+                return a
+
+            # sharded placement needs host-side pad/concat copies anyway;
+            # still ONE counted transfer for the whole bucket
+            host = jax.tree.map(assemble, *pack_hosts)
+            shardings = jax.tree.map(
+                lambda a: model_sharding(self.mesh, a.ndim - 1), host
+            )
+            dev = artifacts.to_device(host, shardings)
+            self._x_sharding = model_sharding(self.mesh, 2)
+            self.params, self.scaler_stats, self.det_stats = dev
+            self.agg_thresholds = None
+            if self.with_thresholds:
+                agg = self.agg_thresholds_np
+                if pad:
+                    agg = np.concatenate([agg, np.repeat(agg[:1], pad)])
+                self.agg_thresholds = jax.device_put(
+                    jnp.asarray(agg), model_sharding(self.mesh, 0)
+                )
+            return
+        devs = [artifacts.to_device(h) for h in pack_hosts]
+        dev = devs[0] if len(devs) == 1 else jax.tree.map(
+            lambda *xs: jnp.concatenate(xs, axis=0), *devs
+        )
+        self.params, self.scaler_stats, self.det_stats = dev
+        self.agg_thresholds = (
+            jnp.asarray(self.agg_thresholds_np)
+            if self.with_thresholds else None
+        )
 
     #: max retained stacking buffers per bucket (power-of-two shape
     #: bucketing keeps distinct shapes few; 4 covers a steady mix of bulk +
@@ -369,6 +445,104 @@ class _Bucket:
         return out
 
 
+class _PrestackMiss(Exception):
+    """A chain leaf did not map back to its pack's stacked tensors —
+    fall back to the generic per-leaf stacking path."""
+
+
+def _prestack_group(
+    store, names: List[str], chains: List[Dict[str, Any]]
+):
+    """Zero-copy stacked arrays for a pack-backed signature group.
+
+    Bucketing stays at the v1 granularity (one bucket per structural
+    signature — dispatch geometry, and therefore XLA codegen and bitwise
+    outputs, must not depend on how the build chunked its packs).  Each
+    pack contributes its stacked ``(M_pack, ...)`` memmap tensors as ONE
+    whole-pack device transfer; a multi-pack bucket concatenates the
+    transferred trees on device.
+
+    Succeeds only when every machine of the group is pack-backed, every
+    contributing pack's live machines all fall in this group, and every
+    chain array of each pack's first machine maps back to a stacked
+    tensor.  Returns ``(prestacked, names, chains)`` reordered to
+    pack-slot order, or ``(None, names, chains)`` unchanged.
+    """
+    by_name = dict(zip(names, chains))
+    group = set(names)
+    pack_ids: List[str] = []
+    for n in names:
+        if n not in store:
+            return None, names, chains
+        pid = store.location(n)[0]
+        if pid not in pack_ids:
+            pack_ids.append(pid)
+    slot_orders: Dict[str, List[str]] = {}
+    for pid in pack_ids:
+        live = store.machines_of(pid)
+        if not set(live).issubset(group):
+            # the pack's other machines bucketed elsewhere — stacked rows
+            # would not align with this bucket
+            return None, names, chains
+        slot_orders[pid] = live
+    pack_ids.sort(key=lambda p: slot_orders[p][0])
+
+    def lift(pid, live_count, a):
+        loc = store.leaf_of(a)
+        if loc is None or loc[0] != pid:
+            raise _PrestackMiss()
+        stacked = store.stacked(pid)[loc[1]]
+        if stacked.shape[0] != live_count:
+            # superseded slots still occupy stacked rows — row i would
+            # no longer be machine i of this bucket
+            raise _PrestackMiss()
+        return stacked
+
+    pack_hosts = []
+    thr_parts: List[Any] = []
+    want_thr = all(
+        c["detector"]["feature_thresholds"] is not None for c in chains
+    )
+    try:
+        for pid in pack_ids:
+            live = slot_orders[pid]
+            c0 = by_name[live[0]]
+            take = lambda a, p=pid, m=len(live): lift(p, m, a)  # noqa: E731
+            pack_hosts.append((
+                jax.tree.map(take, c0["params"]),
+                tuple(
+                    jax.tree.map(take, stats) for _, stats in c0["scalers"]
+                ),
+                jax.tree.map(take, c0["detector"]["scaler_stats"]),
+            ))
+            if want_thr:
+                thr_parts.append(take(c0["detector"]["feature_thresholds"]))
+    except _PrestackMiss:
+        return None, names, chains
+
+    names = [n for pid in pack_ids for n in slot_orders[pid]]
+    chains = [by_name[n] for n in names]
+    thr = None
+    if want_thr:
+        # single pack: the memmap view itself (zero copy); multi-pack:
+        # one bounded host concat of the (M, n_tags) threshold rows
+        thr = thr_parts[0] if len(thr_parts) == 1 else np.concatenate(
+            thr_parts
+        )
+    prestacked = {
+        "packs": pack_hosts,
+        "feature_thresholds": thr,
+        "agg": np.asarray(
+            [
+                float(c["detector"]["aggregate_threshold"] or 0.0)
+                for c in chains
+            ],
+            np.float32,
+        ),
+    }
+    return prestacked, names, chains
+
+
 def _signature(chain: Dict[str, Any]) -> Optional[Tuple]:
     det = chain["detector"]
     if det is None:
@@ -454,11 +628,20 @@ class FleetScorer:
 
     @classmethod
     def from_models(
-        cls, models: Dict[str, Any], mesh: Optional[Any] = None
+        cls,
+        models: Dict[str, Any],
+        mesh: Optional[Any] = None,
+        pack_store: Optional[Any] = None,
     ) -> "FleetScorer":
         """``mesh``: optional ``("models", "data")`` fleet mesh; buckets
         shard their stacked machine axis over it so one serving dispatch
         spans every chip (single-device behavior is unchanged without it).
+
+        ``pack_store``: the v2 :class:`gordo_tpu.artifacts.PackStore`
+        the models came from, when they did.  Pack-backed machines group
+        one bucket per pack and the bucket's stacked arrays ship as ONE
+        whole-pack device transfer instead of a per-leaf ``jnp.stack``
+        over per-machine copies — the v2 load contract.
         """
         self = cls()
         self.models = dict(models)
@@ -473,7 +656,14 @@ class FleetScorer:
             names.append(name)
             chains.append(chain)
         for names, chains in groups.values():
-            bucket = _Bucket(names, chains, mesh=mesh)
+            prestacked = None
+            if pack_store is not None:
+                prestacked, names, chains = _prestack_group(
+                    pack_store, names, chains
+                )
+            bucket = _Bucket(
+                names, chains, mesh=mesh, prestacked=prestacked
+            )
             idx = len(self.buckets)
             self.buckets.append(bucket)
             for pos, name in enumerate(names):

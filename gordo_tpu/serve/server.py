@@ -31,7 +31,7 @@ import pandas as pd
 from aiohttp import web
 
 import gordo_tpu
-from gordo_tpu import serializer, telemetry
+from gordo_tpu import artifacts, serializer, telemetry
 from gordo_tpu.serve import codec
 from gordo_tpu.serve import coalesce as coalesce_mod
 from gordo_tpu.serve.scorer import CompiledScorer
@@ -109,19 +109,29 @@ WARMUP_TASK_KEY: "web.AppKey[object]" = web.AppKey("warmup_task", object)
 
 
 class ModelEntry:
+    """One served machine, loaded through the artifact plane — a v1
+    per-machine directory or a slot of a v2 pack, behind one surface."""
+
     def __init__(self, name: str, directory: str):
-        self.name = name
-        self.directory = directory
-        self.model = serializer.load(directory)
-        self.metadata = serializer.load_metadata(directory)
+        # v1-dir compatibility constructor (tests/bench build entries
+        # straight from a dumped artifact dir)
+        self._init_from(
+            artifacts.ArtifactRef(name, "dir", directory, directory=directory)
+        )
+
+    @classmethod
+    def from_artifact(cls, ref: "artifacts.ArtifactRef") -> "ModelEntry":
+        entry = cls.__new__(cls)
+        entry._init_from(ref)
+        return entry
+
+    def _init_from(self, ref: "artifacts.ArtifactRef") -> None:
+        self.name = ref.name
+        self.directory = ref.ref
+        self.model = ref.load_model()
+        self.metadata = ref.load_metadata()
         self.scorer = CompiledScorer(self.model)
-        try:
-            st = os.stat(os.path.join(directory, serializer.MODEL_FILE))
-            self.mtime = st.st_mtime
-            self.size = st.st_size
-        except OSError:
-            self.mtime = 0.0
-            self.size = -1
+        self.mtime, self.size = ref.stat()
 
     @property
     def tags(self) -> List[str]:
@@ -150,6 +160,7 @@ class ModelCollection:
         project: str = "project",
         source_dir: Optional[str] = None,
         serve_mesh=None,
+        pack_store=None,
     ):
         self.entries = entries
         self.project = project
@@ -157,6 +168,10 @@ class ModelCollection:
         #: optional ("models","data") fleet mesh: stacked serving dispatches
         #: shard their machine axis over it (multi-chip serving)
         self.serve_mesh = serve_mesh
+        #: the v2 artifacts.PackStore these entries came from (None for a
+        #: v1 directory layout): lets the fleet scorer ship each pack's
+        #: stacked tensors to the device as ONE transfer
+        self.pack_store = pack_store
         self._fleet_scorer = None
         # guards the (entries, _fleet_scorer) pair: the background rescan
         # swaps both from an executor thread while bulk requests lazily
@@ -173,6 +188,7 @@ class ModelCollection:
                 self._fleet_scorer = FleetScorer.from_models(
                     {name: e.model for name, e in self.entries.items()},
                     mesh=self.serve_mesh,
+                    pack_store=self.pack_store,
                 )
             return self._fleet_scorer
 
@@ -180,20 +196,26 @@ class ModelCollection:
     def from_directory(
         cls, path: str, project: str = "project", serve_mesh=None
     ) -> "ModelCollection":
+        """Load every artifact under ``path`` — a v2 pack index, v1
+        per-machine dirs, a mixed output, or one machine's artifact dir.
+
+        Pack failures raise (:class:`gordo_tpu.artifacts.PackCorruptError`
+        — a truncated pack must kill startup loudly, not silently shrink
+        the fleet); a single broken v1 dir only loses that machine, as
+        before."""
+        store, refs = artifacts.discover(path)
         entries: Dict[str, ModelEntry] = {}
-        source_dir: Optional[str] = None
-        if os.path.exists(os.path.join(path, serializer.MODEL_FILE)):
-            name = os.path.basename(os.path.normpath(path))
-            entries[name] = ModelEntry(name, path)
-        else:
-            source_dir = path
-            for child in sorted(os.listdir(path)):
-                sub = os.path.join(path, child)
-                if os.path.exists(os.path.join(sub, serializer.MODEL_FILE)):
-                    try:
-                        entries[child] = ModelEntry(child, sub)
-                    except Exception:
-                        logger.exception("Failed to load artifact %s", sub)
+        source_dir: Optional[str] = (
+            None if artifacts.is_artifact_dir(path) else path
+        )
+        for ref in refs:
+            if ref.kind == "pack":
+                entries[ref.name] = ModelEntry.from_artifact(ref)
+                continue
+            try:
+                entries[ref.name] = ModelEntry.from_artifact(ref)
+            except Exception:
+                logger.exception("Failed to load artifact %s", ref.ref)
         if not entries:
             raise FileNotFoundError(f"No model artifacts under {path!r}")
         return cls(
@@ -201,6 +223,7 @@ class ModelCollection:
             project=project,
             source_dir=source_dir,
             serve_mesh=serve_mesh,
+            pack_store=store,
         )
 
     def get(self, name: str) -> Optional[ModelEntry]:
@@ -211,41 +234,58 @@ class ModelCollection:
 
         The reference got this "for free" from its pod-per-model design (a
         new machine = a new pod); one process serving a whole project must
-        instead watch its artifact dir.  New dirs load, changed model files
-        (mtime) reload, vanished dirs drop.  The entries dict is replaced
-        atomically so in-flight requests keep a consistent view.
+        instead watch its artifact dir.  New artifacts load, changed ones
+        ((mtime, size) of model.pkl for v1 dirs, of the pack file for v2
+        slots — a delta-rewritten pack reloads all its machines) reload,
+        vanished ones drop.  The entries dict is replaced atomically so
+        in-flight requests keep a consistent view.
         """
         if self.source_dir is None or not os.path.isdir(self.source_dir):
             return {"added": [], "reloaded": [], "removed": []}
-        added, reloaded, removed = [], [], []
+        try:
+            store, refs = artifacts.discover(self.source_dir)
+        except Exception:
+            # a mid-write index (builder racing the rescan) must not take
+            # down the serving loop — keep the current view, retry later
+            logger.exception("Artifact discovery failed during rescan")
+            return {"added": [], "reloaded": [], "removed": []}
+        if (
+            store is not None
+            and self.pack_store is not None
+            and store.index_stat == self.pack_store.index_stat
+        ):
+            # unchanged index: keep the already-mapped store so entry
+            # views and the fleet scorer's prestacking stay one object
+            store = self.pack_store
+            for ref in refs:
+                if ref.kind == "pack":
+                    ref._store = store
+        added, reloaded = [], []
         new_entries: Dict[str, ModelEntry] = {}
-        for child in sorted(os.listdir(self.source_dir)):
-            sub = os.path.join(self.source_dir, child)
-            model_file = os.path.join(sub, serializer.MODEL_FILE)
-            if not os.path.exists(model_file):
-                continue
-            current = self.entries.get(child)
+        for ref in refs:
+            current = self.entries.get(ref.name)
+            # an index swap remaps every pack: reload its machines (cheap
+            # skeleton unpickles) so their views — and the fleet scorer's
+            # one-transfer prestacking — bind to the new store
+            force = ref.kind == "pack" and store is not self.pack_store
             try:
-                st = os.stat(model_file)
                 if current is None:
-                    new_entries[child] = ModelEntry(child, sub)
-                    added.append(child)
-                elif (st.st_mtime, st.st_size) != (
-                    current.mtime, current.size,
-                ):
+                    new_entries[ref.name] = ModelEntry.from_artifact(ref)
+                    added.append(ref.name)
+                elif force or ref.stat() != (current.mtime, current.size):
                     # (mtime, size) inequality, not mtime>: a rebuild can
                     # land with an equal-or-older mtime (cache copies, clock
                     # skew) and must still reload.  Known blind spot: an
                     # mtime-preserving copy (cp -p) of a same-size artifact
                     # is indistinguishable without hashing content.
-                    new_entries[child] = ModelEntry(child, sub)
-                    reloaded.append(child)
+                    new_entries[ref.name] = ModelEntry.from_artifact(ref)
+                    reloaded.append(ref.name)
                 else:
-                    new_entries[child] = current
+                    new_entries[ref.name] = current
             except Exception:
-                logger.exception("Failed to (re)load artifact %s", sub)
+                logger.exception("Failed to (re)load artifact %s", ref.ref)
                 if current is not None:  # keep serving the old model
-                    new_entries[child] = current
+                    new_entries[ref.name] = current
         removed = sorted(set(self.entries) - set(new_entries))
         if added or reloaded or removed:
             logger.info(
@@ -253,6 +293,7 @@ class ModelCollection:
             )
             with self._lock:  # swap entries + scorer reset atomically
                 self.entries = new_entries
+                self.pack_store = store
                 self._fleet_scorer = None  # stacked params must restack
         return {"added": added, "reloaded": reloaded, "removed": removed}
 
@@ -694,14 +735,20 @@ async def metrics_endpoint(request: web.Request) -> web.Response:
 
 async def project_index(request: web.Request) -> web.Response:
     collection: ModelCollection = request.app[COLLECTION_KEY]
-    return web.json_response(
-        {
-            "project-name": collection.project,
-            "machines": sorted(collection.entries),
-            "gordo-server-version": gordo_tpu.__version__,
-            "coalescer": coalesce_mod.stats(request.app.get(COALESCER_KEY)),
-        }
-    )
+    store = collection.pack_store
+    doc = {
+        "project-name": collection.project,
+        "machines": sorted(collection.entries),
+        "gordo-server-version": gordo_tpu.__version__,
+        "coalescer": coalesce_mod.stats(request.app.get(COALESCER_KEY)),
+        # client/watchman artifact discovery: which format backs this
+        # collection, and how many packs when v2
+        "artifact-format": "v2-packs" if store is not None else "v1-dirs",
+    }
+    if store is not None:
+        doc["artifact-packs"] = len(store.packs)
+        doc["artifact-pack-bytes"] = store.total_bytes()
+    return web.json_response(doc)
 
 
 def _validate_width(X: np.ndarray, entry: ModelEntry) -> None:

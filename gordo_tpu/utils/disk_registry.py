@@ -25,13 +25,36 @@ def _key_path(registry_dir: str, key: str) -> str:
     return os.path.join(registry_dir, key)
 
 
+def fsync_dir(path: str) -> None:
+    """fsync a directory so a rename INTO it survives a crash — an
+    ``os.replace`` alone makes the file atomic, not durable: until the
+    directory entry itself is flushed, a power cut can roll the rename
+    back.  Best-effort on filesystems that refuse directory fds."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 def write_key(registry_dir: str, key: str, value: str) -> None:
     os.makedirs(registry_dir, exist_ok=True)
     path = _key_path(registry_dir, key)
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w") as f:
         f.write(value)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)  # atomic vs concurrent builders of the same key
+    # durability, not just atomicity: the registry entry must not survive
+    # a crash that its artifact (pack/model bytes, fsynced before their
+    # own rename) did not — same bug class PR 4 fixed for round files
+    fsync_dir(registry_dir)
 
 
 def get_value(registry_dir: str, key: str) -> Optional[str]:

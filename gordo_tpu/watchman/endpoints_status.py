@@ -118,11 +118,17 @@ async def discover_machines_ex(
     base_urls: Sequence[str],
     timeout: float = 5.0,
     session: Optional[aiohttp.ClientSession] = None,
+    artifact_formats: Optional[Dict[str, str]] = None,
 ) -> "tuple[List[str], int]":
     """Like :func:`discover_machines` but also reports how many targets
     answered their index at all — callers evicting machines absent from
     discovery must distinguish "every index omits this machine" from "no
-    index was reachable this cycle"."""
+    index was reachable this cycle".
+
+    ``artifact_formats``: optional dict the poll fills with each
+    responding target's reported ``artifact-format`` (``v2-packs`` |
+    ``v1-dirs``) — the fleet-wide artifact-discovery surface watchman
+    republishes, free-riding on the index responses already fetched."""
     own_session = session is None
     session = session or aiohttp.ClientSession()
     names: List[str] = []
@@ -140,6 +146,8 @@ async def discover_machines_ex(
             except (aiohttp.ClientError, asyncio.TimeoutError, ValueError):
                 continue
             n_responding += 1
+            if artifact_formats is not None and body.get("artifact-format"):
+                artifact_formats[base] = str(body["artifact-format"])
             for name in body.get("machines") or []:
                 if name not in names:
                     names.append(str(name))

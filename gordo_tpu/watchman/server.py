@@ -80,6 +80,11 @@ class Watchman:
         self.target_discovery = target_discovery
         self.started_at = time.time()
         self.statuses: Dict[str, EndpointStatus] = {}
+        #: per-target artifact format from the latest discovery poll
+        #: ({base_url: "v2-packs" | "v1-dirs"}) — republished in the
+        #: status document so a rollout to packed artifacts is visible
+        #: fleet-wide without querying every server
+        self.artifact_formats: Dict[str, str] = {}
         self._task: Optional[asyncio.Task] = None
         self._loop_ref: Optional[asyncio.AbstractEventLoop] = None
         self._wake: Optional[asyncio.Event] = None
@@ -103,9 +108,13 @@ class Watchman:
         t0 = time.monotonic()
         targets = await self._current_targets()
         if self.discover:
+            formats: Dict[str, str] = {}
             discovered, n_responding = await discover_machines_ex(
-                self.project, targets, timeout=self.request_timeout
+                self.project, targets, timeout=self.request_timeout,
+                artifact_formats=formats,
             )
+            if formats:
+                self.artifact_formats = formats
             for name in discovered:
                 if name not in self.machines:
                     self.machines.append(name)
@@ -214,6 +223,7 @@ class Watchman:
             "gordo-server-version": gordo_tpu.__version__,
             "uptime-seconds": round(time.time() - self.started_at, 1),
             "target-base-urls": self.target_base_urls,
+            "artifact-formats": dict(self.artifact_formats),
             "endpoints": [
                 self.statuses[m].to_json()
                 for m in self.machines

@@ -161,6 +161,11 @@ def build_plan(
         "n_machines": len(config.machines),
         "n_buckets": len(plan_buckets),
         "buckets": plan_buckets,
+        # artifact volume layout: the generated builder writes format v2,
+        # so the models PVC holds ~one pack per planned chunk (plus the
+        # index) instead of one directory per machine
+        "artifact_format": "v2",
+        "artifact_packs_estimate": len(plan_buckets),
     }
     if align_lengths:
         plan["align_lengths"] = int(align_lengths)
@@ -320,6 +325,13 @@ def _builder_job(project: str, image: str, tpu_resources: Dict[str, Any]) -> Dic
                             ],
                             "env": [
                                 {"name": "PROJECT_NAME", "value": project},
+                                # production builds write artifact format
+                                # v2: one mmap-able pack per fleet chunk
+                                # on the models PVC instead of thousands
+                                # of per-machine dirs — the server's
+                                # zero-copy load path (gordo_tpu/artifacts/)
+                                {"name": "GORDO_ARTIFACT_FORMAT",
+                                 "value": "v2"},
                                 # shared persistent XLA compile cache: a
                                 # retried Job (and every worker of a
                                 # --multihost Indexed Job, which extends
@@ -679,6 +691,10 @@ def generate_argo_workflow(
                         ],
                         "env": [
                             {"name": "PROJECT_NAME", "value": project},
+                            # chunk tasks share one models PVC: each task
+                            # writes its chunk's pack + an index merge
+                            # (flock-serialized), not per-machine dirs
+                            {"name": "GORDO_ARTIFACT_FORMAT", "value": "v2"},
                         ],
                         "resources": tpu_resources,
                         "volumeMounts": [

@@ -55,6 +55,28 @@ D2H_ASARRAY_MODULES = {"np", "numpy"}
 #: functions); ``# noqa`` opts a line out, as elsewhere.
 JIT_ALLOWED_DIR = os.path.join("gordo_tpu", "compile")
 
+#: per-machine artifact path construction is owned by the artifact plane:
+#: only gordo_tpu/artifacts/ (both formats behind one API), the
+#: serializer (which defines the v1 layout) and the builder (the v1
+#: write path) may reference the per-machine artifact file names.  Any
+#: other product code joining "<dir>/<machine>/model.pkl" bypasses the
+#: v2 pack index and silently grows a third layout.
+ARTIFACT_PATH_ALLOWED_DIRS = (
+    os.path.join("gordo_tpu", "artifacts"),
+    os.path.join("gordo_tpu", "serializer"),
+    os.path.join("gordo_tpu", "builder"),
+)
+ARTIFACT_FILE_LITERALS = {"model.pkl", "metadata.json", "definition.yaml"}
+ARTIFACT_FILE_ATTRS = {"MODEL_FILE", "METADATA_FILE", "DEFINITION_FILE"}
+
+#: gordo_tpu/artifacts/ load-path contract: packs load ZERO-COPY (memmap
+#: views — no host stack/concat copies) and ship to the device through
+#: exactly one call site, the function named ``to_device`` (the counted
+#: transfer behind the "one device_put per pack" acceptance gate).
+ARTIFACTS_DIR = os.path.join("gordo_tpu", "artifacts")
+ARTIFACTS_COPY_CALLS = {"stack", "concatenate", "vstack", "hstack"}
+ARTIFACTS_DEVICE_PUT_FN = "to_device"
+
 
 def _jit_allowed(path: str) -> bool:
     norm = os.path.normpath(path)
@@ -86,6 +108,89 @@ def _jit_findings(path: str, tree: ast.AST, noqa_lines: set) -> List[Finding]:
                  "program with the compile plane (compile.program for the "
                  "AOT serving path, compile.jit as a passthrough)")
             )
+    return findings
+
+
+def _artifact_path_findings(
+    path: str, tree: ast.AST, noqa_lines: set
+) -> List[Finding]:
+    """Flag per-machine artifact file references (``"model.pkl"`` /
+    ``serializer.MODEL_FILE`` and friends) in product code outside the
+    artifact plane's allowlisted owners."""
+    norm = os.path.normpath(path)
+    parts = norm.split(os.sep)
+    if "tests" in parts or os.path.basename(norm).startswith("test_"):
+        return []
+    if os.path.join("gordo_tpu", "") not in norm + os.sep:
+        return []  # scripts/bench/examples are operator tooling
+    if any(d in norm for d in ARTIFACT_PATH_ALLOWED_DIRS):
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        bad = None
+        if (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and node.value in ARTIFACT_FILE_LITERALS
+        ):
+            bad = repr(node.value)
+        elif (
+            isinstance(node, ast.Attribute)
+            and node.attr in ARTIFACT_FILE_ATTRS
+        ):
+            bad = f"serializer.{node.attr}"
+        if bad and getattr(node, "lineno", 0) not in noqa_lines:
+            findings.append(
+                (path, node.lineno,
+                 f"per-machine artifact path construction ({bad}) outside "
+                 "gordo_tpu/artifacts/ — go through the artifact plane "
+                 "(artifacts.discover / ArtifactRef / write_pack)")
+            )
+    return findings
+
+
+def _artifacts_pack_findings(
+    path: str, tree: ast.AST, noqa_lines: set
+) -> List[Finding]:
+    """Enforce the pack load contract inside gordo_tpu/artifacts/: no
+    host copy calls (stack/concatenate — loads must stay memmap views)
+    and ``device_put`` only inside ``to_device`` (the one counted
+    whole-pack transfer)."""
+    norm = os.path.normpath(path)
+    if ARTIFACTS_DIR not in norm:
+        return []
+    findings: List[Finding] = []
+    # map every node to its enclosing function name
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for child in ast.walk(node):
+                child._lint_fn = getattr(  # type: ignore[attr-defined]
+                    child, "_lint_fn", node.name
+                )
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not isinstance(func, ast.Attribute):
+            continue
+        if (
+            func.attr in ARTIFACTS_COPY_CALLS
+            and node.lineno not in noqa_lines
+        ):
+            findings.append(
+                (path, node.lineno,
+                 f"host copy call .{func.attr}() inside gordo_tpu/artifacts/"
+                 " — pack loads are zero-copy memmap views by contract")
+            )
+        if func.attr == "device_put" and node.lineno not in noqa_lines:
+            fn = getattr(node, "_lint_fn", None)
+            if fn != ARTIFACTS_DEVICE_PUT_FN:
+                findings.append(
+                    (path, node.lineno,
+                     "device_put outside to_device() in gordo_tpu/artifacts/"
+                     " — the one counted whole-pack transfer is the only "
+                     "allowed call site")
+                )
     return findings
 
 
@@ -217,6 +322,8 @@ def lint_file(path: str) -> List[Finding]:
 
     findings.extend(_d2h_findings(path, tree, noqa_lines))
     findings.extend(_jit_findings(path, tree, noqa_lines))
+    findings.extend(_artifact_path_findings(path, tree, noqa_lines))
+    findings.extend(_artifacts_pack_findings(path, tree, noqa_lines))
 
     for node in ast.walk(tree):
         if isinstance(node, ast.Call):

@@ -1,0 +1,585 @@
+"""Artifact format v2 (ISSUE 6): memory-mapped bucket packs.
+
+Fast lane: the pack format itself — zero-copy round-trips, page
+alignment, delta writes, corruption loudness, registry/manifest
+satellites, and the new lint gates (all on synthetic objects, no
+training).  Slow lane (``TestV1V2Parity``, CI test-full job): the
+v1↔v2 parity suite — build the same project both ways and assert
+scoring responses are byte-identical and the registry keys match, plus
+conversion round-trips and the one-device_put-per-pack attestation.
+"""
+
+import importlib.util
+import json
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from gordo_tpu import artifacts
+from gordo_tpu.utils import disk_registry
+
+
+def _models(n, rng=None, width=3):
+    rng = rng or np.random.default_rng(0)
+    out = []
+    for i in range(n):
+        w = rng.standard_normal((8, width)).astype(np.float32)
+        out.append(
+            {
+                "w": w,
+                "w_again": w,  # duplicate reference — must restore shared
+                "thr": rng.standard_normal(width).astype(np.float32),
+                "scale": float(i),
+                "note": f"machine {i}",
+            }
+        )
+    return out
+
+
+def _write(tmp_path, n=3, prefix="m"):
+    names = [f"{prefix}-{i}" for i in range(n)]
+    models = _models(n)
+    metas = [{"name": nm, "cache_key": f"key-{i}"}
+             for i, nm in enumerate(names)]
+    pack_id = artifacts.write_pack(
+        str(tmp_path), names, models, metas, definition="model: yes\n",
+        cache_keys={nm: f"key-{i}" for i, nm in enumerate(names)},
+    )
+    return names, models, pack_id
+
+
+class TestPackFormat:
+    def test_roundtrip_is_zero_copy_and_value_exact(self, tmp_path):
+        names, models, pack_id = _write(tmp_path)
+        store = artifacts.open_store(str(tmp_path))
+        assert store.names() == sorted(names)
+        m1 = store.load_model("m-1")
+        assert np.array_equal(m1["w"], models[1]["w"])
+        assert np.array_equal(m1["thr"], models[1]["thr"])
+        assert m1["scale"] == 1.0 and m1["note"] == "machine 1"
+        # duplicate references restore as ONE shared view
+        assert m1["w"] is m1["w_again"]
+        # zero copy: the leaf is a view into the pack mmap, owning nothing
+        assert not m1["w"].flags.owndata
+        assert store.load_metadata("m-1")["cache_key"] == "key-1"
+        assert store.definition("m-1") == "model: yes\n"
+
+    def test_tensor_segments_are_page_aligned(self, tmp_path):
+        _, _, pack_id = _write(tmp_path)
+        store = artifacts.open_store(str(tmp_path))
+        tensors = store.packs[pack_id]["tensors"]
+        assert tensors, "stacked tensors recorded"
+        for t in tensors:
+            assert t["offset"] % 4096 == 0, t
+
+    def test_stacked_tensors_match_slot_views(self, tmp_path):
+        names, models, pack_id = _write(tmp_path)
+        store = artifacts.open_store(str(tmp_path))
+        m0 = store.load_model("m-0")
+        loc = store.leaf_of(m0["w"])
+        assert loc is not None and loc[0] == pack_id
+        stacked = store.stacked(pack_id)[loc[1]]
+        assert stacked.shape[0] == len(names)
+        assert np.array_equal(stacked[0], models[0]["w"])
+        assert np.array_equal(stacked[2], models[2]["w"])
+
+    def test_leaf_signature_mismatch_refuses_pack(self, tmp_path):
+        models = _models(2)
+        models[1]["w"] = models[1]["w_again"] = np.zeros(
+            (9, 3), np.float32
+        )  # different shape
+        with pytest.raises(artifacts.PackError, match="leaf signature"):
+            artifacts.write_pack(str(tmp_path), ["a", "b"], models)
+
+    def test_rewrite_supersedes_and_gcs_dead_packs(self, tmp_path):
+        names, _, pack_id = _write(tmp_path)
+        # rewrite the same machines as a new chunk grouping
+        artifacts.write_pack(
+            str(tmp_path), names, _models(3, np.random.default_rng(7)),
+        )
+        store = artifacts.open_store(str(tmp_path))
+        # the superseded pack (same machine set -> same deterministic id
+        # is replaced in place; a different grouping would be GC'd once
+        # no machine rows point at it)
+        for name in names:
+            assert name in store
+        live_packs = {store.location(n)[0] for n in names}
+        packs_dir = artifacts.packs_dir(str(tmp_path))
+        on_disk = {
+            f for f in os.listdir(packs_dir) if f.endswith(".pack")
+        }
+        assert on_disk == {
+            store.packs[p]["file"] for p in live_packs
+        }, "no orphaned pack files survive a rewrite"
+
+
+class TestDeltaWrite:
+    def test_delta_rewrites_only_the_changed_slot(self, tmp_path):
+        names, models, pack_id = _write(tmp_path)
+        store = artifacts.open_store(str(tmp_path))
+        before = {
+            n: bytes(store.load_model(n)["w"].tobytes()) for n in names
+        }
+        new = dict(models[1])
+        new["w"] = new["w_again"] = np.full((8, 3), 7.0, np.float32)
+        new["scale"] = 99.0
+        rewritten = artifacts.delta_write(
+            str(tmp_path), {"m-1": new}, {"m-1": {"name": "m-1", "d": 1}}
+        )
+        assert rewritten == ["m-1"]
+        store2 = artifacts.open_store(str(tmp_path))
+        m1 = store2.load_model("m-1")
+        assert np.all(m1["w"] == 7.0) and m1["scale"] == 99.0
+        assert store2.load_metadata("m-1") == {"name": "m-1", "d": 1}
+        for n in ("m-0", "m-2"):  # other slots byte-untouched
+            assert store2.load_model(n)["w"].tobytes() == before[n]
+
+    def test_delta_of_unknown_machine_is_loud(self, tmp_path):
+        _write(tmp_path)
+        with pytest.raises(artifacts.PackError, match="not in the pack"):
+            artifacts.delta_write(str(tmp_path), {"nope": _models(1)[0]})
+
+    def test_delta_structural_change_is_loud(self, tmp_path):
+        _write(tmp_path)
+        bad = _models(1)[0]
+        bad["w"] = bad["w_again"] = np.zeros((2, 2), np.float32)
+        with pytest.raises(artifacts.PackError, match="leaf signature"):
+            artifacts.delta_write(str(tmp_path), {"m-0": bad})
+
+
+class TestCorruptionIsLoud:
+    def test_truncated_pack_fails_open(self, tmp_path):
+        _, _, pack_id = _write(tmp_path)
+        store = artifacts.open_store(str(tmp_path))
+        path = os.path.join(
+            artifacts.packs_dir(str(tmp_path)), store.packs[pack_id]["file"]
+        )
+        with open(path, "r+b") as fh:
+            fh.truncate(64)
+        with pytest.raises(artifacts.PackCorruptError, match="truncated"):
+            artifacts.open_store(str(tmp_path))
+
+    def test_bad_index_offset_fails_open(self, tmp_path):
+        _, _, pack_id = _write(tmp_path)
+        index = os.path.join(
+            artifacts.packs_dir(str(tmp_path)), "index.json"
+        )
+        doc = json.load(open(index))
+        doc["packs"][pack_id]["tensors"][0]["offset"] = 10 ** 9
+        json.dump(doc, open(index, "w"))
+        with pytest.raises(artifacts.PackCorruptError, match="truncated"):
+            artifacts.open_store(str(tmp_path))
+
+    def test_bad_magic_fails_open(self, tmp_path):
+        _, _, pack_id = _write(tmp_path)
+        store = artifacts.open_store(str(tmp_path))
+        path = os.path.join(
+            artifacts.packs_dir(str(tmp_path)), store.packs[pack_id]["file"]
+        )
+        with open(path, "r+b") as fh:
+            fh.write(b"XXXX")
+        with pytest.raises(artifacts.PackCorruptError, match="magic"):
+            artifacts.open_store(str(tmp_path))
+
+    def test_server_load_of_corrupt_pack_is_loud(self, tmp_path):
+        """The serving contract: a truncated pack must kill collection
+        load, not silently shrink the fleet."""
+        from gordo_tpu.serve.server import ModelCollection
+
+        _, _, pack_id = _write(tmp_path)
+        store = artifacts.open_store(str(tmp_path))
+        path = os.path.join(
+            artifacts.packs_dir(str(tmp_path)), store.packs[pack_id]["file"]
+        )
+        with open(path, "r+b") as fh:
+            fh.truncate(64)
+        with pytest.raises(artifacts.PackCorruptError):
+            ModelCollection.from_directory(str(tmp_path))
+
+
+class TestRefsAndRegistry:
+    def test_pack_ref_parses(self, tmp_path):
+        ref = artifacts.machine_ref(str(tmp_path), "m-0")
+        assert artifacts.is_pack_ref(ref)
+        directory, name = artifacts.parse_ref(ref)
+        assert name == "m-0"
+        assert directory.endswith(artifacts.PACKS_DIR)
+
+    def test_resolve_cached_hit_and_misses(self, tmp_path):
+        _write(tmp_path)
+        ref = artifacts.machine_ref(str(tmp_path), "m-1")
+        assert artifacts.resolve_cached(ref, "key-1") == ref
+        # wrong key -> miss (slot was overwritten by a different build)
+        assert artifacts.resolve_cached(ref, "other") is None
+        # unknown machine -> miss
+        missing = artifacts.machine_ref(str(tmp_path), "ghost")
+        assert artifacts.resolve_cached(missing, "key-1") is None
+        # vanished index -> miss, not a crash
+        shutil.rmtree(artifacts.packs_dir(str(tmp_path)))
+        assert artifacts.resolve_cached(ref, "key-1") is None
+
+    def test_registry_write_key_fsyncs_parent_dir(self, tmp_path, monkeypatch):
+        """ISSUE 6 satellite: the atomic rename alone is not durable —
+        the parent directory must fsync after it, or a crash can keep
+        the registry entry while its pack never landed."""
+        synced = []
+        real_fsync = os.fsync
+        real_open = os.open
+
+        opened = {}
+
+        def tracking_open(path, flags, *a, **kw):
+            fd = real_open(path, flags, *a, **kw)
+            opened[fd] = path
+            return fd
+
+        def tracking_fsync(fd):
+            synced.append(opened.get(fd, fd))
+            return real_fsync(fd)
+
+        monkeypatch.setattr(os, "open", tracking_open)
+        monkeypatch.setattr(os, "fsync", tracking_fsync)
+        reg = str(tmp_path / "reg")
+        disk_registry.write_key(reg, "abc123", "value")
+        assert disk_registry.get_value(reg, "abc123") == "value"
+        assert reg in synced, "parent directory fsynced after the rename"
+
+
+class TestManifestPruning:
+    def test_stale_machines_prune_from_kept_rows(self, tmp_path):
+        """ISSUE 6 satellite regression: a partial rebuild that shrinks
+        a bucket must drop machines (and whole rows) no longer present,
+        instead of union-merging stale (signature, bucket) rows forever.
+        """
+        from gordo_tpu.compile import load_warmup_manifest
+        from gordo_tpu.compile.warmup import write_warmup_manifest
+
+        out = str(tmp_path)
+        write_warmup_manifest(out, [
+            {"signature": "s1", "machines": ["a", "b"], "n_machines": 2,
+             "n_features": 3, "n_outputs": 3, "lookback": 1},
+            {"signature": "s2", "machines": ["c"], "n_machines": 1,
+             "n_features": 3, "n_outputs": 3, "lookback": 1},
+        ])
+        # partial rebuild touching only "d": machine "b" vanished from
+        # disk and every machine of row s2 is gone
+        write_warmup_manifest(
+            out,
+            [{"signature": "s3", "machines": ["d"], "n_machines": 1,
+              "n_features": 3, "n_outputs": 3, "lookback": 1}],
+            live_machines={"a", "d"},
+        )
+        manifest = load_warmup_manifest(out)
+        rows = {
+            e["signature"]: e["machines"] for e in manifest["programs"]
+        }
+        assert rows == {"s1": ["a"], "s3": ["d"]}
+        assert all(
+            e["n_machines"] == len(e["machines"])
+            for e in manifest["programs"]
+        )
+
+    def test_without_live_set_keeps_union_merge_behavior(self, tmp_path):
+        from gordo_tpu.compile import load_warmup_manifest
+        from gordo_tpu.compile.warmup import write_warmup_manifest
+
+        out = str(tmp_path)
+        write_warmup_manifest(out, [
+            {"signature": "s1", "machines": ["a", "b"], "n_machines": 2},
+        ])
+        write_warmup_manifest(out, [
+            {"signature": "s2", "machines": ["c"], "n_machines": 1},
+        ])
+        manifest = load_warmup_manifest(out)
+        assert {e["signature"] for e in manifest["programs"]} == {"s1", "s2"}
+
+
+class TestLintGates:
+    @staticmethod
+    def _lint(path):
+        spec = importlib.util.spec_from_file_location(
+            "gordo_lint", os.path.join(
+                os.path.dirname(os.path.dirname(__file__)),
+                "scripts", "lint.py",
+            ),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.lint_file(path)
+
+    def test_per_machine_path_construction_rejected(self, tmp_path):
+        bad = tmp_path / "gordo_tpu" / "serve" / "thing.py"
+        bad.parent.mkdir(parents=True)
+        bad.write_text(
+            "import os\np = os.path.join('d', 'model.pkl')\n"
+        )
+        findings = self._lint(str(bad))
+        assert any("artifact path construction" in f[2] for f in findings)
+
+    def test_artifacts_package_zero_copy_gate(self, tmp_path):
+        bad = tmp_path / "gordo_tpu" / "artifacts" / "thing.py"
+        bad.parent.mkdir(parents=True)
+        bad.write_text(
+            "import numpy as np\nimport jax\n"
+            "def load(xs):\n    return np.stack(xs)\n"
+            "def other(t):\n    return jax.device_put(t)\n"
+            "def to_device(t):\n    return jax.device_put(t)\n"
+        )
+        msgs = [f[2] for f in self._lint(str(bad))]
+        assert any("zero-copy" in m for m in msgs)
+        assert any("device_put outside to_device" in m for m in msgs)
+        assert sum("device_put outside" in m for m in msgs) == 1
+
+    def test_repo_is_clean_under_the_new_gates(self):
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        for rel in (
+            os.path.join("gordo_tpu", "serve", "server.py"),
+            os.path.join("gordo_tpu", "serve", "fleet_scorer.py"),
+            os.path.join("gordo_tpu", "artifacts", "pack.py"),
+            os.path.join("gordo_tpu", "artifacts", "__init__.py"),
+        ):
+            assert self._lint(os.path.join(repo, rel)) == [], rel
+
+
+# ---------------------------------------------------------------------------
+# v1 <-> v2 parity (slow lane — the CI test-full job)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestV1V2Parity:
+    @pytest.fixture(scope="class")
+    def built(self, tmp_path_factory):
+        from gordo_tpu.builder import build_project
+        from gordo_tpu.workflow.config import Machine
+
+        base = tmp_path_factory.mktemp("parity")
+        machines = [
+            Machine.from_config({
+                "name": f"pm-{i}",
+                "dataset": {
+                    "type": "RandomDataset",
+                    "tag_list": ["a", "b", "c"],
+                    "train_start_date": "2017-12-25T06:00:00Z",
+                    "train_end_date": "2017-12-26T06:00:00Z",
+                },
+            })
+            for i in range(5)
+        ]
+        dirs = {}
+        for fmt in ("v1", "v2"):
+            out = str(base / fmt)
+            reg = str(base / f"reg-{fmt}")
+            result = build_project(
+                machines, out, model_register_dir=reg,
+                max_bucket_size=2, artifact_format=fmt,
+            )
+            assert not result.failed
+            assert result.summary()["artifact_format"] == fmt
+            dirs[fmt] = (out, reg)
+        return machines, dirs
+
+    def test_registry_keys_identical(self, built):
+        _, dirs = built
+        k1 = disk_registry.list_keys(dirs["v1"][1])
+        k2 = disk_registry.list_keys(dirs["v2"][1])
+        assert k1 == k2 and len(k1) == 5
+
+    def test_v2_writes_packs_not_machine_dirs(self, built):
+        machines, dirs = built
+        out2 = dirs["v2"][0]
+        info = artifacts.store_info(out2)
+        assert info["format"] == "v2-packs"
+        assert info["packs"] == 3  # 5 machines at bucket 2
+        assert info["dir_machines"] == 0
+        for m in machines:
+            assert not os.path.isdir(os.path.join(out2, m.name))
+
+    def test_scoring_byte_identical_with_one_device_put_per_pack(self, built):
+        from gordo_tpu.serve.server import ModelCollection
+
+        _, dirs = built
+        c1 = ModelCollection.from_directory(dirs["v1"][0])
+        c2 = ModelCollection.from_directory(dirs["v2"][0])
+        assert c2.pack_store is not None and c1.pack_store is None
+        rng = np.random.default_rng(0)
+        X = {
+            n: rng.standard_normal((300, 3)).astype(np.float32)
+            for n in c1.entries
+        }
+        d0 = artifacts.device_put_count()
+        o2 = c2.fleet_scorer.score_all(X)
+        dputs = artifacts.device_put_count() - d0
+        # telemetry attestation: exactly ONE whole-pack transfer per pack
+        assert dputs == len(c2.pack_store.packs) == 3
+        o1 = c1.fleet_scorer.score_all(X)
+        for n in o1:
+            for k in o1[n]:
+                assert (
+                    np.asarray(o1[n][k]).tobytes()
+                    == np.asarray(o2[n][k]).tobytes()
+                ), (n, k)
+        # per-machine route parity too
+        s1 = c1.entries["pm-0"].scorer.anomaly_arrays(X["pm-0"])
+        s2 = c2.entries["pm-0"].scorer.anomaly_arrays(X["pm-0"])
+        for k in s1:
+            assert (
+                np.asarray(s1[k]).tobytes() == np.asarray(s2[k]).tobytes()
+            ), k
+
+    def test_v2_rerun_cache_hits_through_pack_refs(self, built, tmp_path):
+        from gordo_tpu.builder import build_project
+
+        machines, dirs = built
+        out2, reg2 = dirs["v2"]
+        rerun = build_project(
+            machines, out2, model_register_dir=reg2,
+            max_bucket_size=2, artifact_format="v2",
+        )
+        assert sorted(rerun.cached) == sorted(m.name for m in machines)
+        assert all(
+            artifacts.is_pack_ref(p) for p in rerun.artifacts.values()
+        )
+
+    def test_repack_then_unpack_round_trip(self, built, tmp_path):
+        from gordo_tpu.serve.server import ModelCollection
+
+        _, dirs = built
+        src = str(tmp_path / "work")
+        shutil.copytree(dirs["v1"][0], src)
+        summary = artifacts.repack(src, max_bucket_size=2)
+        assert summary["packs"] == 3 and not summary["kept_as_dirs"]
+        rng = np.random.default_rng(0)
+        c1 = ModelCollection.from_directory(dirs["v1"][0])
+        X = {
+            n: rng.standard_normal((300, 3)).astype(np.float32)
+            for n in c1.entries
+        }
+        o1 = c1.fleet_scorer.score_all(X)
+        o_packed = ModelCollection.from_directory(
+            src
+        ).fleet_scorer.score_all(X)
+        dest = str(tmp_path / "export")
+        artifacts.unpack(src, dest)
+        o_unpacked = ModelCollection.from_directory(
+            dest
+        ).fleet_scorer.score_all(X)
+        for n in o1:
+            for k in o1[n]:
+                want = np.asarray(o1[n][k]).tobytes()
+                assert np.asarray(o_packed[n][k]).tobytes() == want
+                assert np.asarray(o_unpacked[n][k]).tobytes() == want
+
+    def test_rescan_reloads_after_delta_write(self, built):
+        from gordo_tpu.serve.server import ModelCollection
+
+        _, dirs = built
+        out2 = dirs["v2"][0]
+        coll = ModelCollection.from_directory(out2)
+        name = "pm-0"
+        entry = coll.entries[name]
+        model = entry.model
+        # steady state: rescan with nothing changed keeps entries AND the
+        # mapped store object
+        store_before = coll.pack_store
+        assert coll.rescan() == {
+            "added": [], "reloaded": [], "removed": [],
+        }
+        assert coll.pack_store is store_before
+        import pickle
+
+        rebuilt = pickle.loads(pickle.dumps(model))
+        rebuilt.aggregate_threshold_ = 123.0
+        artifacts.delta_write(out2, {name: rebuilt})
+        changes = coll.rescan()
+        assert name in changes["reloaded"]
+        assert coll.entries[name].model.aggregate_threshold_ == 123.0
+
+    def test_manifest_prunes_when_bucket_shrinks(self, built, tmp_path):
+        from gordo_tpu.builder import build_project
+        from gordo_tpu.compile import load_warmup_manifest
+        from gordo_tpu.workflow.config import Machine
+
+        machines, dirs = built
+        out = str(tmp_path / "shrink")
+        shutil.copytree(dirs["v2"][0], out)
+        # machine pm-4 leaves the project: drop it from disk, then
+        # partially rebuild one other machine with a changed config
+        store = artifacts.open_store(out)
+        doc = json.load(open(os.path.join(
+            artifacts.packs_dir(out), "index.json"
+        )))
+        del doc["machines"]["pm-4"]
+        json.dump(doc, open(os.path.join(
+            artifacts.packs_dir(out), "index.json"
+        ), "w"))
+        changed = Machine.from_config({
+            "name": "pm-0",
+            "dataset": {
+                "type": "RandomDataset",
+                "tag_list": ["a", "b", "c"],
+                "train_start_date": "2017-12-25T06:00:00Z",
+                "train_end_date": "2017-12-26T12:00:00Z",
+            },
+        })
+        result = build_project(
+            [changed], out, max_bucket_size=2, artifact_format="v2",
+        )
+        assert not result.failed
+        manifest = load_warmup_manifest(out)
+        listed = {
+            m for e in manifest["programs"] for m in e["machines"]
+        }
+        assert "pm-4" not in listed, "stale machine pruned from manifest"
+        assert "pm-0" in listed
+        del store  # silence unused warning; keeps mmap alive above
+
+
+@pytest.mark.slow
+class TestMixedLayout:
+    def test_v2_build_with_single_fallback_serves_both(self, tmp_path):
+        """Non-fleetable machines still write v1 dirs inside a v2 build;
+        discovery and the collection serve the mixed layout."""
+        import yaml
+
+        from gordo_tpu.builder import build_project
+        from gordo_tpu.serve.server import ModelCollection
+        from gordo_tpu.workflow.config import Machine
+
+        plain = yaml.safe_load("""
+gordo_tpu.pipeline.Pipeline:
+  steps:
+    - gordo_tpu.ops.scalers.MinMaxScaler
+    - gordo_tpu.models.estimator.AutoEncoder:
+        kind: feedforward_hourglass
+        epochs: 2
+""")
+        dataset = {
+            "type": "RandomDataset",
+            "tag_list": ["a", "b", "c"],
+            "train_start_date": "2017-12-25T06:00:00Z",
+            "train_end_date": "2017-12-26T06:00:00Z",
+        }
+        machines = [
+            Machine.from_config({"name": "fleet-0", "dataset": dataset}),
+            Machine.from_config({"name": "fleet-1", "dataset": dataset}),
+            Machine.from_config(
+                {"name": "plain-0", "dataset": dataset, "model": plain}
+            ),
+        ]
+        out = str(tmp_path / "mixed")
+        result = build_project(machines, out, artifact_format="v2")
+        assert not result.failed
+        assert sorted(result.fleet_built) == ["fleet-0", "fleet-1"]
+        assert result.single_built == ["plain-0"]
+        info = artifacts.store_info(out)
+        assert info["packed_machines"] == 2 and info["dir_machines"] == 1
+        coll = ModelCollection.from_directory(out)
+        assert sorted(coll.entries) == ["fleet-0", "fleet-1", "plain-0"]
+        X = np.random.default_rng(0).standard_normal(
+            (40, 3)
+        ).astype(np.float32)
+        assert coll.entries["plain-0"].scorer.predict(X).shape == (40, 3)
+        out_fleet = coll.fleet_scorer.score_all({"fleet-0": X})
+        assert "total-anomaly-score" in out_fleet["fleet-0"]

@@ -12,13 +12,17 @@ import bench
 
 def test_default_runs_every_stage_in_priority_order():
     assert bench.parse_stages([]) == [
-        "build", "build_pipeline", "serving", "serving_openloop",
-        "telemetry_overhead", "cold_start", "lstm",
+        "build", "build_pipeline", "artifact_io", "serving",
+        "serving_openloop", "telemetry_overhead", "cold_start", "lstm",
     ]
 
 
 def test_cold_start_stage_selectable():
     assert bench.parse_stages(["--stage", "cold_start"]) == ["cold_start"]
+
+
+def test_artifact_io_stage_selectable():
+    assert bench.parse_stages(["--stage", "artifact_io"]) == ["artifact_io"]
 
 
 def test_single_stage_selection():
