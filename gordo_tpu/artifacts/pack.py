@@ -669,11 +669,30 @@ class PackStore:
         return sum(int(e.get("bytes", 0)) for e in self.packs.values())
 
 
-def to_device(host_tree: Any, shardings: Any = None) -> Any:
+def to_device(host_tree: Any, shardings: Any = None, dtype: Any = None) -> Any:
     """ONE whole-pack host→device transfer (counted; the v2 load contract
     is exactly one of these per (signature, bucket) pack — the lint gate
-    keeps ``device_put`` out of everywhere else in this package)."""
+    keeps ``device_put`` out of everywhere else in this package).
+
+    ``dtype``: optional storage dtype (the serving-precision plane —
+    ``gordo_tpu/serve/precision.py``): float leaves are cast host-side
+    before the transfer, so a bf16 serving configuration ships HALF the
+    pack bytes over the wire and resides at half the device footprint.
+    ``None`` (the fp32 default) preserves the zero-copy memmap path —
+    a cast necessarily materializes a host copy, so it only happens when
+    reduced precision was explicitly configured.
+    """
     _PACK_DEVICE_PUTS.inc(1.0)
+    if dtype is not None:
+        dt = np.dtype(dtype)
+        host_tree = jax.tree.map(
+            lambda a: (
+                a.astype(dt)
+                if getattr(getattr(a, "dtype", None), "kind", "") == "f"
+                else a
+            ),
+            host_tree,
+        )
     if shardings is None:
         return jax.device_put(host_tree)
     return jax.device_put(host_tree, shardings)

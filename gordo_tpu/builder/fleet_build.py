@@ -977,6 +977,7 @@ def build_project(
         # machines that actually exist on disk so a shrunk bucket can't
         # leave stale (signature, bucket) rows behind.
         from gordo_tpu.compile import write_warmup_manifest
+        from gordo_tpu.serve.precision import serve_dtype
 
         write_warmup_manifest(
             output_dir, manifest_entries, shard=result.shard,
@@ -984,6 +985,11 @@ def build_project(
                 artifacts.machines_on_disk(output_dir)
                 | set(result.artifacts)
             ),
+            # resolved HERE, at build time: the manifest carries the
+            # precision this deployment is configured for, so a server
+            # started without GORDO_SERVE_DTYPE set still warms and
+            # serves what the build intended
+            serve_dtype=serve_dtype(),
         )
     except Exception:  # the manifest is a hint, never a build failure
         logger.exception("warmup manifest write failed")

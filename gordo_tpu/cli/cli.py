@@ -611,6 +611,7 @@ def warmup_cmd(model_dir, server_url, row_sizes, timeout):
         click.echo(
             f"warmup: {stats['buckets']} bucket(s), "
             f"{len(stats['programs'])} program signature(s), "
+            f"dtype={stats.get('dtype', 'float32')}, "
             f"{stats.get('compile_seconds', 0.0):.2f}s compiling, "
             f"{stats['errors']} error(s)"
         )
@@ -818,10 +819,17 @@ def workflow_group():
                    "annotations on the server and watchman pod templates "
                    "so their /metrics endpoints are scraped without extra "
                    "cluster config.")
+@click.option("--serve-dtype", default=None,
+              help="Serving precision (fp32/bf16; int8 needs the "
+                   "GORDO_SERVE_INT8 opt-in at runtime): stamps "
+                   "GORDO_SERVE_DTYPE on builder AND server pods so the "
+                   "warmup manifest, AOT warmup, and request dispatch all "
+                   "agree. Only use after the fp32 parity suite passes "
+                   "for this project's model family (docs/perf.md).")
 @click.option("--output-file", type=click.File("w"), default="-")
 def workflow_generate(machine_config, project_name, image, server_replicas,
                       server_args, fmt, multihost, scrape_annotations,
-                      output_file):
+                      serve_dtype, output_file):
     """Render the kubernetes manifests + fleet build plan (reference:
     the Argo workflow template render)."""
     from gordo_tpu.workflow import (
@@ -843,6 +851,7 @@ def workflow_generate(machine_config, project_name, image, server_replicas,
             config, image=image, server_replicas=server_replicas,
             server_args=list(server_args), multihost=multihost,
             scrape_annotations=scrape_annotations,
+            serve_dtype=serve_dtype,
         )
     except ValueError as exc:
         raise click.ClickException(str(exc))
@@ -851,9 +860,13 @@ def workflow_generate(machine_config, project_name, image, server_replicas,
 
         # the Argo Workflow replaces the builder Job; serving manifests
         # (Deployments/Services/Mappings/plan ConfigMap) stay as-is
-        docs = [generate_argo_workflow(config, image=image)] + [
-            d for d in docs if d.get("kind") != "Job"
-        ]
+        try:
+            argo = generate_argo_workflow(
+                config, image=image, serve_dtype=serve_dtype
+            )
+        except ValueError as exc:
+            raise click.ClickException(str(exc))
+        docs = [argo] + [d for d in docs if d.get("kind") != "Job"]
     output_file.write(workflow_to_yaml(docs))
 
 

@@ -36,7 +36,12 @@ WARMUP_DIR = ".gordo-warmup"
 #: and the 2048-row bench/replay size)
 DEFAULT_ROW_BUCKETS = (256, 2048)
 
-MANIFEST_VERSION = 1
+#: v2 manifests carry the build-time serving dtype (the serving-precision
+#: plane): what the builder resolved ``GORDO_SERVE_DTYPE`` to is what the
+#: server's warmup must compile for — a bf16 build warms bf16
+#: executables, never fp32 ones.  v1 manifests (no ``dtype``) read as
+#: float32.
+MANIFEST_VERSION = 2
 
 
 def _shard_path(output_dir: str, shard) -> str:
@@ -52,6 +57,7 @@ def write_warmup_manifest(
     shard=None,
     row_buckets: Optional[Sequence[int]] = None,
     live_machines: Optional[set] = None,
+    serve_dtype: Optional[str] = None,
 ) -> Optional[str]:
     """Write (merge) this build's warmup manifest shard file.
 
@@ -60,6 +66,12 @@ def write_warmup_manifest(
     "lookback"}``.  Entries already on disk for machines NOT rebuilt this
     run are kept (a partial rebuild must not unlearn the rest of the
     project); entries overlapping the new machine set are replaced.
+
+    ``serve_dtype``: the serving precision this build was configured for
+    (``None`` resolves ``GORDO_SERVE_DTYPE`` here, at write time) —
+    recorded doc-level so the serve plane warms, and defaults to serving,
+    the same precision; a rewrite (latest build) wins over merged rows'
+    older dtype.
 
     ``live_machines``: when given, kept rows PRUNE to it — machines no
     longer present in the build output drop out of their rows, and rows
@@ -94,8 +106,12 @@ def write_warmup_manifest(
             kept.append(e)
     except (OSError, ValueError):
         pass
+    # lazy import: gordo_tpu.compile initializes before the serve package
+    from gordo_tpu.serve.precision import canonical, serve_dtype as _resolve
+
     doc = {
         "version": MANIFEST_VERSION,
+        "dtype": canonical(serve_dtype) if serve_dtype else _resolve(),
         "row_buckets": sorted(
             set(int(r) for r in (row_buckets or DEFAULT_ROW_BUCKETS))
         ),
@@ -120,14 +136,19 @@ def write_warmup_manifest(
 def load_warmup_manifest(path: str) -> Optional[Dict[str, Any]]:
     """Merge every shard manifest under ``path`` (a build output dir, or
     its ``.gordo-warmup/`` subdir directly).  Returns
-    ``{"row_buckets": [...], "programs": [...]}`` or None when no
-    manifest exists."""
+    ``{"dtype": ..., "row_buckets": [...], "programs": [...]}`` or None
+    when no manifest exists.  ``dtype`` is the build-time serving
+    precision when every shard agrees (v1 shards read as float32);
+    disagreeing shards — a half-finished precision migration — yield
+    ``None`` with a warning, and the serve plane falls back to its env
+    resolution rather than guessing."""
     candidates = [os.path.join(path, WARMUP_DIR), path]
     directory = next((d for d in candidates if os.path.isdir(d)), None)
     if directory is None:
         return None
     row_buckets: set = set()
     programs: List[Dict[str, Any]] = []
+    dtypes: set = set()
     for name in sorted(os.listdir(directory)):
         if not name.endswith(".json"):
             continue
@@ -139,9 +160,19 @@ def load_warmup_manifest(path: str) -> Optional[Dict[str, Any]]:
             continue
         row_buckets.update(int(r) for r in doc.get("row_buckets", ()))
         programs.extend(doc.get("programs", ()))
+        dtypes.add(str(doc.get("dtype", "float32")))
     if not programs and not row_buckets:
         return None
+    dtype: Optional[str] = None
+    if len(dtypes) == 1:
+        dtype = next(iter(dtypes))
+    elif len(dtypes) > 1:
+        logger.warning(
+            "warmup manifest shards disagree on serving dtype (%s); "
+            "ignoring the manifest dtype", sorted(dtypes),
+        )
     return {
+        "dtype": dtype,
         "row_buckets": sorted(row_buckets) or list(DEFAULT_ROW_BUCKETS),
         "programs": programs,
     }
@@ -184,6 +215,9 @@ def warmup_collection(
         logger.exception("Warmup: fleet scorer construction failed")
         stats["errors"] += 1
         return stats
+    # the serving precision actually warmed (bucket program prefixes carry
+    # it; a bf16 manifest/collection warms bf16 executables, never fp32)
+    stats["dtype"] = getattr(fleet, "dtype", "float32")
 
     for bucket in fleet.buckets:
         ok = True

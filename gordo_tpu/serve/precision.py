@@ -1,0 +1,191 @@
+"""The serving-precision plane: ONE owner of the serving compute dtype.
+
+Reference status: absent upstream — the reference served fp64 pandas
+through sklearn and had no precision policy to own.  Here every serving
+request runs through a fused XLA program, and reduced-precision batched
+serving is the dominant TPU lever (PAPERS.md, the Gemma-on-TPU serving
+comparison): bf16 halves parameter residency and H2D bytes and runs on
+the MXU's native path.  This module is the single place that policy
+lives; the scorer, the fleet scorer, the warmup path, the artifact
+plane's ``to_device`` casts, and the workflow generator all resolve the
+serving dtype HERE so they can never disagree.
+
+Resolution order (``serve_dtype``): an explicit argument (tests,
+embedding callers) > the ``GORDO_SERVE_DTYPE`` env var (what the
+generated k8s manifests stamp on builder AND server pods) > the build's
+warmup-manifest dtype (``default=``, so the decision travels with the
+artifacts) > ``float32``.
+
+Supported dtypes:
+
+- ``float32`` — the parity reference; the default everywhere.
+- ``bfloat16`` — params, scaler stats and all in-program compute run
+  bf16; outputs are cast back to float32 before leaving the program so
+  the response schema (and the codec) see exactly what fp32 serving
+  emits, modulo the precision itself.  Gated by the fp32 parity suite
+  (``tests/test_serving_precision.py``; per-machine error bounds —
+  see docs/perf.md "Serving precision").
+- ``int8`` — EXPERIMENTAL, behind the explicit ``GORDO_SERVE_INT8=1``
+  opt-in: weight/stat tensors are fake-quantized to the symmetric
+  127-level int8 grid in-program (per-leaf max-abs scale) and
+  activations compute in bf16 — the numerics of int8 weight-only
+  serving, measurable against the parity gate ahead of hardware int8
+  kernels.  It is a precision probe, not (yet) a throughput lever.
+
+The dtype is a STATIC argument of every serving program, so it lands in
+the compile plane's executable cache keys and the warmup manifest —
+a bf16 manifest warms bf16 executables, never fp32 ones.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import numpy as np
+
+#: the one env knob (stamped by the workflow generator on builder and
+#: server pods so build-time manifests and serve-time dispatches agree)
+SERVE_DTYPE_ENV = "GORDO_SERVE_DTYPE"
+#: int8 is experimental quantization simulation — require a second,
+#: explicit switch so nobody lands on it by typo or copy-paste
+INT8_OPT_IN_ENV = "GORDO_SERVE_INT8"
+
+#: accepted spellings → canonical names (the canonical name is what the
+#: compile-plane cache keys and the warmup manifest carry)
+_ALIASES = {
+    "float32": "float32", "fp32": "float32", "f32": "float32",
+    "bfloat16": "bfloat16", "bf16": "bfloat16",
+    "int8": "int8", "i8": "int8",
+}
+SUPPORTED = ("float32", "bfloat16", "int8")
+
+
+def canonical(name: str) -> str:
+    """Canonical dtype name for any accepted spelling; ValueError on an
+    unknown one (the loud-config contract: a typo'd dtype must fail the
+    process, not silently serve fp32)."""
+    resolved = _ALIASES.get(str(name).strip().lower())
+    if resolved is None:
+        raise ValueError(
+            f"unknown serving dtype {name!r}; supported: "
+            f"{', '.join(SUPPORTED)} (GORDO_SERVE_DTYPE)"
+        )
+    return resolved
+
+
+def _int8_opted_in() -> bool:
+    return os.environ.get(INT8_OPT_IN_ENV, "").strip().lower() in (
+        "1", "true", "on", "yes",
+    )
+
+
+def serve_dtype(default: Optional[str] = None) -> str:
+    """Resolve the serving dtype: ``GORDO_SERVE_DTYPE`` when set, else
+    ``default`` (the warmup manifest's build-time dtype, when the caller
+    has one), else ``float32``.  ``int8`` additionally requires the
+    ``GORDO_SERVE_INT8=1`` opt-in — without it resolution raises, so a
+    misconfigured deployment fails at startup/build, never mid-request.
+    """
+    raw = os.environ.get(SERVE_DTYPE_ENV, "").strip()
+    if raw:
+        name = canonical(raw)
+    elif default:
+        name = canonical(default)
+    else:
+        name = "float32"
+    if name == "int8" and not _int8_opted_in():
+        raise ValueError(
+            "GORDO_SERVE_DTYPE=int8 is experimental (weight fake-quant, "
+            "bf16 activations) and requires the explicit opt-in "
+            f"{INT8_OPT_IN_ENV}=1"
+        )
+    return name
+
+
+def storage_np_dtype(name: str):
+    """The numpy dtype device-resident float tensors are STORED in for a
+    serving dtype: bf16 for both bf16 and int8 serving (int8 fake-quant
+    happens in-program; shipping bf16 already halves residency and the
+    pack ``to_device`` transfer), float32 otherwise.  Returns None for
+    float32 so callers can skip the cast entirely and keep the v2 pack
+    load zero-copy."""
+    if canonical(name) == "float32":
+        return None
+    import ml_dtypes
+
+    return np.dtype(ml_dtypes.bfloat16)
+
+
+# ---------------------------------------------------------------------------
+# in-program casts (traced inside the fused serving programs)
+# ---------------------------------------------------------------------------
+
+def compute_dtype(name: str):
+    """The jnp dtype in-program activations compute in."""
+    import jax.numpy as jnp
+
+    return jnp.float32 if canonical(name) == "float32" else jnp.bfloat16
+
+
+def _fake_quant_int8(a):
+    """Symmetric per-tensor fake quantization to the 127-level int8 grid
+    (round-to-nearest, per-leaf max-abs scale) — the numerics of int8
+    weight-only serving without hardware int8 kernels."""
+    import jax.numpy as jnp
+
+    scale = jnp.maximum(jnp.max(jnp.abs(a)), 1e-12) / 127.0
+    q = jnp.clip(jnp.round(a / scale), -127.0, 127.0)
+    return (q * scale).astype(jnp.bfloat16)
+
+
+def cast_params(tree: Any, name: str) -> Any:
+    """Cast a parameter/stats pytree's float leaves for in-program
+    compute: identity for float32, bf16 cast for bfloat16, fake-quant →
+    bf16 for int8.  No-op on leaves already stored reduced."""
+    name = canonical(name)
+    if name == "float32":
+        return tree
+    import jax
+    import jax.numpy as jnp
+
+    if name == "int8":
+        fn = lambda a: (  # noqa: E731
+            _fake_quant_int8(a)
+            if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a
+        )
+    else:
+        fn = lambda a: (  # noqa: E731
+            jnp.asarray(a).astype(jnp.bfloat16)
+            if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a
+        )
+    return jax.tree.map(fn, tree)
+
+
+def cast_input(x: Any, name: str) -> Any:
+    """Cast the request matrix to the compute dtype (activations: bf16
+    for both bf16 and int8 serving — inputs are data, not weights, so
+    they are never fake-quantized)."""
+    if canonical(name) == "float32":
+        return x
+    import jax.numpy as jnp
+
+    return jnp.asarray(x).astype(jnp.bfloat16)
+
+
+def cast_storage(tree: Any, name: str) -> Any:
+    """Cast an already-stacked device/host pytree's float leaves to the
+    STORAGE dtype (see :func:`storage_np_dtype`); identity for f32."""
+    st = storage_np_dtype(name)
+    if st is None:
+        return tree
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree.map(
+        lambda a: (
+            jnp.asarray(a).astype(jnp.bfloat16)
+            if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a
+        ),
+        tree,
+    )

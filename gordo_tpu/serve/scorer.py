@@ -20,6 +20,9 @@ that don't match run through their own (slower, host-side) ``.anomaly`` /
 
 from __future__ import annotations
 
+import os
+import threading
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -27,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from gordo_tpu import compile as compile_plane
+from gordo_tpu import telemetry
 from gordo_tpu.anomaly.base import AnomalyDetectorBase
 from gordo_tpu.anomaly.diff import DiffBasedAnomalyDetector, scores_fn
 from gordo_tpu.models.estimator import (
@@ -36,6 +40,24 @@ from gordo_tpu.models.estimator import (
 )
 from gordo_tpu.ops.windows import make_windows
 from gordo_tpu.pipeline import Pipeline
+from gordo_tpu.serve import precision
+
+# -- telemetry instruments (docs/observability.md "Serving dispatch") -------
+#: the single-dispatch attestation pair: on the fused request path a
+#: request is decode → ONE input transfer → ONE device dispatch → encode,
+#: and these counters are the evidence (bench serving_precision asserts
+#: deltas == request counts; divergence means host-side work crept back in)
+_DISPATCHES = telemetry.counter(
+    "gordo_serve_dispatches_total",
+    "Device dispatches issued by the serving scorers, by program",
+    labels=("program",),
+)
+_H2D = telemetry.counter(
+    "gordo_serve_input_transfers_total",
+    "Host-to-device input transfers on the serving request path, "
+    "by program",
+    labels=("program",),
+)
 
 #: smallest compile bucket; requests below this pad up to it.  Hardware
 #: sweep (v5e via tunnel, r4): per-call latency is FLAT ~204-240ms from 32
@@ -66,6 +88,24 @@ def _bucket_rows(n: int) -> int:
     while b < n:
         b *= 2
     return b
+
+
+def _fused_enabled() -> bool:
+    """``GORDO_SERVE_FUSED=off`` routes the diff-anomaly epilogue
+    (threshold/confidence math) and request padding back through host
+    numpy — the r11 request path, kept ONLY as the measured baseline for
+    ``bench --stage serving_precision`` and the fused-vs-host parity pin.
+    Production serving never turns this off."""
+    return os.environ.get("GORDO_SERVE_FUSED", "on").strip().lower() not in (
+        "off", "0", "false",
+    )
+
+
+def _legacy_pad(X: np.ndarray, bucket: int) -> np.ndarray:
+    """The r11 host-side repeat-last pad (double copy: concatenate then
+    the transfer).  Only reachable with ``GORDO_SERVE_FUSED=off``; the
+    fused path writes into a pinned pad buffer instead."""
+    return np.concatenate([X, np.tile(X[-1:], (bucket - X.shape[0], 1))])
 
 
 def _extract_chain(model) -> Optional[Dict[str, Any]]:
@@ -176,14 +216,27 @@ def _score_program_fn(
     det_cls,
     with_anomaly,
     smooth_window,
+    dtype,
+    with_confidence,
     scaler_stats,
     params,
     det_stats,
+    agg_threshold,
     X,
     smooth_block=0,
 ):
-    """(X padded to bucket) -> dict of arrays; the whole pipeline fused."""
-    Xs = X
+    """(X padded to bucket) -> dict of arrays; the whole pipeline fused —
+    scaler chain, windowing, network apply, detector scaling, |diff|, L2
+    total, smoothing, AND the confidence epilogue — at the serving
+    precision ``dtype`` (a static: it keys the compiled executable).
+    Outputs always leave the program as float32, so the response schema
+    is dtype-invariant; reduced precision is an internal compute matter
+    gated by the fp32 parity suite."""
+    Xc = precision.cast_input(X, dtype)
+    scaler_stats = precision.cast_params(scaler_stats, dtype)
+    params = precision.cast_params(params, dtype)
+    det_stats = precision.cast_params(det_stats, dtype)
+    Xs = Xc
     for cls, stats in zip(scaler_classes, scaler_stats):
         Xs = cls.apply(stats, Xs)
 
@@ -195,10 +248,10 @@ def _score_program_fn(
         inputs = make_windows(Xs[:-1], lookback)
 
     pred = module.apply({"params": params}, inputs)
-    out = {"model-output": pred}
+    out = {"model-output": pred.astype(jnp.float32)}
     if with_anomaly:
         offset = X.shape[0] - pred.shape[0]
-        y_al = X[offset:]
+        y_al = Xc[offset:]
         tag, total = scores_fn(det_cls, det_stats, y_al, pred)
         if smooth_window and smooth_block:
             tag = _rolling_median_blocked(tag, smooth_window, smooth_block)
@@ -208,26 +261,42 @@ def _score_program_fn(
         elif smooth_window:
             tag = _rolling_median(tag, smooth_window)
             total = _rolling_median(total, smooth_window)
+        tag = tag.astype(jnp.float32)
+        total = total.astype(jnp.float32)
         out["tag-anomaly-scores"] = tag
         out["total-anomaly-score"] = total
+        if with_confidence:
+            # the diff-anomaly epilogue, fused: confidence is computed on
+            # device in f32 (thresholds never quantize) — the last piece
+            # of host numpy the request path used to pay per request
+            out["anomaly-confidence"] = total / jnp.maximum(
+                agg_threshold.astype(jnp.float32), 1e-12
+            )
     return out
 
 
 #: the per-machine fused serving program, owned by the compile plane: the
-#: server's startup warmup AOT-compiles it per (signature, row bucket)
-#: before the readiness flip, so the first request never traces
+#: server's startup warmup AOT-compiles it per (signature, row bucket,
+#: serving dtype) before the readiness flip, so the first request never
+#: traces
 _score_program = compile_plane.program(
     "serve.score",
     _score_program_fn,
     static_argnames=(
         "module", "scaler_classes", "mode", "lookback", "det_cls",
-        "with_anomaly", "smooth_window", "smooth_block",
+        "with_anomaly", "smooth_window", "dtype", "with_confidence",
+        "smooth_block",
     ),
 )
 
 
 def _program_args(
-    c: Dict[str, Any], X: Any, with_anomaly: bool, smooth_block: int
+    c: Dict[str, Any],
+    X: Any,
+    with_anomaly: bool,
+    smooth_block: int,
+    dtype: str,
+    with_confidence: bool,
 ) -> Tuple[Tuple, Dict[str, Any]]:
     """The ONE assembly of ``_score_program``'s arguments — the dispatch
     path (``_run``) and the AOT warmup (``warm_programs``) must agree on
@@ -242,51 +311,126 @@ def _program_args(
         det["scaler_cls"] if det else None,
         bool(with_anomaly and det),
         det["window"] if (det and with_anomaly) else 0,
+        dtype,
+        with_confidence,
         tuple(stats for _, stats in c["scalers"]),
         c["params"],
         det["scaler_stats"] if det else None,
+        # a () f32 leaf, not a python float: its signature must be
+        # identical between warm (ShapeDtypeStruct-adjacent) and dispatch
+        np.float32(det["aggregate_threshold"]) if with_confidence else None,
         X,
     )
     return args, {"smooth_block": smooth_block}
 
 
 class CompiledScorer:
-    """Callable scoring surface over one model; jitted when possible."""
+    """Callable scoring surface over one model; jitted when possible.
 
-    def __init__(self, model):
+    ``dtype``: the serving precision this scorer dispatches at
+    (``None`` resolves ``GORDO_SERVE_DTYPE`` per call — the env knob is
+    live for tests and embedding callers; collections resolve once and
+    pass it explicitly so a whole fleet serves one precision).
+    """
+
+    #: max retained pinned pad buffers (power-of-two row bucketing keeps
+    #: distinct request shapes log-few; mirrors _Bucket.MAX_STACK_BUFS)
+    MAX_PAD_BUFS = 4
+
+    def __init__(self, model, dtype: Optional[str] = None):
         self.model = model
         self.chain = _extract_chain(model)
         self.is_anomaly = isinstance(model, AnomalyDetectorBase)
         self.offset = getattr(model, "offset", 0)
+        self._dtype = precision.canonical(dtype) if dtype else None
+        #: pinned host pad buffers keyed by (bucket_rows, n_features),
+        #: reused while request shapes repeat: padding writes ONE copy
+        #: into the buffer and the transfer is the only other touch —
+        #: the r11 path concatenated a fresh padded array first (two
+        #: copies per request).  Guarded by _pad_lock: concurrent
+        #: requests for one machine run _run from executor threads.
+        self._pad_bufs: "OrderedDict[Tuple[int, int], np.ndarray]" = (
+            OrderedDict()
+        )
+        self._pad_lock = threading.Lock()
 
     @property
     def fused(self) -> bool:
         return self.chain is not None
+
+    @property
+    def dtype(self) -> str:
+        return self._dtype or precision.serve_dtype()
+
+    def _pad_buffer(self, shape: Tuple[int, int]) -> np.ndarray:
+        """Pinned pad buffer for ``shape`` (call with ``_pad_lock`` held)."""
+        buf = self._pad_bufs.get(shape)
+        if buf is None:
+            buf = self._pad_bufs[shape] = np.empty(shape, np.float32)
+            while len(self._pad_bufs) > self.MAX_PAD_BUFS:
+                self._pad_bufs.popitem(last=False)
+        else:
+            self._pad_bufs.move_to_end(shape)
+        return buf
 
     # -- fused path ----------------------------------------------------------
     def _run(
         self, X: np.ndarray, with_anomaly: bool, smooth_block: int = 0
     ) -> Dict[str, np.ndarray]:
         c = self.chain
+        det = c["detector"]
+        dtype = self.dtype
+        fused = _fused_enabled()
+        with_confidence = bool(
+            with_anomaly and fused and det
+            and det["feature_thresholds"] is not None
+        )
         n = X.shape[0]
         bucket = _bucket_rows(n)
-        if bucket != n:
-            X = np.concatenate(
-                [X, np.tile(X[-1:], (bucket - n, 1))]  # repeat-last padding
-            )
+        if bucket != n and not fused:
+            X = _legacy_pad(X, bucket)
+        if bucket != n and fused:
+            # single-copy repeat-last padding into the pinned buffer; the
+            # lock spans fill -> transfer so a concurrent request can't
+            # overwrite rows mid-copy.  jnp.array (copy=True), NOT
+            # jnp.asarray: on the CPU backend asarray may ZERO-COPY ALIAS
+            # the numpy buffer, and the next same-bucket request would
+            # then rewrite this request's live device array after the
+            # lock drops (observed as coalesced-vs-direct mismatches
+            # under concurrency).  On real accelerators the H2D DMA is
+            # the copy either way.  The input transfer stays f32 (the
+            # client's precision); reduced-precision casts happen inside
+            # the program, where they are free.
+            with self._pad_lock:
+                buf = self._pad_buffer((bucket, X.shape[1]))
+                buf[:n] = X
+                buf[n:] = X[-1:]
+                _H2D.inc(1.0, "serve.score")
+                Xd = jnp.array(buf, jnp.float32)
+        else:
+            _H2D.inc(1.0, "serve.score")
+            Xd = jnp.asarray(X, jnp.float32)
         args, kw = _program_args(
-            c, jnp.asarray(X, jnp.float32), with_anomaly, smooth_block
+            c, Xd, with_anomaly, smooth_block, dtype, with_confidence
         )
+        # the ONE device dispatch of this request (attested by bench
+        # serving_precision: counter delta == request count)
+        _DISPATCHES.inc(1.0, "serve.score")
         out = _score_program(*args, **kw)
         n_valid = n - self.offset
         return {k: np.asarray(v)[:n_valid] for k, v in out.items()}
 
-    def warm_programs(self, rows: int, n_features: int) -> List[Tuple[str, float]]:
+    def warm_programs(
+        self, rows: int, n_features: int, dtype: Optional[str] = None
+    ) -> List[Tuple[str, float]]:
         """AOT-compile this machine's fused program(s) for one row bucket
-        — shape structs only, nothing executes.  Returns
-        ``[(label, compile_seconds), ...]`` (0.0 = already compiled)."""
+        — shape structs only, nothing executes.  ``dtype`` defaults to
+        this scorer's serving dtype, so warmed executables are the ones
+        dispatch looks up.  Returns ``[(label, compile_seconds), ...]``
+        (0.0 = already compiled)."""
         if not self.fused:
             return []
+        dtype = precision.canonical(dtype) if dtype else self.dtype
         X = jax.ShapeDtypeStruct((int(rows), int(n_features)), jnp.float32)
         det = self.chain["detector"]
         out: List[Tuple[str, float]] = []
@@ -296,7 +440,13 @@ class CompiledScorer:
         ):
             variants.append(("serve.score/anomaly", True))
         for label, with_anomaly in variants:
-            args, kw = _program_args(self.chain, X, with_anomaly, 0)
+            with_confidence = bool(
+                with_anomaly and _fused_enabled() and det
+                and det["feature_thresholds"] is not None
+            )
+            args, kw = _program_args(
+                self.chain, X, with_anomaly, 0, dtype, with_confidence
+            )
             out.append((label, _score_program.warm(*args, **kw)))
         return out
 
@@ -373,15 +523,21 @@ class CompiledScorer:
                 "total-anomaly-score": out["total-anomaly-score"],
             }
             if det["feature_thresholds"] is not None:
+                # thresholds are per-model constants: attaching them is
+                # response assembly, not per-row compute — the confidence
+                # SERIES rides out of the fused program already computed
                 result["tag-anomaly-thresholds"] = np.asarray(
                     det["feature_thresholds"]
                 )
                 result["total-anomaly-threshold"] = float(
                     det["aggregate_threshold"]
                 )
-                result["anomaly-confidence"] = result[
-                    "total-anomaly-score"
-                ] / max(float(det["aggregate_threshold"]), 1e-12)
+                if "anomaly-confidence" in out:
+                    result["anomaly-confidence"] = out["anomaly-confidence"]
+                else:  # GORDO_SERVE_FUSED=off: the r11 host-side epilogue
+                    result["anomaly-confidence"] = result[
+                        "total-anomaly-score"
+                    ] / max(float(det["aggregate_threshold"]), 1e-12)
             return result
         # fallback: the model's own pandas path
         frame = self.model.anomaly(X, y)
@@ -403,6 +559,6 @@ class CompiledScorer:
         return result
 
 
-def compile_scorer(model) -> CompiledScorer:
+def compile_scorer(model, dtype: Optional[str] = None) -> CompiledScorer:
     """Build (and warm up lazily) the serving scorer for ``model``."""
-    return CompiledScorer(model)
+    return CompiledScorer(model, dtype=dtype)

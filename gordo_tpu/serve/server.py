@@ -110,27 +110,38 @@ WARMUP_TASK_KEY: "web.AppKey[object]" = web.AppKey("warmup_task", object)
 
 class ModelEntry:
     """One served machine, loaded through the artifact plane — a v1
-    per-machine directory or a slot of a v2 pack, behind one surface."""
+    per-machine directory or a slot of a v2 pack, behind one surface.
 
-    def __init__(self, name: str, directory: str):
+    ``serve_dtype``: the collection's serving precision, threaded into
+    this entry's scorer (``None`` resolves ``GORDO_SERVE_DTYPE`` per
+    call — the bench/test compatibility path)."""
+
+    def __init__(
+        self, name: str, directory: str, serve_dtype: Optional[str] = None
+    ):
         # v1-dir compatibility constructor (tests/bench build entries
         # straight from a dumped artifact dir)
         self._init_from(
-            artifacts.ArtifactRef(name, "dir", directory, directory=directory)
+            artifacts.ArtifactRef(name, "dir", directory, directory=directory),
+            serve_dtype=serve_dtype,
         )
 
     @classmethod
-    def from_artifact(cls, ref: "artifacts.ArtifactRef") -> "ModelEntry":
+    def from_artifact(
+        cls, ref: "artifacts.ArtifactRef", serve_dtype: Optional[str] = None
+    ) -> "ModelEntry":
         entry = cls.__new__(cls)
-        entry._init_from(ref)
+        entry._init_from(ref, serve_dtype=serve_dtype)
         return entry
 
-    def _init_from(self, ref: "artifacts.ArtifactRef") -> None:
+    def _init_from(
+        self, ref: "artifacts.ArtifactRef", serve_dtype: Optional[str] = None
+    ) -> None:
         self.name = ref.name
         self.directory = ref.ref
         self.model = ref.load_model()
         self.metadata = ref.load_metadata()
-        self.scorer = CompiledScorer(self.model)
+        self.scorer = CompiledScorer(self.model, dtype=serve_dtype)
         self.mtime, self.size = ref.stat()
 
     @property
@@ -161,7 +172,10 @@ class ModelCollection:
         source_dir: Optional[str] = None,
         serve_mesh=None,
         pack_store=None,
+        serve_dtype: Optional[str] = None,
     ):
+        from gordo_tpu.serve import precision
+
         self.entries = entries
         self.project = project
         self.source_dir = source_dir
@@ -172,6 +186,12 @@ class ModelCollection:
         #: v1 directory layout): lets the fleet scorer ship each pack's
         #: stacked tensors to the device as ONE transfer
         self.pack_store = pack_store
+        #: the ONE serving precision for this collection (env >
+        #: build-manifest dtype > float32; resolved by from_directory) —
+        #: per-machine mixing would make responses depend on bucketing
+        self.serve_dtype = precision.canonical(serve_dtype) if (
+            serve_dtype
+        ) else precision.serve_dtype()
         self._fleet_scorer = None
         # guards the (entries, _fleet_scorer) pair: the background rescan
         # swaps both from an executor thread while bulk requests lazily
@@ -189,6 +209,7 @@ class ModelCollection:
                     {name: e.model for name, e in self.entries.items()},
                     mesh=self.serve_mesh,
                     pack_store=self.pack_store,
+                    dtype=self.serve_dtype,
                 )
             return self._fleet_scorer
 
@@ -202,18 +223,34 @@ class ModelCollection:
         Pack failures raise (:class:`gordo_tpu.artifacts.PackCorruptError`
         — a truncated pack must kill startup loudly, not silently shrink
         the fleet); a single broken v1 dir only loses that machine, as
-        before."""
+        before.
+
+        The serving dtype resolves here: ``GORDO_SERVE_DTYPE`` when set,
+        else the build's warmup-manifest dtype (the precision decision
+        travels with the artifacts), else float32."""
+        from gordo_tpu.compile import load_warmup_manifest
+        from gordo_tpu.serve import precision
+
         store, refs = artifacts.discover(path)
-        entries: Dict[str, ModelEntry] = {}
         source_dir: Optional[str] = (
             None if artifacts.is_artifact_dir(path) else path
         )
+        manifest_dtype = None
+        if source_dir is not None:
+            manifest = load_warmup_manifest(source_dir)
+            manifest_dtype = (manifest or {}).get("dtype")
+        serve_dtype = precision.serve_dtype(default=manifest_dtype)
+        entries: Dict[str, ModelEntry] = {}
         for ref in refs:
             if ref.kind == "pack":
-                entries[ref.name] = ModelEntry.from_artifact(ref)
+                entries[ref.name] = ModelEntry.from_artifact(
+                    ref, serve_dtype=serve_dtype
+                )
                 continue
             try:
-                entries[ref.name] = ModelEntry.from_artifact(ref)
+                entries[ref.name] = ModelEntry.from_artifact(
+                    ref, serve_dtype=serve_dtype
+                )
             except Exception:
                 logger.exception("Failed to load artifact %s", ref.ref)
         if not entries:
@@ -224,6 +261,7 @@ class ModelCollection:
             source_dir=source_dir,
             serve_mesh=serve_mesh,
             pack_store=store,
+            serve_dtype=serve_dtype,
         )
 
     def get(self, name: str) -> Optional[ModelEntry]:
@@ -270,7 +308,9 @@ class ModelCollection:
             force = ref.kind == "pack" and store is not self.pack_store
             try:
                 if current is None:
-                    new_entries[ref.name] = ModelEntry.from_artifact(ref)
+                    new_entries[ref.name] = ModelEntry.from_artifact(
+                        ref, serve_dtype=self.serve_dtype
+                    )
                     added.append(ref.name)
                 elif force or ref.stat() != (current.mtime, current.size):
                     # (mtime, size) inequality, not mtime>: a rebuild can
@@ -278,7 +318,9 @@ class ModelCollection:
                     # skew) and must still reload.  Known blind spot: an
                     # mtime-preserving copy (cp -p) of a same-size artifact
                     # is indistinguishable without hashing content.
-                    new_entries[ref.name] = ModelEntry.from_artifact(ref)
+                    new_entries[ref.name] = ModelEntry.from_artifact(
+                        ref, serve_dtype=self.serve_dtype
+                    )
                     reloaded.append(ref.name)
                 else:
                     new_entries[ref.name] = current
@@ -335,11 +377,18 @@ _OFFLOAD_BYTES = 64 * 1024
 
 
 def _decode_payload(raw: bytes, is_msgpack: bool) -> Any:
-    """Bytes → payload dict; ValueError on malformed input (→ 400).
-    Pure function so handlers can run it on or off the event loop."""
+    """Bytes → payload dict; ValueError on malformed input (→ 400), 415
+    for a body carrying an array dtype the wire doesn't speak (a media
+    problem, not a malformed payload).  Pure function so handlers can run
+    it on or off the event loop."""
     if is_msgpack:
         try:
             return codec.unpackb(raw)
+        except codec.UnsupportedWireDtype as exc:
+            raise web.HTTPUnsupportedMediaType(
+                text=json.dumps({"error": str(exc)}),
+                content_type="application/json",
+            )
         except Exception as exc:
             raise ValueError(f"Invalid msgpack body: {exc}")
     # json.JSONDecodeError is a ValueError — same 400 surface as before
@@ -394,9 +443,20 @@ async def _respond(
     the bundled client uses it for bulk), JSON otherwise with ndarray
     leaves encoded by the native fastjson kernel (~13x stdlib json, which
     was the measured HTTP serving ceiling — see ``serve/codec.py``).
+    An ``Accept`` ``dtype=`` media parameter selects the wire float
+    precision (``application/x-msgpack;dtype=bfloat16`` halves bulk
+    response bytes); an unknown dtype name is a 415, not a 500.
     Encoding runs in the executor: a large bulk body takes ~100ms even
     natively, which must not stall the accept loop."""
-    encode, content_type = codec.negotiate(request.headers.get("Accept", ""))
+    try:
+        encode, content_type = codec.negotiate(
+            request.headers.get("Accept", "")
+        )
+    except codec.UnsupportedWireDtype as exc:
+        raise web.HTTPUnsupportedMediaType(
+            text=json.dumps({"error": str(exc)}),
+            content_type="application/json",
+        )
     body = await asyncio.get_running_loop().run_in_executor(
         None, encode, obj
     )
@@ -744,6 +804,10 @@ async def project_index(request: web.Request) -> web.Response:
         # client/watchman artifact discovery: which format backs this
         # collection, and how many packs when v2
         "artifact-format": "v2-packs" if store is not None else "v1-dirs",
+        # the serving precision this collection dispatches at (the
+        # serving-precision plane; clients reading bulk responses at
+        # reduced wire dtypes can confirm what the compute side ran)
+        "serving-dtype": collection.serve_dtype,
     }
     if store is not None:
         doc["artifact-packs"] = len(store.packs)

@@ -225,6 +225,7 @@ def _multihost_builder_docs(
     image: str,
     tpu_resources: Dict[str, Any],
     num_processes: int,
+    serve_dtype: Optional[str] = None,
 ) -> List[Dict]:
     """Indexed builder Job (one pod per process) + the headless Service
     that gives process 0 a stable coordinator DNS name.
@@ -241,7 +242,7 @@ def _multihost_builder_docs(
     remainder."""
     job_name = f"gordo-builder-{project}"
     svc_name = f"gordo-builder-{project}"
-    job = _builder_job(project, image, tpu_resources)
+    job = _builder_job(project, image, tpu_resources, serve_dtype=serve_dtype)
     spec = job["spec"]
     spec["completions"] = num_processes
     spec["parallelism"] = num_processes
@@ -299,7 +300,25 @@ def _compile_cache_env() -> Dict[str, str]:
     return {"name": "GORDO_COMPILE_CACHE_DIR", "value": COMPILE_CACHE_MOUNT}
 
 
-def _builder_job(project: str, image: str, tpu_resources: Dict[str, Any]) -> Dict:
+def _serve_dtype_env(serve_dtype: Optional[str]) -> List[Dict[str, str]]:
+    """``GORDO_SERVE_DTYPE`` env entries for a pod template.  Stamped on
+    BOTH the builder (so the warmup manifest records the precision and
+    warmup compiles for it) and the server (so dispatch matches) — the
+    serving-precision plane's one-config contract.  Validated here so a
+    typo fails manifest GENERATION, not a pod at 3am."""
+    if serve_dtype is None:
+        return []
+    from gordo_tpu.serve.precision import canonical
+
+    return [{"name": "GORDO_SERVE_DTYPE", "value": canonical(serve_dtype)}]
+
+
+def _builder_job(
+    project: str,
+    image: str,
+    tpu_resources: Dict[str, Any],
+    serve_dtype: Optional[str] = None,
+) -> Dict:
     return {
         "apiVersion": "batch/v1",
         "kind": "Job",
@@ -337,6 +356,7 @@ def _builder_job(project: str, image: str, tpu_resources: Dict[str, Any]) -> Dic
                                 # --multihost Indexed Job, which extends
                                 # this template) reuses peers' compiles
                                 _compile_cache_env(),
+                                *_serve_dtype_env(serve_dtype),
                             ],
                             "resources": tpu_resources,
                             "volumeMounts": [
@@ -372,6 +392,7 @@ def _server_deployment(
     replicas: int,
     server_args: Optional[List[str]] = None,
     scrape_annotations: bool = True,
+    serve_dtype: Optional[str] = None,
 ) -> Dict:
     template_meta: Dict[str, Any] = {
         "labels": _labels(project, "ml-server"),
@@ -413,7 +434,10 @@ def _server_deployment(
                             # the shared compile cache — a rescheduled pod
                             # goes ready in cache-load time, not compile
                             # time
-                            "env": [_compile_cache_env()],
+                            "env": [
+                                _compile_cache_env(),
+                                *_serve_dtype_env(serve_dtype),
+                            ],
                             "ports": [{"containerPort": DEFAULT_SERVER_PORT}],
                             "readinessProbe": {
                                 # /ready returns 503 until the startup
@@ -541,6 +565,7 @@ def generate_workflow(
     server_args: Optional[List[str]] = None,
     multihost: Optional[int] = None,
     scrape_annotations: bool = True,
+    serve_dtype: Optional[str] = None,
 ) -> List[Dict[str, Any]]:
     """Project config → list of k8s manifest dicts (+ the build plan as a
     ConfigMap so the cluster state carries the bucketing decision).
@@ -559,6 +584,13 @@ def generate_workflow(
     discovery annotations on the server and watchman pod templates so a
     conventionally-configured Prometheus scrapes their ``/metrics``
     without extra config; disable for clusters using ServiceMonitors.
+
+    ``serve_dtype`` (e.g. ``"bfloat16"``): stamp ``GORDO_SERVE_DTYPE`` on
+    the builder AND server pod templates — the build's warmup manifest
+    then records the precision, warmup compiles for it, and dispatch
+    matches (the serving-precision plane's one-config contract).  Only
+    set this after the fp32 parity suite passes for the project's model
+    family (docs/perf.md "Serving precision").
     """
     project = config.project_name
     machines = [m.name for m in config.machines]
@@ -582,15 +614,21 @@ def generate_workflow(
     }
     if multihost is not None and multihost > 1:
         builder_docs = _multihost_builder_docs(
-            project, image, tpu_resources, multihost
+            project, image, tpu_resources, multihost,
+            serve_dtype=serve_dtype,
         )
     else:
-        builder_docs = [_builder_job(project, image, tpu_resources)]
+        builder_docs = [
+            _builder_job(
+                project, image, tpu_resources, serve_dtype=serve_dtype
+            )
+        ]
     docs: List[Dict[str, Any]] = [
         *builder_docs,
         _server_deployment(
             project, image, server_replicas, server_args,
             scrape_annotations=scrape_annotations,
+            serve_dtype=serve_dtype,
         ),
         _service(project, "ml-server", DEFAULT_SERVER_PORT),
         _watchman_deployment(
@@ -628,6 +666,7 @@ def generate_argo_workflow(
     image: str = DEFAULT_IMAGE,
     max_bucket_size: int = 512,
     tpu_resources: Optional[Dict[str, Any]] = None,
+    serve_dtype: Optional[str] = None,
 ) -> Dict[str, Any]:
     """Project config → one ``argoproj.io/v1alpha1 Workflow`` document.
 
@@ -695,6 +734,7 @@ def generate_argo_workflow(
                             # writes its chunk's pack + an index merge
                             # (flock-serialized), not per-machine dirs
                             {"name": "GORDO_ARTIFACT_FORMAT", "value": "v2"},
+                            *_serve_dtype_env(serve_dtype),
                         ],
                         "resources": tpu_resources,
                         "volumeMounts": [

@@ -48,6 +48,28 @@ D2H_BLOCKING_NAMES = {"to_host"}
 #: modules whose ``.asarray(...)`` materializes a jax array on host
 D2H_ASARRAY_MODULES = {"np", "numpy"}
 
+#: request-path host-math gate (serve/): between decode and dispatch a
+#: request's data must not be computed on with host numpy — padding,
+#: scaling, windowing, thresholds and confidence all live INSIDE the
+#: fused device programs now, and host np compute creeping back in is
+#: exactly the regression this PR removed (r11: concatenate/tile padding
+#: and a host confidence divide per request).  Scoped to the dispatch/
+#: epilogue functions; ``np.asarray`` wraps, buffer fills, and the
+#: explicitly-named legacy kill-switch helpers are the decode side and
+#: stay allowed.  ``# noqa`` opts a line out, as elsewhere.
+HOST_MATH_FORBIDDEN_SCOPES = {
+    "scorer.py": {"_run", "predict", "anomaly_arrays"},
+    "fleet_scorer.py": {"score", "score_subset", "assemble"},
+}
+HOST_MATH_MODULES = {"np", "numpy"}
+HOST_MATH_CALLS = {
+    "concatenate", "tile", "stack", "vstack", "hstack", "repeat", "pad",
+    "maximum", "minimum", "clip", "where", "abs", "divide", "multiply",
+    "add", "subtract", "median", "percentile", "mean", "sum", "matmul",
+    "dot", "einsum",
+}
+SERVE_DIR = os.path.join("gordo_tpu", "serve")
+
 #: the ONE module family allowed to touch jax.jit directly: the compile
 #: plane (gordo_tpu/compile/) owns every jitted program in the stack —
 #: register through compile.program (AOT serving path) or compile.jit
@@ -279,6 +301,47 @@ def _d2h_findings(path: str, tree: ast.AST, noqa_lines: set) -> List[Finding]:
     return findings
 
 
+def _host_math_findings(
+    path: str, tree: ast.AST, noqa_lines: set
+) -> List[Finding]:
+    """Flag host numpy COMPUTE calls (``np.concatenate``/``np.tile``/
+    arithmetic reductions — see ``HOST_MATH_CALLS``) inside the serve
+    plane's request-path scopes (``HOST_MATH_FORBIDDEN_SCOPES``): that
+    work belongs inside the fused device program, where it is one
+    dispatch instead of a per-request host bill."""
+    norm = os.path.normpath(path)
+    if SERVE_DIR not in norm:
+        return []
+    scopes = HOST_MATH_FORBIDDEN_SCOPES.get(os.path.basename(norm))
+    if not scopes:
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name not in scopes:
+            continue
+        for call in ast.walk(node):
+            if not isinstance(call, ast.Call):
+                continue
+            func = call.func
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr in HOST_MATH_CALLS
+                and isinstance(func.value, ast.Name)
+                and func.value.id in HOST_MATH_MODULES
+                and call.lineno not in noqa_lines
+            ):
+                findings.append(
+                    (path, call.lineno,
+                     f"host numpy compute {func.value.id}.{func.attr}() "
+                     f"inside {node.name}() — the serve request path is "
+                     "decode -> one device dispatch -> encode; fuse this "
+                     "into the compiled program (serve/scorer.py)")
+                )
+    return findings
+
+
 def lint_file(path: str) -> List[Finding]:
     findings: List[Finding] = []
     with open(path, encoding="utf-8") as f:
@@ -321,6 +384,7 @@ def lint_file(path: str) -> List[Finding]:
                 findings.append((path, lineno, f"unused import: {name}"))
 
     findings.extend(_d2h_findings(path, tree, noqa_lines))
+    findings.extend(_host_math_findings(path, tree, noqa_lines))
     findings.extend(_jit_findings(path, tree, noqa_lines))
     findings.extend(_artifact_path_findings(path, tree, noqa_lines))
     findings.extend(_artifacts_pack_findings(path, tree, noqa_lines))

@@ -99,6 +99,86 @@ def test_non_contiguous_and_int_arrays():
     assert json.loads(codec.dumps_bytes(ints)) == [0, 1, 2, 3, 4]
 
 
+def test_msgpack_bf16_f16_roundtrip():
+    """Reduced-precision wire support (ISSUE 7 satellite): bf16 rides the
+    wire under an explicit name (its numpy ``dtype.str`` is an ambiguous
+    ``<V2``), f16 under its standard spelling; both decode back to the
+    exact same bits."""
+    import ml_dtypes
+
+    rng = np.random.default_rng(3)
+    a32 = rng.standard_normal((50, 4)).astype(np.float32)
+    for dt in (np.dtype(ml_dtypes.bfloat16), np.dtype(np.float16)):
+        a = a32.astype(dt)
+        dec = codec.unpackb(codec.packb({"x": a}))["x"]
+        assert dec.dtype == dt
+        assert np.array_equal(
+            dec.astype(np.float32), a.astype(np.float32)
+        )
+
+
+def test_json_encodes_half_precision_arrays():
+    """bf16/f16 arrays JSON-encode through the native f32 kernel (the
+    widening is exact) instead of erroring or crawling through tolist."""
+    import ml_dtypes
+
+    a = np.array([0.5, -1.25, 3.0], np.float32)
+    for dt in (ml_dtypes.bfloat16, np.float16):
+        dec = json.loads(codec.dumps_bytes(a.astype(dt)))
+        assert dec == [0.5, -1.25, 3.0]
+
+
+def test_unknown_wire_dtype_rejected_on_decode():
+    """An ``__nd__`` header naming a dtype outside the wire set raises
+    UnsupportedWireDtype (the server's 415) instead of letting numpy
+    throw a 500-shaped TypeError."""
+    body = codec.packb({"x": np.zeros(3, np.complex128)})
+    with pytest.raises(codec.UnsupportedWireDtype):
+        codec.unpackb(body)
+    # a corrupt/alien dtype string likewise
+    import msgpack
+
+    evil = msgpack.packb(
+        {"__nd__": True, "dtype": "not-a-dtype", "shape": [1],
+         "data": b"\x00\x00\x00\x00"},
+        use_bin_type=True,
+    )
+    with pytest.raises(codec.UnsupportedWireDtype):
+        codec.unpackb(evil)
+
+
+def test_negotiate_wire_dtype_param():
+    """``Accept: application/x-msgpack;dtype=bfloat16`` casts float array
+    leaves to the asked-for wire precision; unknown names raise (→ 415);
+    non-float leaves are untouched."""
+    import ml_dtypes
+
+    obj = {
+        "data": {
+            "model-output": np.arange(6, dtype=np.float32).reshape(2, 3),
+            "n": np.arange(3),
+        }
+    }
+    enc, ct = codec.negotiate("application/x-msgpack;dtype=bfloat16")
+    assert ct == codec.MSGPACK_CONTENT_TYPE
+    dec = codec.unpackb(enc(obj))
+    assert dec["data"]["model-output"].dtype == np.dtype(ml_dtypes.bfloat16)
+    assert dec["data"]["n"].dtype == np.int64  # ints don't quantize
+    # plain negotiate stays f32 — the default wire is full precision
+    enc2, _ = codec.negotiate(codec.MSGPACK_CONTENT_TYPE)
+    assert codec.unpackb(enc2(obj))["data"]["model-output"].dtype == (
+        np.float32
+    )
+    with pytest.raises(codec.UnsupportedWireDtype):
+        codec.negotiate("application/x-msgpack;dtype=int4")
+    # the dtype param composes with JSON too (values round to the wire
+    # precision, text stays dtype-less JSON)
+    enc3, ct3 = codec.negotiate("application/json;dtype=bfloat16")
+    assert ct3 == "application/json"
+    out = json.loads(enc3({"x": np.array([1.0 / 3.0], np.float32)}))
+    assert abs(out["x"][0] - 1.0 / 3.0) < 2e-3  # bf16-rounded
+
+
 def test_msgpack_roundtrip():
     rng = np.random.default_rng(2)
     obj = {

@@ -223,6 +223,101 @@ def test_manifest_merge_keeps_disjoint_entries(tmp_path):
     assert load_warmup_manifest(out)["programs"] == manifest["programs"]
 
 
+def test_manifest_carries_serving_dtype(tmp_path, monkeypatch):
+    """v2 manifests record the build-time serving dtype: the env knob at
+    write time wins, an explicit argument overrides it, and a v1
+    manifest (no dtype field) reads back as float32."""
+    out = str(tmp_path)
+    entry = [{"signature": "sig", "machines": ["m1"], "n_machines": 1,
+              "n_features": 2, "n_outputs": 2, "lookback": 1}]
+    monkeypatch.setenv("GORDO_SERVE_DTYPE", "bf16")
+    write_warmup_manifest(out, entry)
+    manifest = load_warmup_manifest(out)
+    assert manifest["dtype"] == "bfloat16"
+    monkeypatch.delenv("GORDO_SERVE_DTYPE")
+    # explicit argument beats the (now unset) env
+    write_warmup_manifest(out, entry, serve_dtype="float32")
+    assert load_warmup_manifest(out)["dtype"] == "float32"
+    # a v1 manifest (pre-dtype) reads as float32
+    import os as _os
+
+    shard = _os.path.join(out, ".gordo-warmup",
+                          "shard-000-of-001.json")
+    doc = json.load(open(shard))
+    doc.pop("dtype")
+    doc["version"] = 1
+    json.dump(doc, open(shard, "w"))
+    assert load_warmup_manifest(out)["dtype"] == "float32"
+
+
+def test_manifest_mixed_shard_dtypes_yield_none(tmp_path):
+    """Shards disagreeing on dtype (a half-finished precision migration)
+    must not let warmup guess — the manifest dtype reads as None and the
+    serve plane falls back to its env resolution."""
+    out = str(tmp_path)
+    write_warmup_manifest(
+        out, [{"signature": "a", "machines": ["m1"], "n_machines": 1,
+               "n_features": 2, "n_outputs": 2, "lookback": 1}],
+        shard=(0, 2), serve_dtype="float32",
+    )
+    write_warmup_manifest(
+        out, [{"signature": "b", "machines": ["m2"], "n_machines": 1,
+               "n_features": 2, "n_outputs": 2, "lookback": 1}],
+        shard=(1, 2), serve_dtype="bfloat16",
+    )
+    assert load_warmup_manifest(out)["dtype"] is None
+
+
+def test_bf16_manifest_warms_bf16_executables(model_dir, tmp_path, monkeypatch):
+    """The dtype round-trip pin (ISSUE 7 satellite): a manifest written
+    under bf16 must warm bf16 executables, not fp32 ones — and the
+    collection built over it must DISPATCH bf16, so the warmed
+    executables are the ones requests hit."""
+    import shutil
+
+    from gordo_tpu.compile.registry import REGISTRY
+
+    # private copy: rewriting the shared module fixture's manifest would
+    # leak bf16 into every other test using model_dir
+    work = str(tmp_path / "bf16-artifacts")
+    shutil.copytree(model_dir, work)
+    manifest = load_warmup_manifest(work)
+    monkeypatch.setenv("GORDO_SERVE_DTYPE", "bfloat16")
+    write_warmup_manifest(
+        work,
+        [e for e in manifest["programs"]],
+    )
+    monkeypatch.delenv("GORDO_SERVE_DTYPE")
+    assert load_warmup_manifest(work)["dtype"] == "bfloat16"
+
+    # env UNSET: the manifest's dtype must drive both warmup and dispatch
+    REGISTRY.clear()
+    collection = ModelCollection.from_directory(work, project="cpproj")
+    assert collection.serve_dtype == "bfloat16"
+    stats = warmup_collection(collection)
+    assert stats["errors"] == 0
+    assert stats["dtype"] == "bfloat16"
+    serve_keys = [
+        key for key in REGISTRY._executables
+        if str(key[0]).startswith("serve.")
+    ]
+    assert serve_keys, "warmup compiled no serving executables"
+    for key in serve_keys:
+        statics = dict(key[1])
+        assert statics.get("dtype") == "bfloat16", key
+    # and a real request hits a warmed executable, not a fresh compile
+    reg = telemetry.REGISTRY.snapshot()
+    before_miss = _counter(reg, "gordo_compile_cache_misses_total",
+                           "programs")
+    X = np.random.default_rng(3).standard_normal((256, 3)).astype(np.float32)
+    collection.get("cp-machine-0").scorer.anomaly_arrays(X)
+    after_miss = _counter(
+        telemetry.REGISTRY.snapshot(),
+        "gordo_compile_cache_misses_total", "programs",
+    )
+    assert after_miss == before_miss  # warmed, not compiled on request
+
+
 def test_warmup_collection_precompiles_from_manifest(model_dir):
     collection = ModelCollection.from_directory(model_dir, project="cpproj")
     stats = warmup_collection(collection)

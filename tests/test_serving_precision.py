@@ -1,0 +1,539 @@
+"""Serving-precision plane + device-resident end-to-end scoring (ISSUE 7).
+
+The acceptance-critical pins:
+
+- the fused request path is decode → ONE device dispatch → encode, and
+  the telemetry counters attest it per request;
+- the fused epilogue (confidence on device) is BITWISE identical to the
+  r11 host-side epilogue at fp32 (``GORDO_SERVE_FUSED=off``);
+- ``GORDO_SERVE_DTYPE=bfloat16`` serving passes the fp32 parity gate
+  with per-machine error bounds, across the per-machine, full-bucket,
+  and subset-gather program variants (the full sweep incl. LSTM +
+  smoothing lives in the slow lane);
+- int8 is refused without the explicit opt-in;
+- unknown wire dtypes are a 415 at the HTTP surface, both directions;
+- the generated manifests stamp ``GORDO_SERVE_DTYPE`` on builder AND
+  server pods;
+- the request-path host-math lint gate rejects ``np.*`` compute in the
+  serve dispatch scopes.
+"""
+
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+from gordo_tpu import telemetry
+from gordo_tpu.builder import build_project
+from gordo_tpu.serve import precision
+from gordo_tpu.serve.server import ModelCollection
+from gordo_tpu.workflow import NormalizedConfig
+
+_FF_MODEL = {
+    "gordo_tpu.anomaly.diff.DiffBasedAnomalyDetector": {
+        "base_estimator": {
+            "gordo_tpu.pipeline.Pipeline": {
+                "steps": [
+                    "gordo_tpu.ops.scalers.MinMaxScaler",
+                    {
+                        "gordo_tpu.models.estimator.AutoEncoder": {
+                            "kind": "feedforward_hourglass",
+                            "epochs": 2,
+                            "batch_size": 64,
+                        }
+                    },
+                ]
+            }
+        }
+    }
+}
+
+_SMOOTH_MODEL = {
+    "gordo_tpu.anomaly.diff.DiffBasedAnomalyDetector": {
+        "window": 4,  # exercises the fused rolling-median under bf16
+        "base_estimator": _FF_MODEL[
+            "gordo_tpu.anomaly.diff.DiffBasedAnomalyDetector"
+        ]["base_estimator"],
+    }
+}
+
+PROJECT = {
+    "machines": [
+        {
+            "name": "prec-m-0",
+            "dataset": {
+                "type": "RandomDataset",
+                "tags": ["t1", "t2", "t3"],
+                "train_start_date": "2017-12-25T06:00:00Z",
+                "train_end_date": "2017-12-27T06:00:00Z",
+            },
+        },
+        {
+            "name": "prec-m-1",
+            "dataset": {
+                "type": "RandomDataset",
+                "tags": ["t1", "t2", "t3"],
+                "train_start_date": "2017-12-25T06:00:00Z",
+                "train_end_date": "2017-12-27T06:00:00Z",
+            },
+        },
+        {
+            "name": "prec-m-smooth",
+            "dataset": {
+                "type": "RandomDataset",
+                "tags": ["t1", "t2", "t3"],
+                "train_start_date": "2017-12-25T06:00:00Z",
+                "train_end_date": "2017-12-27T06:00:00Z",
+            },
+            "model": _SMOOTH_MODEL,
+        },
+    ],
+    "globals": {"model": _FF_MODEL},
+}
+
+#: the per-machine fp32-vs-reduced parity bounds (max abs error as a
+#: fraction of the machine's max |fp32| value — the methodology of
+#: docs/perf.md "Serving precision").  Measured bf16 errors on the bench
+#: model family sit under 1%; the bounds leave headroom for LSTM
+#: accumulation without ever letting a broken cast (100% error) pass.
+PARITY_BOUNDS = {
+    "model-output": 0.03,
+    "tag-anomaly-scores": 0.10,
+    "total-anomaly-score": 0.10,
+    "anomaly-confidence": 0.10,
+}
+
+
+def assert_parity(ref, reduced, bounds=PARITY_BOUNDS, label=""):
+    for key, tol in bounds.items():
+        if key not in ref:
+            continue
+        r = np.asarray(ref[key], np.float32)
+        q = np.asarray(reduced[key], np.float32)
+        assert r.shape == q.shape, (label, key)
+        scale = max(float(np.max(np.abs(r))), 1e-6)
+        err = float(np.max(np.abs(r - q))) / scale
+        assert err <= tol, (
+            f"{label}{key}: max-normalized error {err:.4%} > {tol:.2%}"
+        )
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    out = tmp_path_factory.mktemp("prec-artifacts")
+    cfg = NormalizedConfig(PROJECT, "precproj")
+    result = build_project(cfg.machines, str(out))
+    assert not result.failed
+    return str(out)
+
+
+def _counter_total(name: str) -> float:
+    metric = telemetry.REGISTRY.snapshot()["metrics"].get(name) or {}
+    return float(sum(metric.get("series", {}).values()))
+
+
+def _X(rows=300, cols=3, seed=11):
+    return np.random.default_rng(seed).standard_normal(
+        (rows, cols)
+    ).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# dtype resolution policy
+# ---------------------------------------------------------------------------
+
+def test_serve_dtype_resolution(monkeypatch):
+    monkeypatch.delenv("GORDO_SERVE_DTYPE", raising=False)
+    assert precision.serve_dtype() == "float32"
+    assert precision.serve_dtype(default="bf16") == "bfloat16"
+    monkeypatch.setenv("GORDO_SERVE_DTYPE", "fp32")
+    # env beats the manifest default
+    assert precision.serve_dtype(default="bfloat16") == "float32"
+    monkeypatch.setenv("GORDO_SERVE_DTYPE", "bf16")
+    assert precision.serve_dtype() == "bfloat16"
+    monkeypatch.setenv("GORDO_SERVE_DTYPE", "float8")
+    with pytest.raises(ValueError, match="unknown serving dtype"):
+        precision.serve_dtype()
+
+
+def test_int8_requires_explicit_opt_in(monkeypatch):
+    monkeypatch.setenv("GORDO_SERVE_DTYPE", "int8")
+    monkeypatch.delenv("GORDO_SERVE_INT8", raising=False)
+    with pytest.raises(ValueError, match="opt-in"):
+        precision.serve_dtype()
+    monkeypatch.setenv("GORDO_SERVE_INT8", "1")
+    assert precision.serve_dtype() == "int8"
+
+
+# ---------------------------------------------------------------------------
+# the fused single-dispatch path
+# ---------------------------------------------------------------------------
+
+def test_single_dispatch_and_transfer_per_request(model_dir):
+    collection = ModelCollection.from_directory(model_dir, project="precproj")
+    scorer = collection.get("prec-m-0").scorer
+    X = _X()
+    scorer.anomaly_arrays(X)  # compile outside the counted window
+    d0 = _counter_total("gordo_serve_dispatches_total")
+    t0 = _counter_total("gordo_serve_input_transfers_total")
+    n = 5
+    for _ in range(n):
+        scorer.anomaly_arrays(X)
+    assert _counter_total("gordo_serve_dispatches_total") - d0 == n
+    assert _counter_total("gordo_serve_input_transfers_total") - t0 == n
+
+
+def test_fused_equals_host_epilogue_fp32(model_dir, monkeypatch):
+    """The r11 host-side epilogue (GORDO_SERVE_FUSED=off: concatenate/
+    tile padding + host confidence divide) and the fused program must
+    agree BITWISE at fp32 — same machines, same request."""
+    collection = ModelCollection.from_directory(model_dir, project="precproj")
+    X = _X()
+    for name in ("prec-m-0", "prec-m-smooth"):
+        scorer = collection.get(name).scorer
+        fused = scorer.anomaly_arrays(X)
+        monkeypatch.setenv("GORDO_SERVE_FUSED", "off")
+        host = scorer.anomaly_arrays(X)
+        monkeypatch.delenv("GORDO_SERVE_FUSED")
+        assert set(fused) == set(host)
+        for key in fused:
+            np.testing.assert_array_equal(
+                np.asarray(fused[key]), np.asarray(host[key]),
+                err_msg=f"{name}/{key}",
+            )
+
+
+def test_concurrent_same_bucket_requests_do_not_corrupt(model_dir):
+    """The pinned-pad-buffer aliasing regression: on the CPU backend a
+    zero-copy ``jnp.asarray`` of the shared pad buffer would let request
+    B's fill rewrite request A's live device array after the lock drops.
+    Concurrent same-machine, same-bucket requests must score exactly
+    what they score serially."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    collection = ModelCollection.from_directory(model_dir, project="precproj")
+    scorer = collection.get("prec-m-0").scorer
+    payloads = [_X(rows=50 + i, seed=100 + i) for i in range(8)]
+    expected = [
+        np.asarray(scorer.anomaly_arrays(X)["total-anomaly-score"])
+        for X in payloads
+    ]
+    with ThreadPoolExecutor(max_workers=8) as pool:
+        for _ in range(5):
+            results = list(
+                pool.map(lambda X: scorer.anomaly_arrays(X), payloads)
+            )
+            for want, got in zip(expected, results):
+                np.testing.assert_array_equal(
+                    want, np.asarray(got["total-anomaly-score"])
+                )
+
+
+def test_pad_buffer_reused_across_same_shape_requests(model_dir):
+    collection = ModelCollection.from_directory(model_dir, project="precproj")
+    scorer = collection.get("prec-m-0").scorer
+    scorer.anomaly_arrays(_X(rows=300))  # 300 pads up to the 512 bucket
+    assert (512, 3) in scorer._pad_bufs
+    buf = scorer._pad_bufs[(512, 3)]
+    scorer.anomaly_arrays(_X(rows=280, seed=12))  # same bucket, same buffer
+    assert scorer._pad_bufs[(512, 3)] is buf
+    assert len(scorer._pad_bufs) <= scorer.MAX_PAD_BUFS
+
+
+# ---------------------------------------------------------------------------
+# reduced-precision parity (fast slice; the full sweep is slow-lane)
+# ---------------------------------------------------------------------------
+
+def test_bf16_parity_per_machine_and_bucket(model_dir, monkeypatch):
+    """fp32 vs bf16 within the per-machine bounds, across the
+    per-machine scorer AND the stacked bucket paths (full-bucket and
+    1-machine subset gather) — including the smoothing machine."""
+    X = _X(rows=400)
+    ref_coll = ModelCollection.from_directory(model_dir, project="precproj")
+    ref_fleet = ref_coll.fleet_scorer.score_all(
+        {name: X for name in ref_coll.entries}
+    )
+    ref_sub = ref_coll.fleet_scorer.score_all({"prec-m-0": X})
+
+    monkeypatch.setenv("GORDO_SERVE_DTYPE", "bfloat16")
+    bf_coll = ModelCollection.from_directory(model_dir, project="precproj")
+    assert bf_coll.serve_dtype == "bfloat16"
+    bf_fleet = bf_coll.fleet_scorer.score_all(
+        {name: X for name in bf_coll.entries}
+    )
+    bf_sub = bf_coll.fleet_scorer.score_all({"prec-m-0": X})
+    for name in ref_coll.entries:
+        ref_pm = ref_coll.get(name).scorer.anomaly_arrays(X)
+        bf_pm = bf_coll.get(name).scorer.anomaly_arrays(X)
+        assert_parity(ref_pm, bf_pm, label=f"per-machine {name}: ")
+        assert_parity(
+            ref_fleet[name], bf_fleet[name], label=f"bucket {name}: "
+        )
+    assert_parity(
+        ref_sub["prec-m-0"], bf_sub["prec-m-0"], label="subset: "
+    )
+    # outputs stay f32 on the wire regardless of compute dtype
+    assert np.asarray(
+        bf_fleet["prec-m-0"]["total-anomaly-score"]
+    ).dtype == np.float32
+
+
+def test_int8_parity_behind_opt_in(model_dir, monkeypatch):
+    X = _X(rows=300)
+    ref = ModelCollection.from_directory(
+        model_dir, project="precproj"
+    ).get("prec-m-0").scorer.anomaly_arrays(X)
+    monkeypatch.setenv("GORDO_SERVE_DTYPE", "int8")
+    monkeypatch.setenv("GORDO_SERVE_INT8", "1")
+    i8 = ModelCollection.from_directory(
+        model_dir, project="precproj"
+    ).get("prec-m-0").scorer.anomaly_arrays(X)
+    # int8 fake-quant is coarser than bf16; bound it looser but finite
+    assert_parity(
+        ref, i8,
+        bounds={k: 0.25 for k in PARITY_BOUNDS},
+        label="int8: ",
+    )
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface: wire dtypes and 415s
+# ---------------------------------------------------------------------------
+
+def test_http_wire_dtype_and_415(model_dir):
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from gordo_tpu.serve import codec
+    from gordo_tpu.serve.server import build_app
+
+    collection = ModelCollection.from_directory(model_dir, project="precproj")
+    X = _X(rows=300)
+
+    async def runner():
+        client = TestClient(TestServer(build_app(collection)))
+        await client.start_server()
+        try:
+            # bf16 on the wire, asked for via the Accept dtype param
+            resp = await client.post(
+                "/gordo/v0/precproj/_bulk/anomaly/prediction",
+                data=codec.packb({"X": {"prec-m-0": X}}),
+                headers={
+                    "Content-Type": codec.MSGPACK_CONTENT_TYPE,
+                    "Accept": codec.MSGPACK_CONTENT_TYPE + ";dtype=bfloat16",
+                },
+            )
+            assert resp.status == 200
+            doc = codec.unpackb(await resp.read())
+            out = doc["data"]["prec-m-0"]["model-output"]
+            assert out.dtype.name == "bfloat16"
+            # unknown Accept dtype → 415, not 500
+            resp = await client.post(
+                "/gordo/v0/precproj/prec-m-0/anomaly/prediction",
+                data=codec.packb({"X": X}),
+                headers={
+                    "Content-Type": codec.MSGPACK_CONTENT_TYPE,
+                    "Accept": codec.MSGPACK_CONTENT_TYPE + ";dtype=int4",
+                },
+            )
+            assert resp.status == 415
+            # request body carrying an alien array dtype → 415 too
+            resp = await client.post(
+                "/gordo/v0/precproj/prec-m-0/anomaly/prediction",
+                data=codec.packb({"X": X.astype(np.complex128)}),
+                headers={"Content-Type": codec.MSGPACK_CONTENT_TYPE},
+            )
+            assert resp.status == 415
+            # bf16 request BODIES score fine (clients may send reduced)
+            import ml_dtypes
+
+            resp = await client.post(
+                "/gordo/v0/precproj/prec-m-0/anomaly/prediction",
+                data=codec.packb({"X": X.astype(ml_dtypes.bfloat16)}),
+                headers={"Content-Type": codec.MSGPACK_CONTENT_TYPE},
+            )
+            assert resp.status == 200
+        finally:
+            await client.close()
+
+    asyncio.run(runner())
+
+
+# ---------------------------------------------------------------------------
+# generator stamping
+# ---------------------------------------------------------------------------
+
+def test_generator_stamps_serve_dtype():
+    from gordo_tpu.workflow.generator import (
+        generate_argo_workflow,
+        generate_workflow,
+    )
+
+    cfg = NormalizedConfig(
+        {"machines": PROJECT["machines"][:1], "globals": PROJECT["globals"]},
+        "precproj",
+    )
+    docs = generate_workflow(cfg, serve_dtype="bf16")
+
+    def envs_of(doc):
+        tpl = doc["spec"]["template"]["spec"]["containers"][0]
+        return {e["name"]: e.get("value") for e in tpl.get("env", [])}
+
+    builder = next(d for d in docs if d["kind"] == "Job")
+    server = next(
+        d for d in docs
+        if d["kind"] == "Deployment"
+        and d["metadata"]["name"].startswith("gordo-server-")
+    )
+    assert envs_of(builder)["GORDO_SERVE_DTYPE"] == "bfloat16"
+    assert envs_of(server)["GORDO_SERVE_DTYPE"] == "bfloat16"
+    # unset → no stamp (the env default stays float32)
+    docs_plain = generate_workflow(cfg)
+    assert "GORDO_SERVE_DTYPE" not in envs_of(
+        next(d for d in docs_plain if d["kind"] == "Job")
+    )
+    # a typo fails generation, not a pod
+    with pytest.raises(ValueError):
+        generate_workflow(cfg, serve_dtype="float8")
+    # argo chunk tasks carry it too
+    argo = generate_argo_workflow(cfg, serve_dtype="bf16")
+    chunk = next(
+        t for t in argo["spec"]["templates"] if t["name"] == "build-chunk"
+    )
+    env = {e["name"]: e["value"] for e in chunk["container"]["env"]}
+    assert env["GORDO_SERVE_DTYPE"] == "bfloat16"
+
+
+# ---------------------------------------------------------------------------
+# the request-path host-math lint gate
+# ---------------------------------------------------------------------------
+
+class TestHostMathGate:
+    @staticmethod
+    def _lint(path):
+        spec = importlib.util.spec_from_file_location(
+            "gordo_lint", os.path.join(
+                os.path.dirname(os.path.dirname(__file__)),
+                "scripts", "lint.py",
+            ),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.lint_file(path)
+
+    def test_np_compute_in_dispatch_scope_rejected(self, tmp_path):
+        bad = tmp_path / "gordo_tpu" / "serve" / "scorer.py"
+        bad.parent.mkdir(parents=True)
+        bad.write_text(
+            "import numpy as np\n"
+            "def _run(X):\n"
+            "    X = np.concatenate([X, np.tile(X[-1:], (4, 1))])\n"
+            "    return X\n"
+            "def helper(X):\n"
+            "    return np.concatenate([X, X])  # outside the gate\n"
+        )
+        msgs = [f[2] for f in self._lint(str(bad))]
+        assert any("np.concatenate" in m and "_run" in m for m in msgs)
+        assert any("np.tile" in m for m in msgs)
+        assert not any("helper" in m for m in msgs)
+
+    def test_serve_request_path_is_clean(self):
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        for rel in (
+            os.path.join("gordo_tpu", "serve", "scorer.py"),
+            os.path.join("gordo_tpu", "serve", "fleet_scorer.py"),
+        ):
+            assert self._lint(os.path.join(repo, rel)) == [], rel
+
+
+# ---------------------------------------------------------------------------
+# the full parity sweep (slow lane; wired into CI test-full)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_bf16_parity_full_suite(tmp_path, monkeypatch):
+    """The fp32-vs-bf16 parity gate over the harder model family: an
+    LSTM autoencoder (recurrent accumulation) plus the smoothing
+    detector, at replay request sizes, across per-machine, full-bucket
+    and subset dispatches — the suite a deployment must pass before
+    flipping GORDO_SERVE_DTYPE=bfloat16 (docs/perf.md)."""
+    lstm_model = {
+        "gordo_tpu.anomaly.diff.DiffBasedAnomalyDetector": {
+            "base_estimator": {
+                "gordo_tpu.pipeline.Pipeline": {
+                    "steps": [
+                        "gordo_tpu.ops.scalers.MinMaxScaler",
+                        {
+                            "gordo_tpu.models.estimator.LSTMAutoEncoder": {
+                                "kind": "lstm_hourglass",
+                                "lookback_window": 4,
+                                "epochs": 2,
+                                "batch_size": 64,
+                            }
+                        },
+                    ]
+                }
+            }
+        }
+    }
+    project = {
+        "machines": [
+            {
+                "name": f"pf-lstm-{i}",
+                "dataset": {
+                    "type": "RandomDataset",
+                    "tags": ["a", "b", "c", "d"],
+                    "train_start_date": "2017-12-25T06:00:00Z",
+                    "train_end_date": "2017-12-27T06:00:00Z",
+                },
+            }
+            for i in range(2)
+        ]
+        + [
+            {
+                "name": "pf-smooth",
+                "dataset": {
+                    "type": "RandomDataset",
+                    "tags": ["a", "b", "c", "d"],
+                    "train_start_date": "2017-12-25T06:00:00Z",
+                    "train_end_date": "2017-12-27T06:00:00Z",
+                },
+                "model": _SMOOTH_MODEL,
+            }
+        ],
+        "globals": {"model": lstm_model},
+    }
+    out = str(tmp_path / "artifacts")
+    cfg = NormalizedConfig(project, "pfproj")
+    result = build_project(cfg.machines, out)
+    assert not result.failed
+
+    X = np.random.default_rng(5).standard_normal((2048, 4)).astype(
+        np.float32
+    )
+    ref_coll = ModelCollection.from_directory(out, project="pfproj")
+    ref_bulk = ref_coll.fleet_scorer.score_all(
+        {name: X for name in ref_coll.entries}
+    )
+    ref_sub = ref_coll.fleet_scorer.score_all({"pf-lstm-0": X})
+
+    monkeypatch.setenv("GORDO_SERVE_DTYPE", "bfloat16")
+    bf_coll = ModelCollection.from_directory(out, project="pfproj")
+    bf_bulk = bf_coll.fleet_scorer.score_all(
+        {name: X for name in bf_coll.entries}
+    )
+    bf_sub = bf_coll.fleet_scorer.score_all({"pf-lstm-0": X})
+
+    for name in ref_coll.entries:
+        assert_parity(
+            ref_coll.get(name).scorer.anomaly_arrays(X),
+            bf_coll.get(name).scorer.anomaly_arrays(X),
+            label=f"per-machine {name}: ",
+        )
+        assert_parity(
+            ref_bulk[name], bf_bulk[name], label=f"bucket {name}: "
+        )
+    assert_parity(ref_sub["pf-lstm-0"], bf_sub["pf-lstm-0"],
+                  label="subset: ")
