@@ -1046,6 +1046,380 @@ def bench_artifact_io(out: dict) -> None:
             shutil.rmtree(d2, ignore_errors=True)
 
 
+def bench_serving_sharded(out: dict) -> None:
+    """ISSUE 8 acceptance: the horizontal serving tier — N forked scoring
+    replicas (REAL server processes, the multihost_dryrun pattern), each
+    loading only its shard of a shared v2 pack dir, driven closed-loop at
+    64-way concurrency with client-side machine-affinity routing.
+
+    Protocol (docs/perf.md "Sharded serving"):
+
+    - one trained machine replicated across 64 names, packed v2 in
+      8-machine chunks so shard boundaries align with pack boundaries at
+      N=2 and N=4;
+    - baseline: ONE unsharded server process; sharded: N=2 and N=4
+      replica processes (``gordo run-server --shard i/N`` equivalents),
+      requests routed to owners via ``serve.shard.ShardRouter``;
+    - aggregate throughput + p50/p99 per topology after a full warmup
+      round (per-request latencies from submission, 64 in flight);
+    - byte parity: the 2-replica scatter-gather of one bulk round must
+      equal the single process's response arrays EXACTLY;
+    - per-replica time-to-ready at 10k machines: a fresh process loading
+      shard 0/4 vs a fresh process loading everything (the 1/N gate —
+      each replica touches only its own packs' skeletons/transfers).
+
+    Honesty note: this container exposes ONE CPU core, so N replica
+    processes timeshare it — aggregate throughput CANNOT show the real
+    N-way win here (the processes are compute-serialized), exactly like
+    the TPU numbers banked behind the absent tunnel.  The fields gate
+    what 1 core can prove (routing correctness, parity, 1/N ready); the
+    throughput ratios are recorded with ``cpu_cores`` alongside.
+    """
+    import asyncio
+    import socket
+    import urllib.request
+
+    import aiohttp
+
+    from gordo_tpu import artifacts
+    from gordo_tpu.serve import codec
+    from gordo_tpu.serve.shard import ShardRouter, shard_slices
+
+    n_machines = int(os.environ.get("BENCH_SHARDED_MACHINES", "64"))
+    rows = int(os.environ.get("BENCH_SHARDED_ROWS", "512"))
+    rounds = int(os.environ.get("BENCH_SHARDED_ROUNDS", "6"))
+    concurrency = 64
+    out["cpu_cores"] = os.cpu_count()
+    if os.cpu_count() == 1:
+        out["sharded_single_core_serialized"] = (
+            "1 visible core: replica processes timeshare it, so the "
+            "aggregate-throughput axis cannot exceed ~1x here; the "
+            "multi-core/TPU win is banked, like the tunnel numbers"
+        )
+
+    model, metadata = _build_serving_model()
+    names = [f"sm-{i:03d}" for i in range(n_machines)]
+    art_dir = tempfile.mkdtemp(prefix="gordo-bench-sharded-")
+    procs: "list[subprocess.Popen]" = []
+    logs: "list[str]" = []
+
+    def free_port() -> int:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    def spawn(port: int, shard: "str | None") -> subprocess.Popen:
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.pop("GORDO_SERVE_SHARD", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        args = [
+            sys.executable, "-m", "gordo_tpu.cli.cli", "run-server",
+            "--model-dir", art_dir, "--project", "bench",
+            "--host", "127.0.0.1", "--port", str(port),
+            "--rescan-interval", "0",
+        ]
+        if shard:
+            args += ["--shard", shard]
+        log_path = os.path.join(art_dir, f"server-{port}.log")
+        logs.append(log_path)
+        proc = subprocess.Popen(
+            args, env=env,
+            stdout=open(log_path, "w"), stderr=subprocess.STDOUT,
+        )
+        procs.append(proc)
+        return proc
+
+    def wait_ready(port: int, timeout_s: float = 120.0) -> None:
+        deadline = time.monotonic() + timeout_s
+        url = f"http://127.0.0.1:{port}/healthz"
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(url, timeout=2) as resp:
+                    if resp.status == 200:
+                        return
+            except Exception:
+                time.sleep(0.25)
+        raise RuntimeError(f"replica on :{port} never became ready")
+
+    def stop(to_stop: "list[subprocess.Popen]") -> None:
+        for proc in to_stop:
+            proc.terminate()
+        for proc in to_stop:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    headers = {
+        "Content-Type": codec.MSGPACK_CONTENT_TYPE,
+        "Accept": codec.MSGPACK_CONTENT_TYPE,
+    }
+
+    async def drive(urls_by_machine: "dict[str, str]") -> dict:
+        """Closed-loop single-machine anomaly rounds, 64 in flight across
+        the whole tier, each request routed to its owner replica."""
+        rng = np.random.default_rng(0)
+        bodies = {
+            name: codec.packb(
+                {"X": rng.standard_normal((rows, N_TAGS)).astype(np.float32)}
+            )
+            for name in names
+        }
+        latencies: "list[float]" = []
+        timeout = aiohttp.ClientTimeout(total=300)
+        async with aiohttp.ClientSession(timeout=timeout) as session:
+            sem = asyncio.Semaphore(concurrency)
+
+            async def post(name: str, measured: bool) -> None:
+                url = (
+                    f"{urls_by_machine[name]}/gordo/v0/bench/{name}"
+                    "/anomaly/prediction"
+                )
+                async with sem:
+                    t0 = time.perf_counter()
+                    async with session.post(
+                        url, data=bodies[name], headers=headers
+                    ) as resp:
+                        raw = await resp.read()
+                    if resp.status != 200:
+                        raise RuntimeError(
+                            f"{name} -> {resp.status}: {raw[:160]!r}"
+                        )
+                if measured:
+                    latencies.append(time.perf_counter() - t0)
+
+            # warmup round: per-process compiles land outside the timing
+            await asyncio.gather(*(post(n, False) for n in names))
+            t0 = time.perf_counter()
+            await asyncio.gather(*(
+                post(n, True) for _ in range(rounds) for n in names
+            ))
+            dt = time.perf_counter() - t0
+        n_req = rounds * len(names)
+        p50, p99 = np.percentile(latencies, [50, 99])
+        return {
+            "samples_per_sec": n_req * rows * N_TAGS / dt,
+            "requests_per_sec": n_req / dt,
+            "p50_ms": float(p50 * 1e3),
+            "p99_ms": float(p99 * 1e3),
+        }
+
+    async def bulk_scatter(
+        urls: "list[str]", X_by: "dict[str, np.ndarray]"
+    ) -> dict:
+        """One bulk round, scatter-gathered across ``urls`` with the
+        shared shard function, reassembled in machine order."""
+        router = ShardRouter(names, urls)
+        plan = router.split(X_by)
+        timeout = aiohttp.ClientTimeout(total=300)
+        async with aiohttp.ClientSession(timeout=timeout) as session:
+
+            async def post(base: str, members: "list[str]") -> dict:
+                async with session.post(
+                    f"{base}/gordo/v0/bench/_bulk/anomaly/prediction",
+                    data=codec.packb({"X": {m: X_by[m] for m in members}}),
+                    headers=headers,
+                ) as resp:
+                    raw = await resp.read()
+                if resp.status != 200:
+                    raise RuntimeError(f"bulk {base} -> {resp.status}")
+                return codec.unpackb(raw)["data"]
+
+            parts = await asyncio.gather(
+                *(post(b, ms) for b, ms in plan.items())
+            )
+        gathered: dict = {}
+        for part in parts:
+            gathered.update(part)
+        return {m: gathered[m] for m in X_by}
+
+    try:
+        # ---- shared v2 artifact dir: 8-machine packs (shard-aligned) ----
+        chunk = max(1, n_machines // 8)
+        for start in range(0, n_machines, chunk):
+            part = names[start: start + chunk]
+            metas = []
+            for name in part:
+                md = dict(metadata)
+                md["name"] = name
+                metas.append(md)
+            artifacts.write_pack(art_dir, part, [model] * len(part), metas)
+        log(f"sharded: {n_machines} machines in "
+            f"{-(-n_machines // chunk)} packs under {art_dir}")
+
+        # ---- baseline: one unsharded process ----
+        base_port = free_port()
+        base_proc = spawn(base_port, None)
+        wait_ready(base_port)
+        base_url = f"http://127.0.0.1:{base_port}"
+        baseline = asyncio.run(drive({n: base_url for n in names}))
+        out["sharded_baseline_samples_per_sec"] = round(
+            baseline["samples_per_sec"]
+        )
+        out["sharded_baseline_p50_ms"] = round(baseline["p50_ms"], 2)
+        out["sharded_baseline_p99_ms"] = round(baseline["p99_ms"], 2)
+        log(f"sharded baseline (1 proc): "
+            f"{baseline['samples_per_sec']:,.0f} samples/s, "
+            f"p50 {baseline['p50_ms']:.0f}ms p99 {baseline['p99_ms']:.0f}ms")
+
+        rng = np.random.default_rng(11)
+        X_parity = {
+            n: rng.standard_normal((rows, N_TAGS)).astype(np.float32)
+            for n in names
+        }
+        single_bulk = asyncio.run(bulk_scatter([base_url], X_parity))
+
+        for n_replicas in (2, 4):
+            ports = [free_port() for _ in range(n_replicas)]
+            replica_procs = [
+                spawn(port, f"{i}/{n_replicas}")
+                for i, port in enumerate(ports)
+            ]
+            for port in ports:
+                wait_ready(port)
+            urls = [f"http://127.0.0.1:{p}" for p in ports]
+            slices = shard_slices(names, n_replicas)
+            url_of = {
+                name: urls[i]
+                for i, shard in enumerate(slices) for name in shard
+            }
+            res = asyncio.run(drive(url_of))
+            key = f"sharded_{n_replicas}rep"
+            out[f"{key}_samples_per_sec"] = round(res["samples_per_sec"])
+            out[f"{key}_p50_ms"] = round(res["p50_ms"], 2)
+            out[f"{key}_p99_ms"] = round(res["p99_ms"], 2)
+            speedup = res["samples_per_sec"] / baseline["samples_per_sec"]
+            out[f"sharded_speedup_{n_replicas}"] = round(speedup, 3)
+            log(f"sharded {n_replicas} replicas: "
+                f"{res['samples_per_sec']:,.0f} samples/s "
+                f"({speedup:.2f}x baseline), p50 {res['p50_ms']:.0f}ms "
+                f"p99 {res['p99_ms']:.0f}ms")
+
+            if n_replicas == 2:
+                sharded_bulk = asyncio.run(bulk_scatter(urls, X_parity))
+                parity = list(sharded_bulk) == list(single_bulk) and all(
+                    (
+                        np.array_equal(sharded_bulk[m][k], v)
+                        and getattr(sharded_bulk[m][k], "dtype", None)
+                        == getattr(v, "dtype", None)
+                    )
+                    if isinstance(v, np.ndarray)
+                    else sharded_bulk[m][k] == v
+                    for m in single_bulk
+                    for k, v in single_bulk[m].items()
+                )
+                out["sharded_parity_ok"] = bool(parity)
+                out["sharded_parity_machines"] = len(single_bulk)
+                log(f"sharded 2-replica scatter-gather byte parity: "
+                    f"{'OK' if parity else 'FAILED'} "
+                    f"({len(single_bulk)} machines)")
+            stop(replica_procs)
+        # the 2x gate the multi-core deployment meets; recorded honestly
+        # either way (see cpu_cores / sharded_single_core_serialized)
+        out["sharded_2x_ge_1p6_ok"] = out["sharded_speedup_2"] >= 1.6
+        stop([base_proc])
+
+        # ---- per-replica time-to-ready at 10k machines ----
+        n_large = int(os.environ.get("BENCH_SHARDED_READY_MACHINES", "10000"))
+        ready_shards = 4
+        big_dir = tempfile.mkdtemp(prefix="gordo-bench-sharded-10k-")
+        try:
+            big_names = [f"bm-{i:05d}" for i in range(n_large)]
+            t0 = time.perf_counter()
+            for start in range(0, n_large, 512):
+                part = big_names[start: start + 512]
+                metas = []
+                for name in part:
+                    md = dict(metadata)
+                    md["name"] = name
+                    metas.append(md)
+                artifacts.write_pack(
+                    big_dir, part, [model] * len(part), metas
+                )
+            log(f"sharded: {n_large}-machine v2 dir written in "
+                f"{time.perf_counter() - t0:.1f}s")
+
+            ready_script = (
+                "import json, sys, time\n"
+                "import jax\n"
+                "from gordo_tpu.serve.server import ModelCollection\n"
+                "from gordo_tpu.serve.shard import ShardSpec\n"
+                "d, spec = sys.argv[1], sys.argv[2]\n"
+                "shard = None if spec == '-' else ShardSpec.parse(spec)\n"
+                "t0 = time.perf_counter()\n"
+                "coll = ModelCollection.from_directory("
+                "d, project='bench', shard=shard)\n"
+                "fleet = coll.fleet_scorer\n"
+                "for b in fleet.buckets:\n"
+                "    jax.block_until_ready(jax.tree.leaves(b.params))\n"
+                "print(json.dumps({'ready_s': time.perf_counter() - t0,"
+                " 'machines': len(coll.entries)}))\n"
+            )
+
+            def ready_child(spec: str) -> dict:
+                env = dict(os.environ)
+                env.pop("PALLAS_AXON_POOL_IPS", None)
+                env.pop("GORDO_SERVE_SHARD", None)
+                env["JAX_PLATFORMS"] = "cpu"
+                res = subprocess.run(
+                    [sys.executable, "-c", ready_script, big_dir, spec],
+                    env=env, stdout=subprocess.PIPE, text=True,
+                    timeout=600,
+                )
+                if res.returncode != 0:
+                    raise RuntimeError(
+                        f"ready child {spec} rc={res.returncode}"
+                    )
+                return json.loads(res.stdout.strip().splitlines()[-1])
+
+            # min-of-2 per point (page-cache / shared-CPU noise lands on
+            # both sides); shard 1 runs once to show a mid-fleet shard
+            # (TWO pack-boundary slices) costs the same shape
+            full_s = min(ready_child("-")["ready_s"] for _ in range(2))
+            shard0_s = min(
+                ready_child(f"0/{ready_shards}")["ready_s"]
+                for _ in range(2)
+            )
+            shard1_s = ready_child(f"1/{ready_shards}")["ready_s"]
+            fraction = shard0_s / full_s
+            out[f"sharded_ready_full_{n_large}_s"] = round(full_s, 3)
+            out[f"sharded_ready_shard_{n_large}_s"] = round(shard0_s, 3)
+            out[f"sharded_ready_shard1_{n_large}_s"] = round(shard1_s, 3)
+            out["sharded_ready_shards"] = ready_shards
+            out["sharded_ready_fraction"] = round(fraction, 3)
+            # strict same-run gate: shard <= full/N.  The fixed cost both
+            # loads share (store open + discover over the FULL index) is
+            # a few % of full, so this sits within noise of exactly 1/N.
+            out["sharded_ready_1_over_n_ok"] = (
+                fraction <= 1.0 / ready_shards
+            )
+            # the ISSUE reference point: 1/N of the single-process v2
+            # number from BENCH_r11 (37.9s v1 -> 6.0s v2 at 10k, CPU)
+            out["sharded_ready_vs_r11_6s_ok"] = (
+                n_large != 10000 or shard0_s <= 6.0 / ready_shards
+            )
+            log(f"sharded time-to-ready @{n_large}: full {full_s:.2f}s vs "
+                f"shard 0/{ready_shards} {shard0_s:.2f}s / shard 1 "
+                f"{shard1_s:.2f}s ({fraction:.3f} of full; gate <= "
+                f"{1.0 / ready_shards:.2f}; r11 ref 6.0s/N)")
+        finally:
+            shutil.rmtree(big_dir, ignore_errors=True)
+    except Exception:
+        for log_path in logs:
+            try:
+                with open(log_path) as fh:
+                    tail = fh.read()[-2000:]
+                if tail:
+                    log(f"--- {log_path} tail ---\n{tail}")
+            except OSError:
+                pass
+        raise
+    finally:
+        stop(procs)
+        shutil.rmtree(art_dir, ignore_errors=True)
+
+
 def bench_cold_start(out: dict) -> None:
     """ISSUE 5 acceptance: cold-start elimination, measured end to end.
 
@@ -1278,8 +1652,8 @@ def run_stage_bounded(
 #: stage registry order == run order == metric priority (a mid-run wedge
 #: costs the least important remaining numbers)
 STAGES = ("build", "build_pipeline", "artifact_io", "serving",
-          "serving_precision", "serving_openloop", "telemetry_overhead",
-          "cold_start", "lstm")
+          "serving_precision", "serving_sharded", "serving_openloop",
+          "telemetry_overhead", "cold_start", "lstm")
 
 
 def parse_cli(argv: "list[str]") -> "tuple[list[str], int | None]":
@@ -1408,6 +1782,10 @@ def main(argv: "list[str] | None" = None) -> None:
         "serving_precision": (
             lambda: bench_serving_precision(out),
             lambda: min(remaining() * 0.7, 480),
+        ),
+        "serving_sharded": (
+            lambda: bench_serving_sharded(out),
+            lambda: min(remaining() * 0.7, 600),
         ),
         "serving_openloop": (
             lambda: bench_serving_openloop(out),

@@ -22,7 +22,6 @@ import tempfile
 import numpy as np
 import yaml
 
-from gordo_tpu import serializer
 from gordo_tpu.builder.fleet_build import build_project
 from gordo_tpu.workflow import NormalizedConfig, build_plan
 from gordo_tpu.workflow.generator import generate_argo_workflow
@@ -96,7 +95,11 @@ def main():
     result = build_project(config.machines, out_dir, pad_lengths=PAD)
     assert not result.failed, result.failed
     print("built:", result.summary())
-    meta = serializer.load_metadata(result.artifacts["ragged-0"])
+    # via the artifact plane: v2 packs are the build default now
+    from gordo_tpu import artifacts
+
+    _, refs = artifacts.discover(out_dir)
+    meta = next(r for r in refs if r.name == "ragged-0").load_metadata()
     print(
         "ragged-0 artifact: pad_lengths =", meta["model"].get("pad_lengths"),
         "| rows_trained =", meta["model"].get("rows_trained"),

@@ -11,7 +11,6 @@ import tempfile
 
 import numpy as np
 
-from gordo_tpu import serializer
 from gordo_tpu.builder.fleet_build import build_project
 from gordo_tpu.workflow import NormalizedConfig
 
@@ -41,15 +40,20 @@ def main():
     result = build_project(config.machines, out_dir)
     print("built:", result.summary())
 
-    # 2. Artifact + metadata
-    path = result.artifacts["demo-machine-0"]
-    meta = serializer.load_metadata(path)
+    # 2. Artifact + metadata — via the artifact plane: the build writes
+    # format v2 by default (one memory-mapped pack per fleet chunk), and
+    # `artifacts.discover` is the one loading API over both formats
+    from gordo_tpu import artifacts
+
+    _, refs = artifacts.discover(out_dir)
+    ref = next(r for r in refs if r.name == "demo-machine-0")
+    meta = ref.load_metadata()
     print("rows:", meta["dataset"]["rows_after_filter"],
           "| cv scores:", {k: round(v["mean"], 4) if isinstance(v, dict) else v
                            for k, v in list(meta["model"]["cross_validation"]["scores"].items())[:1]})
 
     # 3. Local anomaly scoring
-    model = serializer.load(path)
+    model = ref.load_model()
     X = np.random.default_rng(0).standard_normal((64, 4)).astype(np.float32)
     frame = model.anomaly(X)
     print("anomaly frame columns:", sorted({c[0] for c in frame.columns}))

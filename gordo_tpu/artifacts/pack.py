@@ -112,9 +112,13 @@ class PackCorruptError(PackError):
 
 def resolve_format(fmt: Optional[str] = None) -> str:
     """The artifact format a build writes: an explicit argument wins,
-    else ``GORDO_ARTIFACT_FORMAT``, else ``v1`` (the compatibility
-    default — the generated production manifests opt builds into v2)."""
-    fmt = fmt or os.environ.get(ENV_FORMAT, "").strip().lower() or "v1"
+    else ``GORDO_ARTIFACT_FORMAT``, else ``v2`` — memory-mapped bucket
+    packs are the library default now that the whole serving tier
+    (collection load, fleet prestacking, sharded replicas) consumes
+    packs end-to-end.  ``GORDO_ARTIFACT_FORMAT=v1`` is the escape hatch
+    for tooling that still walks per-machine directories (or run
+    ``gordo artifacts unpack`` to export a v1 view)."""
+    fmt = fmt or os.environ.get(ENV_FORMAT, "").strip().lower() or "v2"
     if fmt not in FORMATS:
         raise ValueError(
             f"unknown artifact format {fmt!r}; expected one of {FORMATS}"

@@ -383,7 +383,8 @@ def build_project(
     loads each pack with a single whole-pack device transfer.  Machines
     on the single-machine fallback path still write v1 dirs (the mixed
     layout every reader handles).  Default: ``GORDO_ARTIFACT_FORMAT``,
-    else v1.
+    else v2 (``GORDO_ARTIFACT_FORMAT=v1`` is the per-machine-dirs escape
+    hatch).
 
     Streaming and memory-bounded: at most TWO chunks of machines
     (2 x the effective bucket size) have arrays resident — the one

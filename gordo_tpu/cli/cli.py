@@ -179,12 +179,12 @@ def build(output_dir, name, model_config, data_config, metadata,
                    "compile per distinct row count.")
 @click.option("--artifact-format", default=None,
               type=click.Choice(["v1", "v2"]),
-              help="v1: one directory per machine (compatibility default). "
-                   "v2: one memory-mapped parameter pack per fleet chunk + "
-                   "index (gordo_tpu/artifacts/) — O(chunks) files instead "
-                   "of O(machines), zero-copy server loads. Default: "
-                   "GORDO_ARTIFACT_FORMAT, else v1. The generated k8s "
-                   "builder runs v2.")
+              help="v2 (default): one memory-mapped parameter pack per "
+                   "fleet chunk + index (gordo_tpu/artifacts/) — "
+                   "O(chunks) files instead of O(machines), zero-copy "
+                   "server loads. v1: one directory per machine (the "
+                   "compatibility escape hatch, also via "
+                   "GORDO_ARTIFACT_FORMAT=v1).")
 @click.option("--replace-cache", is_flag=True)
 def build_project_cmd(machine_config, project_name, output_dir,
                       model_register_dir, max_bucket_size, data_parallel,
@@ -398,12 +398,24 @@ def _run_multihost_build(dist_cfg, machines, output_dir, model_register_dir,
               help="Precompile the serving programs in the background at "
                    "startup so the first request doesn't pay jit "
                    "compilation (~20-40s cold on TPU).")
+@click.option("--shard", default=None, envvar="GORDO_SERVE_SHARD",
+              help="'i/N': serve shard i of an N-replica fleet-sharded "
+                   "tier — load, warm, and make device-resident ONLY this "
+                   "shard's machines (the same deterministic partition "
+                   "the client and watchman compute; docs/serving.md "
+                   "'Sharded serving tier'). Default: unsharded.")
 def run_server_cmd(model_dir, host, port, project, rescan_interval,
                    coalesce_ms, coalesce_min_concurrency, coalesce_knee,
-                   model_parallel, warmup):
+                   model_parallel, warmup, shard):
     """Serve model(s) over the /gordo/v0/<project>/<machine>/ routes."""
     from gordo_tpu.serve.server import run_server
+    from gordo_tpu.serve.shard import ShardSpec
 
+    if shard:
+        try:
+            shard = ShardSpec.parse(shard)
+        except ValueError as exc:
+            raise click.BadParameter(str(exc), param_hint="--shard")
     run_server(
         model_dir, host=host, port=port, project=project,
         rescan_interval=rescan_interval,
@@ -412,6 +424,7 @@ def run_server_cmd(model_dir, host, port, project, rescan_interval,
         coalesce_knee_batch=coalesce_knee,
         model_parallel=model_parallel,
         warmup=warmup,
+        shard=shard or None,
     )
 
 
@@ -472,12 +485,19 @@ def run_watchman_cmd(project, machines, machine_config, targets, host, port,
 @click.option("--port", default=5555, show_default=True)
 @click.option("--watchman-url", default=None,
               help="Discover machines from this watchman (healthy only).")
+@click.option("--replica-url", "replica_urls", multiple=True,
+              help="Fleet-sharded serving tier: replica base URL, ordered "
+                   "by shard index (repeatable — give all N). The client "
+                   "computes the shard table locally and routes each "
+                   "machine's requests straight to its owning replica; "
+                   "bulk scoring scatter-gathers across the tier.")
 @click.pass_context
-def client_group(ctx, project, host, port, watchman_url):
+def client_group(ctx, project, host, port, watchman_url, replica_urls):
     """Query ML servers: bulk predictions, metadata, model download."""
     ctx.obj = {
         "project": project, "host": host, "port": port,
         "watchman_url": watchman_url,
+        "replica_urls": list(replica_urls) or None,
     }
 
 
@@ -486,7 +506,8 @@ def _make_client(ctx, **kwargs):
 
     return Client(
         ctx.obj["project"], host=ctx.obj["host"], port=ctx.obj["port"],
-        watchman_url=ctx.obj["watchman_url"], **kwargs
+        watchman_url=ctx.obj["watchman_url"],
+        replica_urls=ctx.obj["replica_urls"], **kwargs
     )
 
 
@@ -575,9 +596,13 @@ def client_download_model(ctx, output_dir, machine_names):
               help="Request row bucket(s) to pre-compile for (repeatable); "
                    "default: the manifest's row buckets, else 256 and "
                    "2048.")
+@click.option("--shard", default=None, envvar="GORDO_SERVE_SHARD",
+              help="--dir mode: 'i/N' — warm only shard i's subset of "
+                   "the artifacts (what a sharded replica's init "
+                   "container runs: 1/N of the fleet's programs).")
 @click.option("--timeout", default=600.0, show_default=True,
               help="--url mode: seconds to wait for the ready state.")
-def warmup_cmd(model_dir, server_url, row_sizes, timeout):
+def warmup_cmd(model_dir, server_url, row_sizes, shard, timeout):
     """Pre-compile serving programs (the cold-start gate).
 
     ``--dir``: AOT-compile every (signature, row bucket) program for the
@@ -591,13 +616,22 @@ def warmup_cmd(model_dir, server_url, row_sizes, timeout):
     if model_dir:
         from gordo_tpu.compile import warmup_collection
         from gordo_tpu.serve.server import ModelCollection
+        from gordo_tpu.serve.shard import ShardSpec
         from gordo_tpu.utils.compile_cache import (
             enable_persistent_compile_cache,
         )
 
+        shard_spec = None
+        if shard:
+            try:
+                shard_spec = ShardSpec.parse(shard)
+            except ValueError as exc:
+                raise click.BadParameter(str(exc), param_hint="--shard")
         enable_persistent_compile_cache()
         try:
-            collection = ModelCollection.from_directory(model_dir)
+            collection = ModelCollection.from_directory(
+                model_dir, shard=shard_spec
+            )
         except FileNotFoundError as exc:
             raise click.ClickException(str(exc))
         stats = warmup_collection(
@@ -826,10 +860,21 @@ def workflow_group():
                    "warmup manifest, AOT warmup, and request dispatch all "
                    "agree. Only use after the fp32 parity suite passes "
                    "for this project's model family (docs/perf.md).")
+@click.option("--serve-shards", default=None, type=click.IntRange(min=1),
+              help="Emit the serving tier fleet-sharded across N "
+                   "replicas: one Deployment+Service per shard "
+                   "(GORDO_SERVE_SHARD=i/N), an HPA per shard driven by "
+                   "the coalescer's queue-wait/service-time ratio gauge, "
+                   "and per-machine Mappings routed to the owning shard. "
+                   "Refused when N exceeds the machine count.")
+@click.option("--hpa-max-replicas", default=4, show_default=True,
+              type=click.IntRange(min=1),
+              help="maxReplicas of each shard's HPA (--serve-shards).")
 @click.option("--output-file", type=click.File("w"), default="-")
 def workflow_generate(machine_config, project_name, image, server_replicas,
                       server_args, fmt, multihost, scrape_annotations,
-                      serve_dtype, output_file):
+                      serve_dtype, serve_shards, hpa_max_replicas,
+                      output_file):
     """Render the kubernetes manifests + fleet build plan (reference:
     the Argo workflow template render)."""
     from gordo_tpu.workflow import (
@@ -852,6 +897,8 @@ def workflow_generate(machine_config, project_name, image, server_replicas,
             server_args=list(server_args), multihost=multihost,
             scrape_annotations=scrape_annotations,
             serve_dtype=serve_dtype,
+            serve_shards=serve_shards,
+            hpa_max_replicas=hpa_max_replicas,
         )
     except ValueError as exc:
         raise click.ClickException(str(exc))

@@ -156,8 +156,20 @@ class Client:
         use_msgpack: bool = True,
         watchman_url: Optional[str] = None,
         timeout: float = 120.0,
+        replica_urls: Optional[Sequence[str]] = None,
     ):
         self.project = project
+        #: fleet-sharded serving tier: base URLs ordered by shard index
+        #: (url i serves shard i/N).  The client computes the SAME
+        #: deterministic shard partition the servers loaded with
+        #: (gordo_tpu.serve.shard), so every single-machine request goes
+        #: straight to its owning replica — no lookup hop, no redirect —
+        #: and bulk rounds scatter per shard and gather back in machine
+        #: order.  None/1-element = the unsharded single-server behavior.
+        self.replica_urls = list(replica_urls) if replica_urls else None
+        self._router = None  # built lazily from the fleet machine list
+        if base_url is None and self.replica_urls:
+            base_url = self.replica_urls[0]
         self.base_url = base_url or f"{scheme}://{host}:{port}"
         self.metadata = metadata or {}
         self.data_provider = data_provider
@@ -175,11 +187,71 @@ class Client:
         self.timeout = timeout
 
     # -- URLs ----------------------------------------------------------------
-    def _project_url(self) -> str:
-        return f"{self.base_url}{API_PREFIX}/{self.project}/"
+    def _project_url(self, base: Optional[str] = None) -> str:
+        return f"{base or self.base_url}{API_PREFIX}/{self.project}/"
 
     def _machine_url(self, machine: str) -> str:
-        return f"{self.base_url}{API_PREFIX}/{self.project}/{machine}"
+        base = self.base_url
+        if self._router is not None:
+            try:
+                base = self._router.url_for(machine)
+            except KeyError:
+                pass  # unknown to the fleet list: let the server answer
+        return f"{base}{API_PREFIX}/{self.project}/{machine}"
+
+    async def _ensure_router(self, session: aiohttp.ClientSession):
+        """Build the shard router once per client: the table derives from
+        the FULL fleet machine list (watchman's endpoint roster, or a
+        replica's reported ``fleet-machines``), never from a request's
+        machine subset — the partition is defined over the whole fleet."""
+        if self.replica_urls is None or len(self.replica_urls) < 2:
+            return None
+        if self._router is not None:
+            return self._router
+        from gordo_tpu.serve.shard import ShardRouter
+
+        fleet: List[str] = []
+        if self.watchman_url:
+            body = await get_json(
+                session, self.watchman_url.rstrip("/") + "/",
+                retries=self.n_retries, timeout=self.timeout,
+            )
+            # ALL endpoints, healthy or not: an unhealthy machine still
+            # owns its shard slot, and dropping it would shift every
+            # machine after it onto the wrong replica
+            fleet = [
+                ep["target-name"] for ep in body.get("endpoints", [])
+                if ep.get("target-name")
+            ]
+        if not fleet:
+            # ask the replicas: each reports the full fleet list when
+            # sharded; union of served machines covers the unsharded case
+            last_exc: Optional[Exception] = None
+            served: List[str] = []
+            for base in self.replica_urls:
+                try:
+                    body = await get_json(
+                        session, self._project_url(base),
+                        retries=self.n_retries, timeout=self.timeout,
+                    )
+                except Exception as exc:
+                    last_exc = exc
+                    continue
+                if body.get("fleet-machines"):
+                    fleet = list(body["fleet-machines"])
+                    break
+                for name in body.get("machines", []):
+                    if name not in served:
+                        served.append(name)
+            if not fleet:
+                fleet = served
+            if not fleet:
+                raise RuntimeError(
+                    "could not discover the fleet machine list from any "
+                    f"replica of {self.replica_urls}"
+                ) from last_exc
+        self._router = ShardRouter(fleet, self.replica_urls)
+        return self._router
 
     # -- discovery / metadata ------------------------------------------------
     async def machine_names_async(self, session: aiohttp.ClientSession) -> List[str]:
@@ -199,6 +271,19 @@ class Client:
                     logger.warning(
                         "Skipping unhealthy endpoint %s", ep.get("target-name")
                     )
+            return names
+        if self.replica_urls and len(self.replica_urls) > 1:
+            # sharded tier: each replica serves (and lists) its shard;
+            # the project's machine roster is their union
+            names: List[str] = []
+            for base in self.replica_urls:
+                body = await get_json(
+                    session, self._project_url(base),
+                    retries=self.n_retries, timeout=self.timeout,
+                )
+                for name in body.get("machines", []):
+                    if name not in names:
+                        names.append(name)
             return names
         body = await get_json(
             session, self._project_url(), retries=self.n_retries, timeout=self.timeout
@@ -277,6 +362,7 @@ class Client:
     ) -> List[PredictionResult]:
         sem = asyncio.Semaphore(self.parallelism)
         async with aiohttp.ClientSession() as session:
+            await self._ensure_router(session)
             names = (
                 list(machine_names)
                 if machine_names
@@ -349,24 +435,64 @@ class Client:
                         ]
             if not payload_X:
                 return
-            url = f"{self.base_url}{API_PREFIX}/{self.project}/_bulk/anomaly/prediction"
-            payload: Dict[str, Any] = {"X": payload_X}
-            if payload_index:
-                payload["index"] = payload_index
+            # scatter: one sub-request per owning replica, computed with
+            # the shared shard function (unsharded degenerates to one).
+            # Machines outside the fleet list fall to the default base —
+            # the server reports them unknown in-slot, same as before.
+            plan: Dict[str, List[str]] = {}
+            for name in payload_X:
+                base = self.base_url
+                if self._router is not None:
+                    try:
+                        base = self._router.url_for(name)
+                    except KeyError:
+                        pass
+                plan.setdefault(base, []).append(name)
             poster = post_msgpack if self.use_msgpack else post_json
-            try:
-                async with sem:
-                    body = await poster(
-                        session, url, payload,
-                        retries=self.n_retries, timeout=self.timeout,
-                    )
-            except Exception as exc:
-                # a failed round affects ONLY the machines whose chunks
-                # rode in it — machines complete in other rounds stay ok
-                for name in payload_X:
-                    errors[name].append(f"chunk {idx}: {exc}")
-                return
-            for name, res in body["data"].items():
+
+            async def post_shard(
+                base: str, members: List[str]
+            ) -> Dict[str, Any]:
+                url = (
+                    f"{base}{API_PREFIX}/{self.project}"
+                    "/_bulk/anomaly/prediction"
+                )
+                payload: Dict[str, Any] = {
+                    "X": {m: payload_X[m] for m in members}
+                }
+                sub_index = {
+                    m: payload_index[m]
+                    for m in members if m in payload_index
+                }
+                if sub_index:
+                    payload["index"] = sub_index
+                try:
+                    async with sem:
+                        body = await poster(
+                            session, url, payload,
+                            retries=self.n_retries, timeout=self.timeout,
+                        )
+                except Exception as exc:
+                    # a failed sub-request affects ONLY the machines whose
+                    # chunks rode in it — other replicas' machines (and
+                    # other rounds) stay ok
+                    for name in members:
+                        errors[name].append(f"chunk {idx}: {exc}")
+                    return {}
+                return body["data"]
+
+            parts = await asyncio.gather(
+                *(post_shard(b, ms) for b, ms in plan.items())
+            )
+            gathered: Dict[str, Any] = {}
+            for part in parts:
+                gathered.update(part)
+            # reassemble in the round's ORIGINAL machine order — which
+            # replica answered a machine must never reorder results
+            for name in payload_X:
+                res = gathered.get(name)
+                if res is None:
+                    continue
                 if "error" in res:
                     errors[name].append(str(res["error"]))
                     continue

@@ -26,11 +26,29 @@ class BadGordoRequest(Exception):
 
 
 class BadGordoResponse(Exception):
-    """5xx / non-JSON — endpoint-side failure; retry may help."""
+    """5xx / non-JSON — endpoint-side failure; retry may help.
+
+    ``retry_after``: the response's ``Retry-After`` delay in seconds when
+    the endpoint sent one (429 overload shedding, 503 warmup), else None
+    — the retry loop sleeps THAT instead of its exponential guess."""
+
+    retry_after: Optional[float] = None
 
 
 #: statuses worth retrying (transient by convention)
 _RETRYABLE_STATUSES = {408, 425, 429, 500, 502, 503, 504}
+
+
+def _parse_retry_after(value: Optional[str]) -> Optional[float]:
+    """``Retry-After`` seconds form → float (the HTTP-date form is not
+    spoken here — the bundled server always sends seconds)."""
+    if not value:
+        return None
+    try:
+        seconds = float(value.strip())
+    except (TypeError, ValueError):
+        return None
+    return seconds if seconds >= 0 else None
 
 
 async def request_json(
@@ -76,9 +94,16 @@ async def request_json(
                         f"{method} {url} -> {resp.status}: {await resp.text()}"
                     )
                 if resp.status >= 400:
-                    raise BadGordoResponse(
+                    exc = BadGordoResponse(
                         f"{method} {url} -> {resp.status}: {await resp.text()}"
                     )
+                    # 429/503 shedding rides a Retry-After: the server
+                    # KNOWS its queue horizon; honor it over the blind
+                    # exponential schedule (capped below)
+                    exc.retry_after = _parse_retry_after(
+                        resp.headers.get("Retry-After")
+                    )
+                    raise exc
                 from gordo_tpu.serve import codec
 
                 if resp.content_type == codec.MSGPACK_CONTENT_TYPE:
@@ -89,7 +114,16 @@ async def request_json(
         except (aiohttp.ClientError, asyncio.TimeoutError, BadGordoResponse) as exc:
             last_exc = exc
             if attempt < retries:
-                await asyncio.sleep(backoff * (2 ** attempt))
+                delay = backoff * (2 ** attempt)
+                retry_after = getattr(exc, "retry_after", None)
+                if retry_after is not None:
+                    # server-stated delay wins over the schedule, capped
+                    # at the schedule's own maximum sleep so a confused
+                    # endpoint can't park the client for minutes
+                    delay = min(
+                        retry_after, backoff * (2 ** max(retries - 1, 0))
+                    )
+                await asyncio.sleep(delay)
     raise BadGordoResponse(f"{method} {url} failed after {retries + 1} attempts") from last_exc
 
 

@@ -21,6 +21,7 @@ from gordo_tpu.compile.registry import (  # noqa: F401
 )
 from gordo_tpu.compile.warmup import (  # noqa: F401
     WARMUP_DIR,
+    filter_manifest,
     load_warmup_manifest,
     warmup_collection,
     write_warmup_manifest,
@@ -32,6 +33,7 @@ __all__ = [
     "Program",
     "WARMUP_DIR",
     "cached_closure",
+    "filter_manifest",
     "install_persistent_cache_counters",
     "jit",
     "load_warmup_manifest",

@@ -178,6 +178,36 @@ def load_warmup_manifest(path: str) -> Optional[Dict[str, Any]]:
     }
 
 
+def filter_manifest(
+    manifest: Optional[Dict[str, Any]], machines
+) -> Optional[Dict[str, Any]]:
+    """Restrict a merged warmup manifest to a machine subset — what a
+    fleet-sharded replica (``GORDO_SERVE_SHARD=i/N``) warms: only the
+    (signature, bucket) rows that intersect ITS machines, with each kept
+    row's machine list pruned to the subset.  Row-bucket hints are
+    shape facts, not machine facts, and pass through unchanged.  N
+    replicas therefore each AOT-compile ~1/N of the fleet's program
+    signatures instead of all of them — warmup wall-clock (and the
+    ``gordo warmup --dir --shard`` init-container gate) scales with the
+    shard, not the project."""
+    if manifest is None:
+        return None
+    wanted = set(machines)
+    programs: List[Dict[str, Any]] = []
+    for entry in manifest.get("programs", ()):
+        kept = [m for m in entry.get("machines", ()) if m in wanted]
+        if not kept:
+            continue
+        if len(kept) != len(entry.get("machines", ())):
+            entry = dict(entry)
+            entry["machines"] = kept
+            entry["n_machines"] = len(kept)
+        programs.append(entry)
+    out = dict(manifest)
+    out["programs"] = programs
+    return out
+
+
 def warmup_collection(
     collection,
     row_sizes: Optional[Sequence[int]] = None,
@@ -207,6 +237,12 @@ def warmup_collection(
     }
     if manifest is None and getattr(collection, "source_dir", None):
         manifest = load_warmup_manifest(collection.source_dir)
+    if getattr(collection, "shard", None) is not None:
+        # a sharded replica warms only ITS manifest subset; the buckets
+        # below already reflect the shard (the collection loaded only its
+        # machines), this keeps the manifest-derived accounting honest
+        manifest = filter_manifest(manifest, collection.entries)
+        stats["shard"] = str(collection.shard)
     if not row_sizes:
         row_sizes = (manifest or {}).get("row_buckets") or [MIN_BUCKET, 2048]
     try:

@@ -52,15 +52,26 @@ EXIT_SHARD_RESUMABLE = 75
 SHARD_STATE_DIR = ".gordo-shards"
 
 
+def _signature_of(machine: Any) -> str:
+    """A machine's partition bucket signature: the ``fleet_signature``
+    attribute when the object carries one (the serving tier's name-only
+    atoms — precomputed so the serve path never imports the build
+    plane), else the build plan's config-derived signature."""
+    sig = getattr(machine, "fleet_signature", None)
+    if sig is not None:
+        return sig
+    from gordo_tpu.workflow.generator import _fleet_signature
+
+    return _fleet_signature(machine)
+
+
 def _bucket_slices(machines: Sequence[Any], num_processes: int):
     """Work units in deterministic order: signature buckets (sorted, as in
     the build plan), each split into up to ``num_processes`` near-equal
     contiguous slices of its name-sorted members."""
-    from gordo_tpu.workflow.generator import _fleet_signature
-
     buckets: Dict[str, List[Any]] = {}
     for m in machines:
-        buckets.setdefault(_fleet_signature(m), []).append(m)
+        buckets.setdefault(_signature_of(m), []).append(m)
     out: List[List[Any]] = []
     for _, members in sorted(buckets.items()):
         members = sorted(members, key=lambda m: m.name)

@@ -16,11 +16,14 @@ reference's pod-per-machine layout; the routes stay per-machine for parity.
 from gordo_tpu.serve import precision
 from gordo_tpu.serve.scorer import CompiledScorer, compile_scorer
 from gordo_tpu.serve.server import ModelCollection, build_app, run_server
+from gordo_tpu.serve.shard import ShardRouter, ShardSpec
 
 __all__ = [
     "CompiledScorer",
     "compile_scorer",
     "ModelCollection",
+    "ShardRouter",
+    "ShardSpec",
     "build_app",
     "precision",
     "run_server",

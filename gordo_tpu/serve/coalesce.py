@@ -110,6 +110,16 @@ _STANDING_DOWN_GAUGE = telemetry.gauge(
     "gordo_coalesce_standing_down",
     "1 while the saturation stand-down routes requests direct",
 )
+_WAIT_SERVICE_RATIO_GAUGE = telemetry.gauge(
+    "gordo_coalesce_wait_service_ratio",
+    "Latest p99 queue wait over median service time (the overload and "
+    "HPA signal; stand-down fires past standdown_ratio, shedding past "
+    "the first cooldown doubling)",
+)
+_SHEDDING_GAUGE = telemetry.gauge(
+    "gordo_coalesce_shedding",
+    "1 while escalated saturation sheds new requests with 429",
+)
 
 
 def export_gauges(coalescer: Optional["CoalescingScorer"]) -> None:
@@ -121,6 +131,46 @@ def export_gauges(coalescer: Optional["CoalescingScorer"]) -> None:
     _INFLIGHT_GAUGE.set(coalescer.inflight)
     _BATCH_CAP_GAUGE.set(coalescer.batch_cap)
     _STANDING_DOWN_GAUGE.set(1.0 if coalescer.standing_down else 0.0)
+    _WAIT_SERVICE_RATIO_GAUGE.set(coalescer.wait_service_ratio)
+    _SHEDDING_GAUGE.set(
+        1.0 if shed_retry_after(coalescer) is not None else 0.0
+    )
+
+
+#: consecutive stand-downs before the server starts SHEDDING (429 +
+#: Retry-After) instead of routing direct: the first stand-down is a
+#: transient probe (base cooldown); the second is the first cooldown
+#: doubling — overload that persisted through a full cooldown, where
+#: accepting more work only queues it to death
+SHED_MIN_STREAK = 2
+#: Retry-After ceiling: a shed client should probe again within the
+#: stand-down's own escalation horizon, not minutes later
+SHED_RETRY_MAX_S = 30.0
+
+
+def shed_retry_after(
+    coalescer: Optional["CoalescingScorer"],
+) -> Optional[float]:
+    """Seconds a shed request should wait before retrying, or None when
+    the server should accept work.
+
+    Shedding engages when the saturation stand-down has ESCALATED — at
+    least :data:`SHED_MIN_STREAK` consecutive stand-downs, i.e. the
+    cooldown has started doubling — and the suggested delay derives from
+    what was OBSERVED, not a constant: at least the p99 queue wait that
+    tripped the signal (a retry sooner than that lands in the same
+    queue), at least the remaining cooldown (before it, batching is
+    still stood down), floored at 1s (the header's second granularity)
+    and capped at :data:`SHED_RETRY_MAX_S`."""
+    if coalescer is None:
+        return None
+    if not coalescer.standing_down:
+        return None
+    if coalescer._standdown_streak < SHED_MIN_STREAK:
+        return None
+    remaining = coalescer._standdown_until - time.monotonic()
+    suggest = max(coalescer.last_wait_p99, remaining, 1.0)
+    return min(suggest, SHED_RETRY_MAX_S)
 
 
 #: knee sweep acceptance: doubling the batch must improve throughput by at
@@ -252,6 +302,12 @@ class CoalescingScorer:
         self.n_queue_full = 0
         self.n_standdowns = 0
         self._standdown_until = 0.0
+        #: latest saturation-signal evaluation (drain-thread writes;
+        #: scrape/shed reads): p99 queue wait, and its ratio over median
+        #: service time — the overload/HPA telemetry and the observed
+        #: basis of a shed response's Retry-After
+        self.last_wait_p99 = 0.0
+        self.wait_service_ratio = 0.0
         self._knee: Optional[int] = None
         self._knee_started = False
         self._cv = threading.Condition()
@@ -361,6 +417,8 @@ class CoalescingScorer:
             return
         wait_p99 = float(np.percentile(np.asarray(self._waits), 99))
         med_service = float(np.median(np.asarray(self._services)))
+        self.last_wait_p99 = wait_p99
+        self.wait_service_ratio = wait_p99 / max(med_service, 1e-6)
         if wait_p99 > self.standdown_ratio * max(med_service, 1e-6):
             cooldown = min(
                 self.standdown_cooldown_s * (2 ** self._standdown_streak),
@@ -691,4 +749,6 @@ def stats(coalescer: Optional[CoalescingScorer]) -> Dict[str, Any]:
         "queue_full_bypassed": coalescer.n_queue_full,
         "standdowns": coalescer.n_standdowns,
         "standing_down": coalescer.standing_down,
+        "shedding": shed_retry_after(coalescer) is not None,
+        "wait_service_ratio": round(coalescer.wait_service_ratio, 2),
     }

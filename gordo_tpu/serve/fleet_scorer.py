@@ -509,11 +509,18 @@ def _prestack_group(
     whole-pack device transfer; a multi-pack bucket concatenates the
     transferred trees on device.
 
-    Succeeds only when every machine of the group is pack-backed, every
-    contributing pack's live machines all fall in this group, and every
-    chain array of each pack's first machine maps back to a stacked
-    tensor.  Returns ``(prestacked, names, chains)`` reordered to
-    pack-slot order, or ``(None, names, chains)`` unchanged.
+    A pack may also contribute a CONTIGUOUS RUN of its slots — the
+    fleet-sharded serving case: shard slices and pack chunks are both
+    name-sorted, so a replica's boundary cuts a pack into a basic numpy
+    slice of the stacked tensors (still a zero-copy view, still one
+    ``to_device`` for that pack's contribution).  A pack whose in-group
+    machines are NOT slot-contiguous (interleaved bucketing) falls back
+    to the generic stacking path, as before.
+
+    Succeeds only when every machine of the group is pack-backed and
+    every chain array of each contributed run's first machine maps back
+    to a stacked tensor.  Returns ``(prestacked, names, chains)``
+    reordered to pack-slot order, or ``(None, names, chains)`` unchanged.
     """
     by_name = dict(zip(names, chains))
     group = set(names)
@@ -525,13 +532,17 @@ def _prestack_group(
         if pid not in pack_ids:
             pack_ids.append(pid)
     slot_orders: Dict[str, List[str]] = {}
+    slot_runs: Dict[str, Tuple[int, int]] = {}
     for pid in pack_ids:
         live = store.machines_of(pid)
-        if not set(live).issubset(group):
-            # the pack's other machines bucketed elsewhere — stacked rows
-            # would not align with this bucket
+        owned_pos = [i for i, m in enumerate(live) if m in group]
+        lo, hi = owned_pos[0], owned_pos[-1] + 1
+        if owned_pos != list(range(lo, hi)):
+            # in-group slots are interleaved with foreign ones — a view
+            # can't express that; stacked rows would not align
             return None, names, chains
-        slot_orders[pid] = live
+        slot_orders[pid] = live[lo:hi]
+        slot_runs[pid] = (lo, hi)
     pack_ids.sort(key=lambda p: slot_orders[p][0])
 
     def lift(pid, live_count, a):
@@ -543,7 +554,8 @@ def _prestack_group(
             # superseded slots still occupy stacked rows — row i would
             # no longer be machine i of this bucket
             raise _PrestackMiss()
-        return stacked
+        lo, hi = slot_runs[pid]
+        return stacked[lo:hi]  # basic slice: still a zero-copy view
 
     pack_hosts = []
     thr_parts: List[Any] = []
@@ -554,7 +566,8 @@ def _prestack_group(
         for pid in pack_ids:
             live = slot_orders[pid]
             c0 = by_name[live[0]]
-            take = lambda a, p=pid, m=len(live): lift(p, m, a)  # noqa: E731
+            n_live = len(store.machines_of(pid))
+            take = lambda a, p=pid, m=n_live: lift(p, m, a)  # noqa: E731
             pack_hosts.append((
                 jax.tree.map(take, c0["params"]),
                 tuple(

@@ -55,6 +55,18 @@ _MACHINES_GAUGE = telemetry.gauge(
     "gordo_server_machines",
     "Machines currently loaded in this server's collection",
 )
+_SHED_TOTAL = telemetry.counter(
+    "gordo_server_shed_total",
+    "Requests shed with 429 + Retry-After (coalescer stand-down escalated)",
+)
+_SHARD_INDEX_GAUGE = telemetry.gauge(
+    "gordo_server_shard_index",
+    "This replica's shard index (absent when serving unsharded)",
+)
+_SHARD_COUNT_GAUGE = telemetry.gauge(
+    "gordo_server_shard_count",
+    "Shard count of the serving tier this replica belongs to",
+)
 
 #: Prometheus exposition content type (text format 0.0.4)
 METRICS_CONTENT_TYPE = "text/plain"
@@ -173,12 +185,32 @@ class ModelCollection:
         serve_mesh=None,
         pack_store=None,
         serve_dtype: Optional[str] = None,
+        shard=None,
+        fleet_machines: Optional[List[str]] = None,
+        shard_owner: Optional[Dict[str, int]] = None,
     ):
         from gordo_tpu.serve import precision
 
         self.entries = entries
         self.project = project
         self.source_dir = source_dir
+        #: this replica's ShardSpec in a fleet-sharded tier (None when the
+        #: process serves the whole project)
+        self.shard = shard
+        #: the FULL project machine list (sharded replicas serve a subset
+        #: but must still answer "who owns machine X" — the 421 surface
+        #: and the client/watchman shard-table source)
+        self.fleet_machines = sorted(
+            fleet_machines if fleet_machines is not None else entries
+        )
+        #: name → owning shard index, from the one shared shard function
+        #: (``from_directory`` passes its already-computed table so a 10k-
+        #: machine shard startup doesn't partition the fleet twice)
+        if shard_owner is None and shard is not None:
+            from gordo_tpu.serve.shard import shard_map
+
+            shard_owner = shard_map(self.fleet_machines, shard.count)
+        self.shard_owner: Dict[str, int] = shard_owner or {}
         #: optional ("models","data") fleet mesh: stacked serving dispatches
         #: shard their machine axis over it (multi-chip serving)
         self.serve_mesh = serve_mesh
@@ -215,7 +247,8 @@ class ModelCollection:
 
     @classmethod
     def from_directory(
-        cls, path: str, project: str = "project", serve_mesh=None
+        cls, path: str, project: str = "project", serve_mesh=None,
+        shard=None,
     ) -> "ModelCollection":
         """Load every artifact under ``path`` — a v2 pack index, v1
         per-machine dirs, a mixed output, or one machine's artifact dir.
@@ -225,13 +258,42 @@ class ModelCollection:
         the fleet); a single broken v1 dir only loses that machine, as
         before.
 
+        ``shard`` (a :class:`gordo_tpu.serve.shard.ShardSpec`, default
+        ``GORDO_SERVE_SHARD`` from the environment): load ONLY this
+        replica's shard of the fleet — the partition is computed over the
+        discovered machine list with the one shared shard function, so
+        only the owned machines' models (and, pack-aligned, typically
+        only the owned packs' bytes) are loaded, warmed, and made device-
+        resident.  Per-replica time-to-ready scales as ~1/N.
+
         The serving dtype resolves here: ``GORDO_SERVE_DTYPE`` when set,
         else the build's warmup-manifest dtype (the precision decision
         travels with the artifacts), else float32."""
         from gordo_tpu.compile import load_warmup_manifest
         from gordo_tpu.serve import precision
+        from gordo_tpu.serve.shard import ShardSpec, shard_map
 
         store, refs = artifacts.discover(path)
+        if shard is None:
+            shard = ShardSpec.from_env()
+        fleet_machines = sorted({r.name for r in refs})
+        shard_owner: Optional[Dict[str, int]] = None
+        if shard is not None:
+            shard_owner = shard_map(fleet_machines, shard.count)
+            refs = [
+                r for r in refs
+                if shard_owner.get(r.name) == shard.index
+            ]
+            if not refs and fleet_machines:
+                raise FileNotFoundError(
+                    f"Shard {shard} owns no machines of the "
+                    f"{len(fleet_machines)}-machine fleet under {path!r} "
+                    f"(shard count exceeds the machine count?)"
+                )
+            logger.info(
+                "Serving shard %s: %d of %d machines",
+                shard, len(refs), len(fleet_machines),
+            )
         source_dir: Optional[str] = (
             None if artifacts.is_artifact_dir(path) else path
         )
@@ -262,10 +324,29 @@ class ModelCollection:
             serve_mesh=serve_mesh,
             pack_store=store,
             serve_dtype=serve_dtype,
+            shard=shard,
+            fleet_machines=fleet_machines,
+            shard_owner=shard_owner,
         )
 
     def get(self, name: str) -> Optional[ModelEntry]:
         return self.entries.get(name)
+
+    @property
+    def generation(self) -> int:
+        """Fleet-generation stamp: a monotone-enough integer that changes
+        whenever the artifacts backing this collection change — the v2
+        pack index's mtime (nanosecond-truncated to ms), else the newest
+        loaded artifact's.  Watchman republishes it per target so a
+        rollout's propagation across shard replicas is visible from one
+        endpoint; it is a CHANGE DETECTOR, not a version: artifact
+        registry generations with atomic flips are ROADMAP item 1."""
+        if self.pack_store is not None:
+            return int(self.pack_store.index_stat[0] * 1000)
+        return int(
+            max((e.mtime for e in self.entries.values()), default=0.0)
+            * 1000
+        )
 
     def rescan(self) -> Dict[str, List[str]]:
         """Pick up artifacts dumped/rebuilt/removed after startup.
@@ -287,6 +368,19 @@ class ModelCollection:
             # down the serving loop — keep the current view, retry later
             logger.exception("Artifact discovery failed during rescan")
             return {"added": [], "reloaded": [], "removed": []}
+        fleet_machines = sorted({r.name for r in refs})
+        shard_owner: Dict[str, int] = {}
+        if self.shard is not None:
+            # re-partition over the CURRENT fleet: machines built after
+            # startup land on their owning shard, and only that replica
+            # loads them (every replica recomputes the same partition)
+            from gordo_tpu.serve.shard import shard_map
+
+            shard_owner = shard_map(fleet_machines, self.shard.count)
+            refs = [
+                r for r in refs
+                if shard_owner.get(r.name) == self.shard.index
+            ]
         if (
             store is not None
             and self.pack_store is not None
@@ -337,6 +431,12 @@ class ModelCollection:
                 self.entries = new_entries
                 self.pack_store = store
                 self._fleet_scorer = None  # stacked params must restack
+        # fleet view refreshes even when this shard's entries didn't
+        # change: a machine added to ANOTHER shard must still 421-route
+        # (not 404) from here, and the shard table must agree fleet-wide
+        self.fleet_machines = fleet_machines
+        if self.shard is not None:
+            self.shard_owner = shard_owner
         return {"added": added, "reloaded": reloaded, "removed": removed}
 
 
@@ -517,14 +617,72 @@ def time_columns(
 # handlers
 # ---------------------------------------------------------------------------
 
+def _misdirected(collection: "ModelCollection", name: str) -> Optional[str]:
+    """When ``name`` is a real fleet machine owned by ANOTHER shard,
+    the human-readable misroute message (else None).  Clients computing
+    the shard table locally never hit this; it exists so a stale or
+    hand-built client fails loudly with the owner's identity instead of
+    a 404 that reads like 'machine was deleted'."""
+    if collection.shard is None:
+        return None
+    owner = collection.shard_owner.get(name)
+    if owner is None or owner == collection.shard.index:
+        return None
+    return (
+        f"Machine {name!r} belongs to serving shard "
+        f"{owner}/{collection.shard.count}; this replica serves shard "
+        f"{collection.shard}"
+    )
+
+
 def _entry_or_404(request: web.Request) -> ModelEntry:
     collection: ModelCollection = request.app[COLLECTION_KEY]
-    entry = collection.get(request.match_info["machine"])
+    name = request.match_info["machine"]
+    entry = collection.get(name)
     if entry is None:
-        raise web.HTTPNotFound(
-            text=f"Machine {request.match_info['machine']!r} not found"
-        )
+        misroute = _misdirected(collection, name)
+        if misroute is not None:
+            # 421 Misdirected Request: the machine exists, this replica
+            # just isn't its owner — a routing bug, not a missing model
+            # (and a non-retryable client error on the bundled client)
+            raise web.HTTPMisdirectedRequest(
+                text=json.dumps({
+                    "error": misroute,
+                    "shard": collection.shard_owner[name],
+                    "shard-count": collection.shard.count,
+                }),
+                content_type="application/json",
+            )
+        raise web.HTTPNotFound(text=f"Machine {name!r} not found")
     return entry
+
+
+def _shed_response(request: web.Request) -> Optional[web.Response]:
+    """Overload shedding: once the coalescer's saturation stand-down has
+    ESCALATED (consecutive stand-downs doubling the cooldown — not the
+    first transient one), new scoring work is refused with 429 +
+    ``Retry-After`` derived from the observed queue wait, instead of
+    queueing toward a timeout.  The bundled client honors Retry-After on
+    its retryable-status path, so a shed request comes back exactly when
+    the server predicted it could be served."""
+    coalescer = request.app.get(COALESCER_KEY)
+    if coalescer is None:
+        return None
+    retry_after = coalesce_mod.shed_retry_after(coalescer)
+    if retry_after is None:
+        return None
+    _SHED_TOTAL.inc()
+    return web.json_response(
+        {
+            "error": (
+                "server overloaded (queue wait escalated past service "
+                "time); retry after the indicated delay"
+            ),
+            "retry-after-seconds": retry_after,
+        },
+        status=429,
+        headers={"Retry-After": str(max(1, int(round(retry_after))))},
+    )
 
 
 async def healthcheck(request: web.Request) -> web.Response:
@@ -575,6 +733,11 @@ async def prediction(request: web.Request) -> web.Response:
 
 async def anomaly_prediction(request: web.Request) -> web.Response:
     entry = _entry_or_404(request)
+    shed = _shed_response(request)
+    if shed is not None:
+        # refused before the body is even read: shedding exists to stop
+        # spending on work that will queue to death anyway
+        return shed
     if not entry.scorer.is_anomaly:
         return web.json_response(
             {
@@ -669,7 +832,13 @@ async def bulk_anomaly_prediction(request: web.Request) -> web.Response:
             entry = collection.get(name)
             try:
                 if entry is None:
-                    raise ValueError(f"Unknown machine {name!r}")
+                    # a foreign-shard machine reports its owner in-slot
+                    # (scatter-gather clients route per shard and should
+                    # never see this; a mis-split payload must say WHY)
+                    raise ValueError(
+                        _misdirected(collection, name)
+                        or f"Unknown machine {name!r}"
+                    )
                 X = parse_X({"X": rows}, entry.tags)
                 _validate_width(X, entry)
                 if isinstance(indices, dict) and name in indices:
@@ -787,6 +956,9 @@ async def metrics_endpoint(request: web.Request) -> web.Response:
     collection = request.app.get(COLLECTION_KEY)
     if collection is not None:
         _MACHINES_GAUGE.set(len(collection.entries))
+        if collection.shard is not None:
+            _SHARD_INDEX_GAUGE.set(collection.shard.index)
+            _SHARD_COUNT_GAUGE.set(collection.shard.count)
     coalesce_mod.export_gauges(request.app.get(COALESCER_KEY))
     return web.Response(
         text=telemetry.render(), content_type=METRICS_CONTENT_TYPE
@@ -808,7 +980,19 @@ async def project_index(request: web.Request) -> web.Response:
         # serving-precision plane; clients reading bulk responses at
         # reduced wire dtypes can confirm what the compute side ran)
         "serving-dtype": collection.serve_dtype,
+        # change-detector stamp for the artifacts backing this replica;
+        # watchman republishes it per target (routing-topology surface)
+        "fleet-generation": collection.generation,
     }
+    if collection.shard is not None:
+        # the routing-topology surface: which shard this replica is, and
+        # the FULL fleet list every client needs to compute the shard
+        # table locally ("machines" stays this replica's served subset)
+        doc["serve-shard"] = {
+            "index": collection.shard.index,
+            "count": collection.shard.count,
+        }
+        doc["fleet-machines"] = collection.fleet_machines
     if store is not None:
         doc["artifact-packs"] = len(store.packs)
         doc["artifact-pack-bytes"] = store.total_bytes()
@@ -1019,13 +1203,23 @@ def run_server(
     coalesce_knee_batch: int = 0,
     model_parallel: bool = False,
     warmup: bool = False,
+    shard: Optional[str] = None,
 ) -> None:
     """Blocking entrypoint (reference: ``gordo run-server``).
 
     ``model_parallel=True`` shards every stacked serving dispatch over all
     visible devices (the ``"models"`` mesh axis) — one server process
     driving a whole slice instead of one chip.
+
+    ``shard``: ``"i/N"`` (or a :class:`~gordo_tpu.serve.shard.ShardSpec`)
+    — serve only shard i of an N-replica fleet-sharded tier; default is
+    the ``GORDO_SERVE_SHARD`` env var (what the generated per-shard
+    Deployments stamp), else unsharded.
     """
+    from gordo_tpu.serve.shard import ShardSpec
+
+    if isinstance(shard, str):
+        shard = ShardSpec.parse(shard)
     serve_mesh = None
     if model_parallel:
         import jax
@@ -1046,11 +1240,12 @@ def run_server(
                 devices[0].platform,
             )
     collection = ModelCollection.from_directory(
-        model_dir, project=project, serve_mesh=serve_mesh
+        model_dir, project=project, serve_mesh=serve_mesh, shard=shard
     )
     logger.info(
-        "Serving %d machine(s) from %s on %s:%d",
+        "Serving %d machine(s)%s from %s on %s:%d",
         len(collection.entries),
+        f" (shard {collection.shard})" if collection.shard else "",
         model_dir,
         host,
         port,

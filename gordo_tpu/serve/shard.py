@@ -1,0 +1,158 @@
+"""Fleet-sharded serving: ONE shard function shared by every tier.
+
+A project too big (or too hot) for one scoring process splits across N
+replicas by machine — and the split is the SAME deterministic,
+config-derived partition the multi-host builder uses
+(:func:`gordo_tpu.distributed.partition.partition_machines`): disjoint,
+exhaustive, independent of list order, computable by anyone holding the
+machine-name list.  That property is the whole design: the server, the
+client, the watchman and the workflow generator each compute the
+partition locally, so a single-machine request routes straight to the
+owning replica with ZERO extra hops — no lookup service, no consistent-
+hash ring to rebalance, no routing table to distribute (Podracer's
+sharded actor fleets and the TensorFlow-serving paper's replicated model
+servers both land on this shape).
+
+Serving shards partition on machine NAME only (one uniform signature
+bucket): unlike the build partition — which keeps same-signature
+machines together so they train as few stacked programs — the serving
+tier's clients know names, not model configs, and the contract must be
+computable from the project index alone.  Within that one bucket the
+partition is ``partition_machines``'s contiguous name-sorted slices, so
+shard boundaries line up with the name-sorted (signature, bucket) chunks
+the v2 pack writer emits: a replica's shard is typically a run of whole
+packs, each still ONE ``artifacts.to_device`` transfer.
+
+``scripts/lint.py`` rejects any other shard computation on the serve
+path (serve/, client/, watchman/, workflow/): two implementations that
+drift by one machine silently misroute that machine forever.
+
+Environment contract: ``GORDO_SERVE_SHARD=i/N`` (what the generated
+per-shard Deployments stamp) makes a server load — and warm — only its
+shard's artifacts.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence
+
+#: the env var a sharded replica reads at startup (``"i/N"`` — shard
+#: index i of N, zero-based)
+ENV_SHARD = "GORDO_SERVE_SHARD"
+
+
+@dataclass(frozen=True)
+class ShardSpec:
+    """One replica's identity in an N-way sharded serving tier."""
+
+    index: int
+    count: int
+
+    def __post_init__(self):
+        if self.count < 1:
+            raise ValueError(f"shard count must be >= 1, got {self.count}")
+        if not 0 <= self.index < self.count:
+            raise ValueError(
+                f"shard index {self.index} outside [0, {self.count})"
+            )
+
+    def __str__(self) -> str:
+        return f"{self.index}/{self.count}"
+
+    @classmethod
+    def parse(cls, spec: str) -> "ShardSpec":
+        """``"i/N"`` → ShardSpec (the ``GORDO_SERVE_SHARD`` /
+        ``--shard`` wire format)."""
+        try:
+            index_s, count_s = str(spec).strip().split("/", 1)
+            return cls(int(index_s), int(count_s))
+        except (ValueError, TypeError) as exc:
+            raise ValueError(
+                f"shard spec must be 'i/N' with 0 <= i < N, got {spec!r}"
+            ) from exc
+
+    @classmethod
+    def from_env(cls) -> Optional["ShardSpec"]:
+        spec = os.environ.get(ENV_SHARD, "").strip()
+        return cls.parse(spec) if spec else None
+
+
+class _ServeAtom:
+    """Name-only machine stand-in for :func:`partition_machines`: serving
+    shards partition on name alone, so every atom carries the same
+    precomputed empty ``fleet_signature`` (one bucket → contiguous
+    name-sorted slices — and the partition never has to import the build
+    plane's config-signature machinery into a serving process)."""
+
+    __slots__ = ("name", "fleet_signature")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.fleet_signature = ""
+
+
+def shard_slices(names: Iterable[str], count: int) -> List[List[str]]:
+    """The full partition: ``count`` disjoint, exhaustive, name-sorted
+    shards of ``names``, via the builder's :func:`partition_machines`.
+    Deterministic in (set(names), count) — input order never matters."""
+    from gordo_tpu.distributed.partition import partition_machines
+
+    atoms = [_ServeAtom(n) for n in sorted(set(names))]
+    return [
+        [a.name for a in shard]
+        for shard in partition_machines(atoms, count)
+    ]
+
+
+def shard_map(names: Iterable[str], count: int) -> Dict[str, int]:
+    """``{machine name: owning shard index}`` for the whole fleet."""
+    return {
+        name: idx
+        for idx, shard in enumerate(shard_slices(names, count))
+        for name in shard
+    }
+
+
+def shard_of(name: str, names: Iterable[str], count: int) -> int:
+    """The shard index owning ``name`` (KeyError when it isn't in the
+    fleet list — an unknown machine has no owner to guess)."""
+    return shard_map(names, count)[name]
+
+
+def owned_names(names: Iterable[str], spec: ShardSpec) -> List[str]:
+    """The machines shard ``spec.index`` of ``spec.count`` owns."""
+    return shard_slices(names, spec.count)[spec.index]
+
+
+class ShardRouter:
+    """Client-side affinity routing over an N-replica serving tier.
+
+    ``replica_urls`` is ordered by shard index (url ``i`` serves shard
+    ``i/N``); ``names`` is the FULL fleet machine list (from watchman or
+    a replica's project index — every replica reports it), never a
+    request's subset: the partition is defined over the whole fleet, and
+    a subset-derived table would route almost every machine wrong.
+    """
+
+    def __init__(self, names: Sequence[str], replica_urls: Sequence[str]):
+        if not replica_urls:
+            raise ValueError("ShardRouter needs at least one replica url")
+        self.replica_urls = list(replica_urls)
+        self._shard_of = shard_map(names, len(self.replica_urls))
+
+    def url_for(self, name: str) -> str:
+        """The owning replica's base url (KeyError for unknown machines —
+        surfaced to the caller as a per-machine error, not a guess)."""
+        return self.replica_urls[self._shard_of[name]]
+
+    def split(self, names: Iterable[str]) -> Dict[str, List[str]]:
+        """Scatter plan: ``{replica url: [its machines, in input order]}``
+        — only replicas that own at least one requested machine appear.
+        Input order is preserved per replica so gather-side reassembly in
+        the ORIGINAL machine order is a plain dict merge."""
+        out: Dict[str, List[str]] = {}
+        for name in names:
+            out.setdefault(self.url_for(name), []).append(name)
+        return out

@@ -119,6 +119,7 @@ async def discover_machines_ex(
     timeout: float = 5.0,
     session: Optional[aiohttp.ClientSession] = None,
     artifact_formats: Optional[Dict[str, str]] = None,
+    topology: Optional[Dict[str, Dict[str, Any]]] = None,
 ) -> "tuple[List[str], int]":
     """Like :func:`discover_machines` but also reports how many targets
     answered their index at all — callers evicting machines absent from
@@ -128,7 +129,15 @@ async def discover_machines_ex(
     ``artifact_formats``: optional dict the poll fills with each
     responding target's reported ``artifact-format`` (``v2-packs`` |
     ``v1-dirs``) — the fleet-wide artifact-discovery surface watchman
-    republishes, free-riding on the index responses already fetched."""
+    republishes, free-riding on the index responses already fetched.
+
+    ``topology``: optional dict the poll fills with each responding
+    target's routing identity — ``{"shard-index", "shard-count",
+    "fleet-generation", "machines"}`` (shard fields absent for an
+    unsharded target) — the one-endpoint routing-topology surface
+    watchman republishes so operators see which replica owns which
+    machines, and which artifact generation each replica serves, without
+    querying every server."""
     own_session = session is None
     session = session or aiohttp.ClientSession()
     names: List[str] = []
@@ -148,6 +157,17 @@ async def discover_machines_ex(
             n_responding += 1
             if artifact_formats is not None and body.get("artifact-format"):
                 artifact_formats[base] = str(body["artifact-format"])
+            if topology is not None:
+                entry: Dict[str, Any] = {
+                    "machines": list(body.get("machines") or []),
+                }
+                if body.get("fleet-generation") is not None:
+                    entry["fleet-generation"] = int(body["fleet-generation"])
+                shard = body.get("serve-shard") or {}
+                if shard:
+                    entry["shard-index"] = int(shard.get("index", 0))
+                    entry["shard-count"] = int(shard.get("count", 1))
+                topology[base] = entry
             for name in body.get("machines") or []:
                 if name not in names:
                     names.append(str(name))

@@ -38,6 +38,18 @@ _ENDPOINTS_GAUGE = telemetry.gauge(
     "Endpoints by health as of the latest poll",
     labels=("healthy",),
 )
+_TARGET_SHARD_GAUGE = telemetry.gauge(
+    "gordo_watchman_target_shard_index",
+    "Each target replica's serving shard index (routing topology; only "
+    "sharded targets report one)",
+    labels=("target",),
+)
+_TARGET_GENERATION_GAUGE = telemetry.gauge(
+    "gordo_watchman_target_fleet_generation",
+    "Each target replica's fleet-generation stamp — diverging values "
+    "across a sharded tier mean a rollout is mid-propagation",
+    labels=("target",),
+)
 
 
 class Watchman:
@@ -85,6 +97,12 @@ class Watchman:
         #: status document so a rollout to packed artifacts is visible
         #: fleet-wide without querying every server
         self.artifact_formats: Dict[str, str] = {}
+        #: per-target routing topology from the latest discovery poll
+        #: ({base_url: {shard-index, shard-count, fleet-generation,
+        #: machines}}) — republished in the status document AND as
+        #: per-target gauges on /metrics, so shard layout and rollout
+        #: generation are readable from ONE endpoint
+        self.serve_topology: Dict[str, Dict[str, Any]] = {}
         self._task: Optional[asyncio.Task] = None
         self._loop_ref: Optional[asyncio.AbstractEventLoop] = None
         self._wake: Optional[asyncio.Event] = None
@@ -109,12 +127,24 @@ class Watchman:
         targets = await self._current_targets()
         if self.discover:
             formats: Dict[str, str] = {}
+            topology: Dict[str, Dict[str, Any]] = {}
             discovered, n_responding = await discover_machines_ex(
                 self.project, targets, timeout=self.request_timeout,
-                artifact_formats=formats,
+                artifact_formats=formats, topology=topology,
             )
             if formats:
                 self.artifact_formats = formats
+            if topology:
+                self.serve_topology = topology
+                for base, entry in topology.items():
+                    if "shard-index" in entry:
+                        _TARGET_SHARD_GAUGE.set(
+                            float(entry["shard-index"]), base
+                        )
+                    if "fleet-generation" in entry:
+                        _TARGET_GENERATION_GAUGE.set(
+                            float(entry["fleet-generation"]), base
+                        )
             for name in discovered:
                 if name not in self.machines:
                     self.machines.append(name)
@@ -224,6 +254,13 @@ class Watchman:
             "uptime-seconds": round(time.time() - self.started_at, 1),
             "target-base-urls": self.target_base_urls,
             "artifact-formats": dict(self.artifact_formats),
+            # routing topology: each target's shard identity, fleet
+            # generation and served machines (empty entries for targets
+            # that never answered their index)
+            "serve-topology": {
+                base: dict(entry)
+                for base, entry in self.serve_topology.items()
+            },
             "endpoints": [
                 self.statuses[m].to_json()
                 for m in self.machines

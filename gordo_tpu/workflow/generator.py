@@ -344,13 +344,11 @@ def _builder_job(
                             ],
                             "env": [
                                 {"name": "PROJECT_NAME", "value": project},
-                                # production builds write artifact format
-                                # v2: one mmap-able pack per fleet chunk
-                                # on the models PVC instead of thousands
-                                # of per-machine dirs — the server's
-                                # zero-copy load path (gordo_tpu/artifacts/)
-                                {"name": "GORDO_ARTIFACT_FORMAT",
-                                 "value": "v2"},
+                                # artifact format v2 (one mmap-able pack
+                                # per fleet chunk, the server's zero-copy
+                                # load path) is the library default; set
+                                # GORDO_ARTIFACT_FORMAT=v1 here only for
+                                # tooling that needs per-machine dirs
                                 # shared persistent XLA compile cache: a
                                 # retried Job (and every worker of a
                                 # --multihost Indexed Job, which extends
@@ -393,9 +391,24 @@ def _server_deployment(
     server_args: Optional[List[str]] = None,
     scrape_annotations: bool = True,
     serve_dtype: Optional[str] = None,
+    shard: Optional[Any] = None,
 ) -> Dict:
+    """``shard`` (a ``serve.shard.ShardSpec``): emit one shard replica's
+    Deployment of a fleet-sharded serving tier — its own name/labels (so
+    per-shard Services select only it) and ``GORDO_SERVE_SHARD=i/N``
+    stamped in the pod env, which makes the server load, warm, and make
+    device-resident ONLY its shard's artifacts."""
+    component = "ml-server" if shard is None else f"ml-server-shard-{shard.index}"
+    name = f"gordo-server-{project}" + (
+        "" if shard is None else f"-shard-{shard.index}"
+    )
+    shard_env = (
+        []
+        if shard is None
+        else [{"name": "GORDO_SERVE_SHARD", "value": str(shard)}]
+    )
     template_meta: Dict[str, Any] = {
-        "labels": _labels(project, "ml-server"),
+        "labels": _labels(project, component),
     }
     if scrape_annotations:
         template_meta["annotations"] = _scrape_annotations(
@@ -405,12 +418,12 @@ def _server_deployment(
         "apiVersion": "apps/v1",
         "kind": "Deployment",
         "metadata": {
-            "name": f"gordo-server-{project}",
-            "labels": _labels(project, "ml-server"),
+            "name": name,
+            "labels": _labels(project, component),
         },
         "spec": {
             "replicas": replicas,
-            "selector": {"matchLabels": _labels(project, "ml-server")},
+            "selector": {"matchLabels": _labels(project, component)},
             "template": {
                 "metadata": template_meta,
                 "spec": {
@@ -436,6 +449,7 @@ def _server_deployment(
                             # time
                             "env": [
                                 _compile_cache_env(),
+                                *shard_env,
                                 *_serve_dtype_env(serve_dtype),
                             ],
                             "ports": [{"containerPort": DEFAULT_SERVER_PORT}],
@@ -487,10 +501,15 @@ def _service(project: str, component: str, port: int) -> Dict:
     }
 
 
-def _machine_mapping(project: str, machine: str) -> Dict:
-    """Ambassador-style route: per-machine URL → the shared server service
+def _machine_mapping(
+    project: str, machine: str, component: str = "ml-server"
+) -> Dict:
+    """Ambassador-style route: per-machine URL → the owning server service
     (the reference annotated one Mapping per machine Service; machines now
-    share one server, the outward URL contract is identical)."""
+    share one server — or, sharded, one replica — the outward URL contract
+    is identical).  With a sharded tier, ``component`` is the OWNING
+    shard's service, computed with the same shard function the servers
+    load with: ingress-level machine-affinity routing, no lookup hop."""
     return {
         "apiVersion": "getambassador.io/v2",
         "kind": "Mapping",
@@ -501,7 +520,55 @@ def _machine_mapping(project: str, machine: str) -> Dict:
         "spec": {
             "prefix": f"{API_PREFIX}/{project}/{machine}/",
             "rewrite": f"{API_PREFIX}/{project}/{machine}/",
-            "service": f"gordo-ml-server-{project}:{DEFAULT_SERVER_PORT}",
+            "service": f"gordo-{component}-{project}:{DEFAULT_SERVER_PORT}",
+        },
+    }
+
+
+def _server_hpa(
+    project: str, shard: Any, max_replicas: int = 4
+) -> Dict:
+    """HorizontalPodAutoscaler for one shard's Deployment, driven by the
+    queue-wait-vs-service-time telemetry the coalescer already exports:
+    ``gordo_coalesce_wait_service_ratio`` (p99 queue wait / median
+    service time, refreshed at scrape time on ``/metrics``).  The target
+    averageValue of 2 sits at HALF the coalescer's stand-down ratio (4):
+    the tier scales out while batching still wins, well before replicas
+    start shedding with 429.  Requires a prometheus adapter exposing the
+    gauge as a Pods metric — the scrape annotations are already stamped.
+    Scaling a shard Deployment adds replicas OF THAT SHARD (same machine
+    subset, load-balanced by its Service); the shard count itself is
+    static config, rendered at generation time."""
+    name = f"gordo-server-{project}-shard-{shard.index}"
+    return {
+        "apiVersion": "autoscaling/v2",
+        "kind": "HorizontalPodAutoscaler",
+        "metadata": {
+            "name": name,
+            "labels": _labels(project, f"ml-server-shard-{shard.index}"),
+        },
+        "spec": {
+            "scaleTargetRef": {
+                "apiVersion": "apps/v1",
+                "kind": "Deployment",
+                "name": name,
+            },
+            "minReplicas": 1,
+            "maxReplicas": max_replicas,
+            "metrics": [
+                {
+                    "type": "Pods",
+                    "pods": {
+                        "metric": {
+                            "name": "gordo_coalesce_wait_service_ratio"
+                        },
+                        "target": {
+                            "type": "AverageValue",
+                            "averageValue": "2",
+                        },
+                    },
+                }
+            ],
         },
     }
 
@@ -511,6 +578,7 @@ def _watchman_deployment(
     image: str,
     machines: List[str],
     scrape_annotations: bool = True,
+    targets: Optional[List[str]] = None,
 ) -> Dict:
     template_meta: Dict[str, Any] = {
         "labels": _labels(project, "watchman"),
@@ -543,8 +611,21 @@ def _watchman_deployment(
                             "args": [
                                 "--project", project,
                                 "--machines", ",".join(machines),
-                                "--target",
-                                f"http://gordo-ml-server-{project}:{DEFAULT_SERVER_PORT}",
+                                # one --target per serving service: the
+                                # whole tier when sharded (watchman polls
+                                # every replica and republishes each one's
+                                # shard index + fleet generation)
+                                *(
+                                    arg
+                                    for target in (
+                                        targets
+                                        or [
+                                            f"http://gordo-ml-server-{project}"
+                                            f":{DEFAULT_SERVER_PORT}"
+                                        ]
+                                    )
+                                    for arg in ("--target", target)
+                                ),
                                 "--port", str(DEFAULT_WATCHMAN_PORT),
                             ],
                             "ports": [{"containerPort": DEFAULT_WATCHMAN_PORT}],
@@ -566,6 +647,8 @@ def generate_workflow(
     multihost: Optional[int] = None,
     scrape_annotations: bool = True,
     serve_dtype: Optional[str] = None,
+    serve_shards: Optional[int] = None,
+    hpa_max_replicas: int = 4,
 ) -> List[Dict[str, Any]]:
     """Project config → list of k8s manifest dicts (+ the build plan as a
     ConfigMap so the cluster state carries the bucketing decision).
@@ -591,9 +674,31 @@ def generate_workflow(
     matches (the serving-precision plane's one-config contract).  Only
     set this after the fp32 parity suite passes for the project's model
     family (docs/perf.md "Serving precision").
+
+    ``serve_shards`` N>1: emit a fleet-sharded serving tier — one
+    Deployment + Service per shard index (``GORDO_SERVE_SHARD=i/N`` in
+    each pod env, so every replica loads only its shard's artifacts), an
+    HPA per shard driven by the coalescer's queue-wait/service-time
+    ratio gauge, per-machine Mappings routed to the OWNING shard's
+    service (the same shard function everywhere — docs/serving.md
+    "Sharded serving tier"), and the watchman polling every shard
+    service.  Refused when N exceeds the machine count, mirroring the
+    ``--multihost`` rule: machines are the atoms of the partition.
     """
     project = config.project_name
     machines = [m.name for m in config.machines]
+    if serve_shards is not None:
+        if serve_shards < 1:
+            raise ValueError(
+                f"serve_shards must be >= 1, got {serve_shards}"
+            )
+        if serve_shards > len(machines):
+            raise ValueError(
+                f"--serve-shards {serve_shards} exceeds the project's "
+                f"machine count ({len(machines)}): machines are the atoms "
+                f"of the serving partition, so extra replicas would own "
+                f"empty shards. Use --serve-shards <= {len(machines)}."
+            )
     if multihost is not None:
         if multihost < 1:
             raise ValueError(f"multihost must be >= 1, got {multihost}")
@@ -623,21 +728,64 @@ def generate_workflow(
                 project, image, tpu_resources, serve_dtype=serve_dtype
             )
         ]
+    sharded = serve_shards is not None and serve_shards > 1
+    if sharded:
+        from gordo_tpu.serve.shard import ShardSpec, shard_map
+
+        specs = [ShardSpec(i, serve_shards) for i in range(serve_shards)]
+        server_docs: List[Dict[str, Any]] = []
+        for spec in specs:
+            server_docs.append(
+                _server_deployment(
+                    project, image, server_replicas, server_args,
+                    scrape_annotations=scrape_annotations,
+                    serve_dtype=serve_dtype, shard=spec,
+                )
+            )
+            server_docs.append(
+                _service(
+                    project, f"ml-server-shard-{spec.index}",
+                    DEFAULT_SERVER_PORT,
+                )
+            )
+            server_docs.append(
+                _server_hpa(project, spec, max_replicas=hpa_max_replicas)
+            )
+        watchman_targets = [
+            f"http://gordo-ml-server-shard-{i}-{project}:"
+            f"{DEFAULT_SERVER_PORT}"
+            for i in range(serve_shards)
+        ]
+        owner = shard_map(machines, serve_shards)
+        mapping_component = {
+            m: f"ml-server-shard-{owner[m]}" for m in machines
+        }
+    else:
+        server_docs = [
+            _server_deployment(
+                project, image, server_replicas, server_args,
+                scrape_annotations=scrape_annotations,
+                serve_dtype=serve_dtype,
+            ),
+            _service(project, "ml-server", DEFAULT_SERVER_PORT),
+        ]
+        watchman_targets = [
+            f"http://gordo-ml-server-{project}:{DEFAULT_SERVER_PORT}"
+        ]
+        mapping_component = {m: "ml-server" for m in machines}
     docs: List[Dict[str, Any]] = [
         *builder_docs,
-        _server_deployment(
-            project, image, server_replicas, server_args,
-            scrape_annotations=scrape_annotations,
-            serve_dtype=serve_dtype,
-        ),
-        _service(project, "ml-server", DEFAULT_SERVER_PORT),
+        *server_docs,
         _watchman_deployment(
             project, image, machines,
             scrape_annotations=scrape_annotations,
+            targets=watchman_targets,
         ),
         _service(project, "watchman", DEFAULT_WATCHMAN_PORT),
     ]
-    docs.extend(_machine_mapping(project, m) for m in machines)
+    docs.extend(
+        _machine_mapping(project, m, mapping_component[m]) for m in machines
+    )
     if include_plan:
         docs.append(
             {
@@ -732,8 +880,8 @@ def generate_argo_workflow(
                             {"name": "PROJECT_NAME", "value": project},
                             # chunk tasks share one models PVC: each task
                             # writes its chunk's pack + an index merge
-                            # (flock-serialized), not per-machine dirs
-                            {"name": "GORDO_ARTIFACT_FORMAT", "value": "v2"},
+                            # (flock-serialized), not per-machine dirs —
+                            # the v2 library default
                             *_serve_dtype_env(serve_dtype),
                         ],
                         "resources": tpu_resources,

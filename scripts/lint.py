@@ -99,6 +99,22 @@ ARTIFACTS_DIR = os.path.join("gordo_tpu", "artifacts")
 ARTIFACTS_COPY_CALLS = {"stack", "concatenate", "vstack", "hstack"}
 ARTIFACTS_DEVICE_PUT_FN = "to_device"
 
+#: serve-path shard contract: the machine→replica partition has exactly
+#: ONE implementation (gordo_tpu/serve/shard.py, wrapping the builder's
+#: partition_machines).  Server, client, watchman and the workflow
+#: generator all compute it locally, so a second implementation that
+#: drifts by one machine silently misroutes that machine forever —
+#: reject direct partition_machines use AND ad-hoc shard arithmetic
+#: (``... % n_shards``, ``hash(name) % ...``) anywhere on the serve path
+#: outside the one module.
+SHARD_FN_MODULE = os.path.join("gordo_tpu", "serve", "shard.py")
+SHARD_PATH_DIRS = (
+    os.path.join("gordo_tpu", "serve"),
+    os.path.join("gordo_tpu", "client"),
+    os.path.join("gordo_tpu", "watchman"),
+    os.path.join("gordo_tpu", "workflow"),
+)
+
 
 def _jit_allowed(path: str) -> bool:
     norm = os.path.normpath(path)
@@ -213,6 +229,68 @@ def _artifacts_pack_findings(
                      " — the one counted whole-pack transfer is the only "
                      "allowed call site")
                 )
+    return findings
+
+
+def _shard_findings(path: str, tree: ast.AST, noqa_lines: set) -> List[Finding]:
+    """Flag serve-path shard computation outside the one shared shard
+    function (``gordo_tpu/serve/shard.py``): direct
+    ``partition_machines`` imports/references, and modulo arithmetic
+    involving shard-named operands or ``hash(...)`` (the classic ad-hoc
+    consistent-hash shortcut that silently disagrees with the real
+    partition)."""
+    norm = os.path.normpath(path)
+    parts = norm.split(os.sep)
+    if "tests" in parts or os.path.basename(norm).startswith("test_"):
+        return []
+    if norm.endswith(SHARD_FN_MODULE):
+        return []
+    if not any(d in norm for d in SHARD_PATH_DIRS):
+        return []
+    findings: List[Finding] = []
+
+    def _mentions_shard_or_hash(node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and "shard" in sub.id.lower():
+                return True
+            if isinstance(sub, ast.Attribute) and "shard" in sub.attr.lower():
+                return True
+            if (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Name)
+                and sub.func.id == "hash"
+            ):
+                return True
+        return False
+
+    for node in ast.walk(tree):
+        bad = None
+        if isinstance(node, ast.ImportFrom) and any(
+            a.name == "partition_machines" for a in node.names
+        ):
+            bad = "partition_machines import"
+        elif (
+            isinstance(node, ast.Name)
+            and node.id == "partition_machines"
+        ) or (
+            isinstance(node, ast.Attribute)
+            and node.attr == "partition_machines"
+        ):
+            bad = "partition_machines reference"
+        elif (
+            isinstance(node, ast.BinOp)
+            and isinstance(node.op, ast.Mod)
+            and not isinstance(node.left, ast.Constant)  # "%s" formatting
+            and _mentions_shard_or_hash(node)
+        ):
+            bad = "ad-hoc shard arithmetic (modulo)"
+        if bad and getattr(node, "lineno", 0) not in noqa_lines:
+            findings.append(
+                (path, node.lineno,
+                 f"{bad} on the serve path — the machine→replica "
+                 "partition has ONE implementation: go through "
+                 "gordo_tpu.serve.shard (shard_map/shard_of/owned_names)")
+            )
     return findings
 
 
@@ -385,6 +463,7 @@ def lint_file(path: str) -> List[Finding]:
 
     findings.extend(_d2h_findings(path, tree, noqa_lines))
     findings.extend(_host_math_findings(path, tree, noqa_lines))
+    findings.extend(_shard_findings(path, tree, noqa_lines))
     findings.extend(_jit_findings(path, tree, noqa_lines))
     findings.extend(_artifact_path_findings(path, tree, noqa_lines))
     findings.extend(_artifacts_pack_findings(path, tree, noqa_lines))

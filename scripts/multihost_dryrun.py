@@ -125,6 +125,12 @@ def build_argv(config_path, out_dir, reg_dir, extra=()):
         "--project-name", "mhdry",
         "--output-dir", out_dir,
         "--model-register-dir", reg_dir,
+        # the byte-identity contract this dryrun pins is defined at
+        # per-machine granularity; v1 dirs make it directly comparable
+        # (v2 pack chunking differs between a single-host and a sharded
+        # build by construction — pack-level parity is the artifact
+        # suite's job, tests/test_artifacts.py::TestV1V2Parity)
+        "--artifact-format", "v1",
         *extra,
     ]
 

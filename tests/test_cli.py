@@ -98,7 +98,8 @@ def test_build_project_cli(tmp_path):
     summary = json.loads(result.output.strip().splitlines()[-1])
     assert summary["n_machines"] == 1
     assert not summary["failed"]
-    assert os.path.isdir(out / "cli-machine")
+    from gordo_tpu import artifacts
+    assert "cli-machine" in artifacts.machines_on_disk(str(out))
 
 
 def test_workflow_generate_and_unique_tags(tmp_path):
@@ -161,8 +162,10 @@ def test_build_project_machines_filter(tmp_path):
     assert result.exit_code == 0, result.output
     summary = json.loads(result.output.strip().splitlines()[-1])
     assert summary["n_machines"] == 2
-    assert os.path.isdir(out / "flt-0") and os.path.isdir(out / "flt-2")
-    assert not os.path.isdir(out / "flt-1")
+    from gordo_tpu import artifacts
+    on_disk = artifacts.machines_on_disk(str(out))
+    assert {"flt-0", "flt-2"} <= on_disk
+    assert "flt-1" not in on_disk
 
     bad = runner.invoke(
         gordo,

@@ -11,6 +11,26 @@ from gordo_tpu.builder import build_project
 from gordo_tpu.parallel import fleet_mesh
 from gordo_tpu.workflow import NormalizedConfig, load_machine_config
 
+
+def _load_model(ref):
+    """Load a model from a build-result artifact ref — a v2 pack ref
+    (the library default now) or a v1 per-machine dir."""
+    from gordo_tpu import artifacts
+
+    if artifacts.is_pack_ref(ref):
+        directory, name = artifacts.parse_ref(ref)
+        return artifacts.PackStore(directory).load_model(name)
+    return serializer.load(ref)
+
+
+def _load_metadata(ref):
+    from gordo_tpu import artifacts
+
+    if artifacts.is_pack_ref(ref):
+        directory, name = artifacts.parse_ref(ref)
+        return artifacts.PackStore(directory).load_metadata(name)
+    return serializer.load_metadata(ref)
+
 # heavy integration module: excluded from the fast CI lane
 pytestmark = pytest.mark.slow
 
@@ -63,8 +83,8 @@ class TestBuildProject:
         assert not result.failed
 
         for name, path in result.artifacts.items():
-            model = serializer.load(path)
-            meta = serializer.load_metadata(path)
+            model = _load_model(path)
+            meta = _load_metadata(path)
             assert meta["name"] == name
             assert meta["model"]["fleet_built"] is True
             assert "cross_validation" in meta["model"]
@@ -266,7 +286,7 @@ def test_build_project_over_mesh_end_to_end(tmp_path):
 
     # artifacts load and score
     for name in ("mesh-ff-0", "mesh-lstm-0"):
-        det = serializer.load(result.artifacts[name])
+        det = _load_model(result.artifacts[name])
         n_feat = 3
         X = np.random.default_rng(0).standard_normal((40, n_feat)).astype(
             np.float32
@@ -382,7 +402,7 @@ def test_pad_lengths_keeps_rows_and_collapses_programs(tmp_path, monkeypatch):
     assert len(seen) == 1 and seen[0][0] == 144
     assert sorted(seen[0][1]) == [122, 128, 134]
 
-    meta = serializer.load_metadata(result.artifacts["pad-0"])
+    meta = _load_metadata(result.artifacts["pad-0"])
     assert meta["model"]["pad_lengths"] == pad
     assert meta["model"]["rows_trained"] == 122
 
@@ -424,14 +444,14 @@ def test_align_lengths_changes_cache_identity(tmp_path):
         machines, out, model_register_dir=reg, align_lengths=60,
     )
     assert first.fleet_built == ["ck-0"]
-    meta = serializer.load_metadata(first.artifacts["ck-0"])
+    meta = _load_metadata(first.artifacts["ck-0"])
     assert meta["model"]["align_lengths"] == 60
     assert meta["model"]["rows_trained"] % 60 == 0
 
     # same register dir, no alignment: MISS (rebuild), not a stale hit
     second = build_project(machines, out, model_register_dir=reg)
     assert second.fleet_built == ["ck-0"] and not second.cached
-    meta2 = serializer.load_metadata(second.artifacts["ck-0"])
+    meta2 = _load_metadata(second.artifacts["ck-0"])
     assert "align_lengths" not in meta2["model"]
 
     # aligned again: the aligned registry entry points at the dir the
@@ -441,7 +461,7 @@ def test_align_lengths_changes_cache_identity(tmp_path):
         machines, out, model_register_dir=reg, align_lengths=60,
     )
     assert third.fleet_built == ["ck-0"] and not third.cached
-    assert serializer.load_metadata(
+    assert _load_metadata(
         third.artifacts["ck-0"]
     )["model"]["align_lengths"] == 60
 
@@ -563,5 +583,5 @@ class TestAutoPad:
         )
         assert not result.failed
         assert result.auto_pad is None
-        meta = serializer.load_metadata(result.artifacts["ex-0"])
+        meta = _load_metadata(result.artifacts["ex-0"])
         assert meta["model"]["align_lengths"] == 60

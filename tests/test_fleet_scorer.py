@@ -11,7 +11,6 @@ from gordo_tpu.serve import ModelCollection, build_app
 from gordo_tpu.serve.fleet_scorer import FleetScorer
 from gordo_tpu.serve.scorer import CompiledScorer
 from gordo_tpu.workflow import NormalizedConfig
-from gordo_tpu import serializer
 
 # heavy integration module: excluded from the fast CI lane
 pytestmark = pytest.mark.slow
@@ -52,9 +51,12 @@ def models(tmp_path_factory):
     out = tmp_path_factory.mktemp("fs-artifacts")
     result = build_project(NormalizedConfig(PROJECT, "fsproj").machines, str(out))
     assert not result.failed
-    return {
-        name: serializer.load(path) for name, path in result.artifacts.items()
-    }, str(out)
+    # load through the artifact plane: the build now writes v2 packs by
+    # default, so result.artifacts values are pack refs, not dirs
+    from gordo_tpu import artifacts
+
+    _, refs = artifacts.discover(str(out))
+    return {r.name: r.load_model() for r in refs}, str(out)
 
 
 class TestFleetScorer:

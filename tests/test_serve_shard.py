@@ -1,0 +1,716 @@
+"""Fleet-sharded serving tier tests: the one shard function (client-
+computed == server-owned, every N), misroute 421s, scatter-gather
+reassembly, overload shedding (429 + Retry-After, honored by the
+client), warmup subsetting, the generator's sharded Deployments/HPA,
+watchman's topology republish, and the serve-path shard lint gate.
+The 2-replica sharded-vs-single byte-parity suite is slow-lane."""
+
+import asyncio
+import importlib.util
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from gordo_tpu.builder import build_project
+from gordo_tpu.serve import ModelCollection, build_app
+from gordo_tpu.serve.shard import (
+    ShardRouter,
+    ShardSpec,
+    owned_names,
+    shard_map,
+    shard_slices,
+)
+from gordo_tpu.workflow import NormalizedConfig
+
+MACHINES = [f"sh-{c}" for c in "abcdef"]
+
+PROJECT = {
+    "machines": [
+        {"name": name, "dataset": {
+            "type": "RandomDataset",
+            "tags": ["s-1", "s-2"],
+            "train_start_date": "2017-12-25T06:00:00Z",
+            "train_end_date": "2017-12-26T06:00:00Z",
+        }}
+        for name in MACHINES
+    ],
+    "globals": {
+        "model": {
+            "gordo_tpu.anomaly.diff.DiffBasedAnomalyDetector": {
+                "base_estimator": {
+                    "gordo_tpu.pipeline.Pipeline": {
+                        "steps": [
+                            "gordo_tpu.ops.scalers.MinMaxScaler",
+                            {"gordo_tpu.models.estimator.AutoEncoder": {
+                                "kind": "feedforward_hourglass",
+                                "epochs": 1,
+                                "batch_size": 64,
+                            }},
+                        ]
+                    }
+                }
+            }
+        }
+    },
+}
+
+X_ROWS = [[0.2, 0.7]] * 32
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    out = tmp_path_factory.mktemp("shard-artifacts")
+    cfg = NormalizedConfig(PROJECT, "shardproj")
+    result = build_project(cfg.machines, str(out))
+    assert not result.failed
+    return str(out)
+
+
+# ---------------------------------------------------------------------------
+# the shard function itself
+# ---------------------------------------------------------------------------
+
+class TestShardFunction:
+    def test_deterministic_disjoint_exhaustive(self):
+        import random
+
+        names = [f"m-{i:03d}" for i in range(23)]
+        shuffled = names[:]
+        random.Random(7).shuffle(shuffled)
+        for count in range(1, 6):
+            a = shard_slices(names, count)
+            assert a == shard_slices(shuffled, count)  # order-independent
+            flat = [n for shard in a for n in shard]
+            assert sorted(flat) == sorted(names)       # exhaustive
+            assert len(flat) == len(set(flat))         # disjoint
+            assert len(a) == count
+
+    def test_spec_parse_and_env(self, monkeypatch):
+        assert ShardSpec.parse("1/4") == ShardSpec(1, 4)
+        for bad in ("4/4", "-1/2", "x/2", "2", ""):
+            with pytest.raises(ValueError):
+                ShardSpec.parse(bad)
+        monkeypatch.setenv("GORDO_SERVE_SHARD", "2/3")
+        assert ShardSpec.from_env() == ShardSpec(2, 3)
+        monkeypatch.delenv("GORDO_SERVE_SHARD")
+        assert ShardSpec.from_env() is None
+
+    def test_router_split_preserves_input_order(self):
+        names = [f"m-{i}" for i in range(8)]
+        router = ShardRouter(names, ["http://a", "http://b"])
+        req = ["m-7", "m-0", "m-5", "m-1"]
+        plan = router.split(req)
+        reassembled = {n for members in plan.values() for n in members}
+        assert reassembled == set(req)
+        for url, members in plan.items():
+            assert members == [n for n in req if router.url_for(n) == url]
+
+
+# ---------------------------------------------------------------------------
+# server-side shard loading
+# ---------------------------------------------------------------------------
+
+class TestServerSharding:
+    @pytest.mark.parametrize("count", [2, 3, 4, 5])
+    def test_client_computed_equals_server_owned(self, model_dir, count):
+        """The acceptance contract: for every machine, the shard the
+        CLIENT computes locally is the shard whose SERVER actually loaded
+        that machine — across N=2..5."""
+        table = shard_map(MACHINES, count)
+        seen = {}
+        for index in range(count):
+            coll = ModelCollection.from_directory(
+                model_dir, project="shardproj",
+                shard=ShardSpec(index, count),
+            )
+            assert sorted(coll.entries) == owned_names(
+                MACHINES, ShardSpec(index, count)
+            )
+            assert coll.fleet_machines == sorted(MACHINES)
+            for name in coll.entries:
+                assert table[name] == index  # client table agrees
+                seen[name] = index
+        assert sorted(seen) == sorted(MACHINES)  # disjoint + exhaustive
+
+    def test_shard_from_env(self, model_dir, monkeypatch):
+        monkeypatch.setenv("GORDO_SERVE_SHARD", "0/2")
+        coll = ModelCollection.from_directory(model_dir, project="shardproj")
+        assert coll.shard == ShardSpec(0, 2)
+        assert sorted(coll.entries) == owned_names(MACHINES, ShardSpec(0, 2))
+
+    def test_misrouted_request_is_421_with_owner(self, model_dir):
+        spec = ShardSpec(0, 2)
+        foreign = owned_names(MACHINES, ShardSpec(1, 2))[0]
+
+        async def fn():
+            coll = ModelCollection.from_directory(
+                model_dir, project="shardproj", shard=spec
+            )
+            client = TestClient(TestServer(build_app(coll)))
+            await client.start_server()
+            try:
+                misrouted = await client.get(
+                    f"/gordo/v0/shardproj/{foreign}/healthcheck"
+                )
+                unknown = await client.get(
+                    "/gordo/v0/shardproj/not-a-machine/healthcheck"
+                )
+                owned = await client.get(
+                    f"/gordo/v0/shardproj/{sorted(coll.entries)[0]}"
+                    "/healthcheck"
+                )
+                body = await misrouted.json()
+                index = await client.get("/gordo/v0/shardproj/")
+                return (
+                    misrouted.status, unknown.status, owned.status,
+                    body, await index.json(),
+                )
+            finally:
+                await client.close()
+
+        mis, unk, own, body, index = asyncio.run(fn())
+        assert (mis, unk, own) == (421, 404, 200)
+        assert body["shard"] == 1 and body["shard-count"] == 2
+        # the routing-topology surface clients compute the table from
+        assert index["serve-shard"] == {"index": 0, "count": 2}
+        assert index["fleet-machines"] == sorted(MACHINES)
+        assert isinstance(index["fleet-generation"], int)
+        assert index["machines"] == owned_names(MACHINES, spec)
+
+    def test_warmup_filters_manifest_to_shard(self, model_dir):
+        from gordo_tpu.compile import (
+            filter_manifest,
+            load_warmup_manifest,
+            warmup_collection,
+        )
+
+        manifest = load_warmup_manifest(model_dir)
+        assert manifest is not None
+        sub = filter_manifest(manifest, {"sh-a", "sh-b"})
+        for entry in sub["programs"]:
+            assert set(entry["machines"]) <= {"sh-a", "sh-b"}
+            assert entry["n_machines"] == len(entry["machines"])
+        assert sub["row_buckets"] == manifest["row_buckets"]
+
+        coll = ModelCollection.from_directory(
+            model_dir, project="shardproj", shard=ShardSpec(0, 3)
+        )
+        stats = warmup_collection(coll)
+        assert stats["shard"] == "0/3"
+        assert stats["errors"] == 0
+
+
+# ---------------------------------------------------------------------------
+# overload shedding
+# ---------------------------------------------------------------------------
+
+class TestOverloadShedding:
+    def _post(self, model_dir, prime):
+        """Build a coalescing app, let ``prime(coalescer)`` set policy
+        state, POST one anomaly request, return (status, headers, body)."""
+
+        async def fn():
+            coll = ModelCollection.from_directory(
+                model_dir, project="shardproj"
+            )
+            app = build_app(coll, coalesce_window_ms=2.0)
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                from gordo_tpu.serve.server import COALESCER_KEY
+
+                prime(app[COALESCER_KEY])
+                resp = await client.post(
+                    "/gordo/v0/shardproj/sh-a/anomaly/prediction",
+                    json={"X": X_ROWS},
+                )
+                return resp.status, dict(resp.headers), await resp.json()
+            finally:
+                await client.close()
+
+        return asyncio.run(fn())
+
+    def test_escalated_standdown_sheds_429_with_retry_after(self, model_dir):
+        def prime(coalescer):
+            # second consecutive stand-down = the first cooldown doubling:
+            # the escalation threshold where queuing turns into shedding
+            coalescer._standdown_streak = 2
+            coalescer._standdown_until = time.monotonic() + 4.0
+            coalescer.last_wait_p99 = 2.5
+
+        status, headers, body = self._post(model_dir, prime)
+        assert status == 429
+        retry_after = int(headers["Retry-After"])
+        # derived from the observed queue wait / remaining cooldown,
+        # never a blind constant below either
+        assert retry_after >= 2
+        assert body["retry-after-seconds"] >= 2.5
+        assert "overloaded" in body["error"]
+
+    def test_first_standdown_does_not_shed(self, model_dir):
+        def prime(coalescer):
+            coalescer._standdown_streak = 1  # transient: route direct
+            coalescer._standdown_until = time.monotonic() + 4.0
+
+        status, _, body = self._post(model_dir, prime)
+        assert status == 200
+        assert "model-output" in body["data"]
+
+    def test_stats_and_gauges_expose_shedding(self, model_dir):
+        from gordo_tpu.serve import coalesce as coalesce_mod
+
+        coalescer = coalesce_mod.CoalescingScorer(lambda: None)
+        try:
+            assert coalesce_mod.stats(coalescer)["shedding"] is False
+            coalescer._standdown_streak = 2
+            coalescer._standdown_until = time.monotonic() + 2.0
+            coalescer.last_wait_p99 = 0.2
+            stats = coalesce_mod.stats(coalescer)
+            assert stats["shedding"] is True
+            ra = coalesce_mod.shed_retry_after(coalescer)
+            assert 1.0 <= ra <= coalesce_mod.SHED_RETRY_MAX_S
+        finally:
+            coalescer.close()
+
+
+class TestClientHonorsRetryAfter:
+    def _run(self, handler, **kw):
+        """Drive ``client.io.request_json`` against an in-process endpoint,
+        recording every retry sleep."""
+        from gordo_tpu.client import io as client_io
+
+        sleeps = []
+        real_sleep = asyncio.sleep
+
+        async def recording_sleep(delay, *a, **k):
+            sleeps.append(delay)
+            await real_sleep(0)
+
+        async def fn():
+            app = web.Application()
+            app.router.add_post("/score", handler)
+            server = TestServer(app)
+            await server.start_server()
+            orig = client_io.asyncio.sleep
+            client_io.asyncio.sleep = recording_sleep
+            try:
+                import aiohttp
+
+                async with aiohttp.ClientSession() as session:
+                    return await client_io.post_json(
+                        session, str(server.make_url("/score")), {"x": 1},
+                        **kw,
+                    )
+            finally:
+                client_io.asyncio.sleep = orig
+                await server.close()
+
+        return asyncio.run(fn()), sleeps
+
+    def test_retry_after_replaces_backoff_capped(self):
+        calls = []
+
+        async def handler(request):
+            calls.append(1)
+            if len(calls) == 1:
+                return web.json_response(
+                    {"error": "overloaded"}, status=429,
+                    headers={"Retry-After": "7"},
+                )
+            return web.json_response({"ok": True})
+
+        body, sleeps = self._run(handler, retries=3, backoff=0.01)
+        assert body == {"ok": True}
+        # 7s honored but capped at the schedule's max sleep (0.01 * 2^2)
+        assert sleeps == [pytest.approx(0.04)]
+
+    def test_small_retry_after_wins_over_backoff(self):
+        calls = []
+
+        async def handler(request):
+            calls.append(1)
+            if len(calls) == 1:
+                return web.json_response(
+                    {"error": "warming"}, status=503,
+                    headers={"Retry-After": "0"},
+                )
+            return web.json_response({"ok": True})
+
+        body, sleeps = self._run(handler, retries=3, backoff=0.5)
+        assert body == {"ok": True}
+        assert sleeps == [0.0]  # the server said "now"; not 0.5s
+
+    def test_no_header_keeps_exponential_schedule(self):
+        calls = []
+
+        async def handler(request):
+            calls.append(1)
+            if len(calls) < 3:
+                return web.json_response({"error": "boom"}, status=503)
+            return web.json_response({"ok": True})
+
+        body, sleeps = self._run(handler, retries=3, backoff=0.01)
+        assert body == {"ok": True}
+        assert sleeps == [pytest.approx(0.01), pytest.approx(0.02)]
+
+
+# ---------------------------------------------------------------------------
+# scatter-gather across real sharded replicas
+# ---------------------------------------------------------------------------
+
+async def _start_replicas(model_dir, count):
+    """N sharded TestServers + one unsharded, all over the same build."""
+    replicas = []
+    for index in range(count):
+        coll = ModelCollection.from_directory(
+            model_dir, project="shardproj", shard=ShardSpec(index, count)
+        )
+        client = TestClient(TestServer(build_app(coll)))
+        await client.start_server()
+        replicas.append(client)
+    single_coll = ModelCollection.from_directory(
+        model_dir, project="shardproj"
+    )
+    single = TestClient(TestServer(build_app(single_coll)))
+    await single.start_server()
+    return replicas, single
+
+
+@pytest.mark.slow
+def test_scatter_gather_byte_parity_and_order(model_dir):
+    """2-replica bulk scoring must return BYTE-identical arrays to the
+    single process, reassembled in the original machine order (the slow-
+    lane parity pin of the sharded tier)."""
+    from gordo_tpu.serve import codec
+
+    rng = np.random.default_rng(5)
+    X_by = {
+        name: rng.standard_normal((64, 2)).astype(np.float32)
+        for name in sorted(MACHINES, reverse=True)  # non-sorted order
+    }
+
+    async def fn():
+        replicas, single = await _start_replicas(model_dir, 2)
+        try:
+            urls = [str(r.server.make_url("")) for r in replicas]
+            router = ShardRouter(MACHINES, urls)
+            plan = router.split(X_by)
+            # scatter concurrently, msgpack wire (raw array bytes)
+            headers = {
+                "Content-Type": codec.MSGPACK_CONTENT_TYPE,
+                "Accept": codec.MSGPACK_CONTENT_TYPE,
+            }
+
+            async def post(client, members):
+                resp = await client.post(
+                    "/gordo/v0/shardproj/_bulk/anomaly/prediction",
+                    data=codec.packb(
+                        {"X": {m: X_by[m] for m in members}}
+                    ),
+                    headers=headers,
+                )
+                assert resp.status == 200
+                return codec.unpackb(await resp.read())["data"]
+
+            parts = await asyncio.gather(*(
+                post(replicas[urls.index(u)], members)
+                for u, members in plan.items()
+            ))
+            gathered = {}
+            for part in parts:
+                gathered.update(part)
+            sharded = {m: gathered[m] for m in X_by}  # machine order
+
+            resp = await single.post(
+                "/gordo/v0/shardproj/_bulk/anomaly/prediction",
+                data=codec.packb({"X": X_by}),
+                headers=headers,
+            )
+            assert resp.status == 200
+            single_out = codec.unpackb(await resp.read())["data"]
+            return sharded, single_out
+        finally:
+            for r in replicas:
+                await r.close()
+            await single.close()
+
+    sharded, single_out = asyncio.run(fn())
+    assert list(sharded) == list(X_by)  # original machine order
+    assert sorted(single_out) == sorted(sharded)
+    for name in X_by:
+        for key, value in single_out[name].items():
+            got = sharded[name][key]
+            if isinstance(value, np.ndarray):
+                assert got.dtype == value.dtype, (name, key)
+                assert np.array_equal(got, value), (name, key)
+            else:
+                assert got == value, (name, key)
+
+
+@pytest.mark.slow
+def test_client_routes_and_unions_across_replicas(model_dir):
+    """The bundled Client against a 2-replica tier: machine discovery
+    unions the shards, metadata requests route to the owning replica
+    (no 421s), and the lazily-built router matches the shared table."""
+    from gordo_tpu.client import Client
+
+    async def fn():
+        replicas, single = await _start_replicas(model_dir, 2)
+        try:
+            urls = [str(r.server.make_url("")) for r in replicas]
+            client = Client("shardproj", replica_urls=urls)
+            import aiohttp
+
+            async with aiohttp.ClientSession() as session:
+                await client._ensure_router(session)
+                names = await client.machine_names_async(session)
+                metas = {
+                    n: await client.machine_metadata_async(session, n)
+                    for n in names
+                }
+            table = shard_map(MACHINES, 2)
+            for name in MACHINES:
+                assert client._router.url_for(name) == urls[table[name]]
+            return names, metas
+        finally:
+            for r in replicas:
+                await r.close()
+            await single.close()
+
+    names, metas = asyncio.run(fn())
+    assert sorted(names) == sorted(MACHINES)
+    for name, meta in metas.items():
+        assert meta["name"] == name
+
+
+@pytest.mark.slow
+def test_rescan_routes_new_machine_to_its_owner(model_dir, tmp_path):
+    """A machine built AFTER startup lands on exactly its owning shard
+    at the next rescan; the other replica learns it fleet-wide (421,
+    not 404) without loading it."""
+    import shutil
+
+    live_dir = str(tmp_path / "live")
+    shutil.copytree(model_dir, live_dir)
+    colls = [
+        ModelCollection.from_directory(
+            live_dir, project="shardproj", shard=ShardSpec(i, 2)
+        )
+        for i in range(2)
+    ]
+    new_name = "sh-zz-late"
+    project = {
+        "machines": [dict(PROJECT["machines"][0], name=new_name)],
+        "globals": PROJECT["globals"],
+    }
+    result = build_project(
+        NormalizedConfig(project, "shardproj").machines, live_dir
+    )
+    assert not result.failed
+    for coll in colls:
+        coll.rescan()
+    fleet = sorted(MACHINES + [new_name])
+    owner = shard_map(fleet, 2)[new_name]
+    for i, coll in enumerate(colls):
+        assert coll.fleet_machines == fleet
+        assert (new_name in coll.entries) == (i == owner)
+        assert coll.shard_owner[new_name] == owner
+
+
+# ---------------------------------------------------------------------------
+# generator + watchman surfaces
+# ---------------------------------------------------------------------------
+
+class TestGeneratorShardedTier:
+    def _config(self):
+        return NormalizedConfig(PROJECT, "shardproj")
+
+    def test_sharded_deployments_services_hpa(self):
+        from gordo_tpu.workflow import generate_workflow
+
+        docs = generate_workflow(self._config(), serve_shards=2)
+        deploys = {
+            d["metadata"]["name"]: d for d in docs
+            if d["kind"] == "Deployment"
+            and "server" in d["metadata"]["name"]
+        }
+        assert sorted(deploys) == [
+            "gordo-server-shardproj-shard-0",
+            "gordo-server-shardproj-shard-1",
+        ]
+        for i, (_, dep) in enumerate(sorted(deploys.items())):
+            env = dep["spec"]["template"]["spec"]["containers"][0]["env"]
+            assert {"name": "GORDO_SERVE_SHARD", "value": f"{i}/2"} in env
+        hpas = [
+            d for d in docs
+            if d["kind"] == "HorizontalPodAutoscaler"
+        ]
+        assert len(hpas) == 2
+        for hpa in hpas:
+            metric = hpa["spec"]["metrics"][0]["pods"]["metric"]["name"]
+            assert metric == "gordo_coalesce_wait_service_ratio"
+        services = {
+            d["metadata"]["name"] for d in docs if d["kind"] == "Service"
+        }
+        assert "gordo-ml-server-shard-0-shardproj" in services
+        assert "gordo-ml-server-shard-1-shardproj" in services
+
+    def test_mappings_route_to_owning_shard(self):
+        from gordo_tpu.workflow import generate_workflow
+
+        docs = generate_workflow(self._config(), serve_shards=2)
+        table = shard_map(MACHINES, 2)
+        mappings = [d for d in docs if d["kind"] == "Mapping"]
+        assert len(mappings) == len(MACHINES)
+        for mapping in mappings:
+            machine = mapping["spec"]["prefix"].rstrip("/").split("/")[-1]
+            expected = (
+                f"gordo-ml-server-shard-{table[machine]}-shardproj:5555"
+            )
+            assert mapping["spec"]["service"] == expected
+
+    def test_watchman_targets_every_shard(self):
+        from gordo_tpu.workflow import generate_workflow
+
+        docs = generate_workflow(self._config(), serve_shards=3)
+        watchman = next(
+            d for d in docs
+            if d["kind"] == "Deployment"
+            and "watchman" in d["metadata"]["name"]
+        )
+        args = watchman["spec"]["template"]["spec"]["containers"][0]["args"]
+        targets = [args[i + 1] for i, a in enumerate(args) if a == "--target"]
+        assert targets == [
+            f"http://gordo-ml-server-shard-{i}-shardproj:5555"
+            for i in range(3)
+        ]
+
+    def test_refuses_more_shards_than_machines(self):
+        from gordo_tpu.workflow import generate_workflow
+
+        with pytest.raises(ValueError, match="exceeds the project's"):
+            generate_workflow(self._config(), serve_shards=7)
+
+    def test_unsharded_output_unchanged(self):
+        from gordo_tpu.workflow import generate_workflow
+
+        docs = generate_workflow(self._config())
+        assert not any(
+            d["kind"] == "HorizontalPodAutoscaler" for d in docs
+        )
+        deploys = [
+            d["metadata"]["name"] for d in docs if d["kind"] == "Deployment"
+        ]
+        assert "gordo-server-shardproj" in deploys
+
+
+def test_watchman_republishes_shard_topology(model_dir):
+    """Watchman's status document (and /metrics) must carry each target's
+    shard index + fleet generation — the one-endpoint routing-topology
+    view of the tier."""
+    from gordo_tpu.watchman import Watchman, build_watchman_app
+
+    async def fn():
+        replicas, single = await _start_replicas(model_dir, 2)
+        try:
+            urls = [str(r.server.make_url("")) for r in replicas]
+            watchman = Watchman(
+                "shardproj", machines=[], target_base_urls=urls,
+                poll_interval=3600,
+            )
+            wm_client = TestClient(
+                TestServer(build_watchman_app(watchman))
+            )
+            await wm_client.start_server()
+            try:
+                await watchman.refresh()
+                body = await (await wm_client.get("/")).json()
+                metrics = await (await wm_client.get("/metrics")).text()
+                return urls, body, metrics
+            finally:
+                await wm_client.close()
+        finally:
+            for r in replicas:
+                await r.close()
+            await single.close()
+
+    urls, body, metrics = asyncio.run(fn())
+    topo = body["serve-topology"]
+    assert set(topo) == set(urls)
+    for i, url in enumerate(urls):
+        assert topo[url]["shard-index"] == i
+        assert topo[url]["shard-count"] == 2
+        assert topo[url]["fleet-generation"] > 0
+        assert topo[url]["machines"] == owned_names(
+            MACHINES, ShardSpec(i, 2)
+        )
+    assert "gordo_watchman_target_shard_index" in metrics
+    assert "gordo_watchman_target_fleet_generation" in metrics
+
+
+# ---------------------------------------------------------------------------
+# lint gate
+# ---------------------------------------------------------------------------
+
+class TestShardLintGate:
+    @staticmethod
+    def _lint(path):
+        spec = importlib.util.spec_from_file_location(
+            "gordo_lint", os.path.join(
+                os.path.dirname(os.path.dirname(__file__)),
+                "scripts", "lint.py",
+            ),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.lint_file(path)
+
+    def test_partition_machines_rejected_on_serve_path(self, tmp_path):
+        bad = tmp_path / "gordo_tpu" / "client" / "thing.py"
+        bad.parent.mkdir(parents=True)
+        bad.write_text(
+            "from gordo_tpu.distributed.partition import "
+            "partition_machines\n"
+            "def route(ms):\n    return partition_machines(ms, 2)\n"
+        )
+        msgs = [f[2] for f in self._lint(str(bad))]
+        assert any("gordo_tpu.serve.shard" in m for m in msgs)
+
+    def test_adhoc_shard_modulo_rejected(self, tmp_path):
+        bad = tmp_path / "gordo_tpu" / "watchman" / "thing.py"
+        bad.parent.mkdir(parents=True)
+        bad.write_text(
+            "def owner(name, n_shards):\n"
+            "    return hash(name) % n_shards\n"
+        )
+        msgs = [f[2] for f in self._lint(str(bad))]
+        assert any("ad-hoc shard arithmetic" in m for m in msgs)
+
+    def test_shard_module_and_serve_path_clean(self):
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        for rel in (
+            os.path.join("gordo_tpu", "serve", "shard.py"),
+            os.path.join("gordo_tpu", "serve", "server.py"),
+            os.path.join("gordo_tpu", "client", "client.py"),
+            os.path.join("gordo_tpu", "watchman", "server.py"),
+            os.path.join("gordo_tpu", "workflow", "generator.py"),
+        ):
+            assert self._lint(os.path.join(repo, rel)) == [], rel
+
+
+def test_index_json_stays_parseable(model_dir):
+    """Guard: the sharded index additions stay JSON-serializable (ints,
+    lists — no numpy leakage through fleet-generation)."""
+    coll = ModelCollection.from_directory(
+        model_dir, project="shardproj", shard=ShardSpec(0, 2)
+    )
+    json.dumps({
+        "generation": coll.generation,
+        "fleet": coll.fleet_machines,
+        "owner": coll.shard_owner,
+    })

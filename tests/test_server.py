@@ -185,10 +185,10 @@ class TestServerRoutes:
 
 class TestCompiledScorer:
     def test_fused_matches_model_methods(self, model_dir):
-        import os
+        from gordo_tpu import artifacts
 
-        path = os.path.join(model_dir, "machine-a")
-        model = serializer.load(path)
+        _, refs = artifacts.discover(model_dir)
+        model = next(r for r in refs if r.name == "machine-a").load_model()
         scorer = CompiledScorer(model)
         assert scorer.fused
 
@@ -206,9 +206,10 @@ class TestCompiledScorer:
         )
 
     def test_shape_buckets_reuse_compilation(self, model_dir):
-        import os
+        from gordo_tpu import artifacts
 
-        model = serializer.load(os.path.join(model_dir, "machine-a"))
+        _, refs = artifacts.discover(model_dir)
+        model = next(r for r in refs if r.name == "machine-a").load_model()
         scorer = CompiledScorer(model)
         for n in (10, 40, 63, 64, 65, 200):
             out = scorer.predict(np.zeros((n, 3), np.float32))
@@ -457,12 +458,14 @@ def test_rescan_reloads_equal_or_older_mtime(model_dir, tmp_path):
     """VERDICT r3 weak #4: an artifact replaced with an equal-or-OLDER
     mtime (cache copy, clock skew) must still reload — comparison is !=."""
     import os
-    import shutil
 
+    from gordo_tpu import artifacts
     from gordo_tpu.serve.server import ModelCollection
 
+    # the (mtime, size) reload signal under test is the v1 per-machine-dir
+    # one: export a v1 view of the (now pack-default) build output
     live_dir = str(tmp_path / "older-mtime")
-    shutil.copytree(model_dir, live_dir)
+    artifacts.unpack(model_dir, live_dir)
     collection = ModelCollection.from_directory(live_dir, project="testproj")
     name = sorted(collection.entries)[0]
     old_model = collection.get(name).model

@@ -198,8 +198,11 @@ def _build_extra_machine(model_dir, name):
         }}],
         "globals": PROJECT["globals"],
     }
+    # v1 on purpose: these tests delete the machine again via rmtree of
+    # its per-machine dir (the mixed v1+v2 layout every reader handles)
     result = build_project(
-        NormalizedConfig(project, "wmproj").machines, model_dir
+        NormalizedConfig(project, "wmproj").machines, model_dir,
+        artifact_format="v1",
     )
     assert not result.failed
 
@@ -258,8 +261,12 @@ def test_collection_rescan_reloads_rebuilt_and_drops_removed(model_dir, tmp_path
     import shutil
     import time as time_mod
 
+    from gordo_tpu import artifacts
+
+    # v1 per-machine-dir semantics under test (mtime reload, rmtree
+    # removal): export a v1 view of the pack-default build output
     live_dir = str(tmp_path / "live2")
-    shutil.copytree(model_dir, live_dir)
+    artifacts.unpack(model_dir, live_dir)
     collection = ModelCollection.from_directory(live_dir, project="wmproj")
     old_model = collection.get("wm-machine").model
 
@@ -286,8 +293,10 @@ def test_watchman_evicts_machines_gone_from_every_index(model_dir, tmp_path):
     statically configured machines are never evicted."""
     import shutil
 
+    from gordo_tpu import artifacts
+
     live_dir = str(tmp_path / "evict")
-    shutil.copytree(model_dir, live_dir)
+    artifacts.unpack(model_dir, live_dir)  # v1 view: rmtree removes a machine
     _build_extra_machine(live_dir, "wm-doomed")
 
     async def main():
