@@ -112,6 +112,17 @@ def build_model(
         fit_duration=fit_duration,
         cv_meta=cv_meta,
     )
+    if cv_mode != "cross_val_only":
+        # training-time residual sketch: the fleet-health drift baseline
+        # (scored through the serving path; GORDO_FLEET_BASELINE=off
+        # skips, and non-anomaly models simply record none)
+        from gordo_tpu.telemetry.fleet_health import training_baseline
+
+        baseline = training_baseline(model, X_arr)
+        if baseline is not None:
+            build_metadata["fleet-health"] = {
+                "version": 1, "baseline": baseline,
+            }
     return model, build_metadata
 
 

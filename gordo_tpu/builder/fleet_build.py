@@ -750,6 +750,7 @@ def build_project(
                     *_chunk_payload(ok_chunk, detectors, fleet_seconds, loaded)
                 )
                 continue
+            baselines = _chunk_baselines(ok_chunk, detectors, loaded)
             for m, det in zip(ok_chunk, detectors):
                 _dump_machine(
                     m,
@@ -763,6 +764,7 @@ def build_project(
                     align_lengths=align_lengths,
                     pad_lengths=pad_lengths,
                     cache_key=machine_keys[m.name],
+                    baseline=baselines.get(m.name),
                 )
                 _done(m.name)
                 _free(loaded, [m.name])  # artifact on disk: arrays drop
@@ -804,6 +806,7 @@ def build_project(
             # byte-parity test pins pipelined == serial per machine, so
             # a config that DID diverge inside a chunk would be caught)
             chunk_definition = serializer.render_definition(detectors[0])
+            baselines = _chunk_baselines(ok_chunk, detectors, loaded)
             batch = []
             for m, det in zip(ok_chunk, detectors):
                 metadata = _machine_metadata(
@@ -815,6 +818,7 @@ def build_project(
                     align_lengths=align_lengths,
                     pad_lengths=pad_lengths,
                     cache_key=machine_keys[m.name],
+                    baseline=baselines.get(m.name),
                 )
                 _free(loaded, [m.name])  # arrays drop at enqueue, not write
                 batch.append(
@@ -853,15 +857,20 @@ def build_project(
     def _chunk_payload(ok_chunk, detectors, fleet_seconds, loaded) -> Tuple:
         """Assemble a v2 chunk's write payload (metadata closes over the
         training arrays, so they free HERE — at enqueue — keeping the
-        2-chunk peak_loaded bound independent of writer backlog)."""
+        2-chunk peak_loaded bound independent of writer backlog).
+        Fleet-health baselines sketch FIRST, while the chunk's training
+        arrays are still resident — one stacked scoring dispatch for the
+        whole chunk (telemetry.fleet_health.training_baselines)."""
         per_machine = fleet_seconds / len(ok_chunk)
         chunk_definition = serializer.render_definition(detectors[0])
+        baselines = _chunk_baselines(ok_chunk, detectors, loaded)
         metadatas = []
         for m, det in zip(ok_chunk, detectors):
             metadatas.append(_machine_metadata(
                 m, det, loaded[m.name], per_machine, fleet=True,
                 align_lengths=align_lengths, pad_lengths=pad_lengths,
                 cache_key=machine_keys[m.name],
+                baseline=baselines.get(m.name),
             ))
             _free(loaded, [m.name])
         names = [m.name for m in ok_chunk]
@@ -1018,6 +1027,19 @@ def _write_telemetry_snapshot(
         logger.exception("telemetry snapshot write failed: %s", path)
 
 
+def _chunk_baselines(ok_chunk, detectors, loaded) -> Dict[str, Any]:
+    """Training-time residual sketches for a just-trained chunk — ONE
+    stacked scoring dispatch over the still-resident training arrays
+    (the device-stage cost rides the same thread the chunk trained on,
+    like training itself).  ``GORDO_FLEET_BASELINE=off`` skips it."""
+    from gordo_tpu.telemetry import fleet_health
+
+    return fleet_health.training_baselines(
+        {m.name: det for m, det in zip(ok_chunk, detectors)},
+        {m.name: loaded[m.name][0] for m in ok_chunk if m.name in loaded},
+    )
+
+
 def _machine_metadata(
     m: Machine,
     detector,
@@ -1027,6 +1049,7 @@ def _machine_metadata(
     align_lengths: Optional[int] = None,
     pad_lengths: Optional[int] = None,
     cache_key: Optional[str] = None,
+    baseline: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Assemble one machine's artifact metadata — everything except the
     disk writes, so the pipelined path can free the training arrays at
@@ -1061,6 +1084,10 @@ def _machine_metadata(
     # detect that this dir was overwritten by a different build
     if cache_key is not None:
         metadata["cache_key"] = cache_key
+    if baseline is not None:
+        # the training-time residual distribution (fleet-health sketch):
+        # the serve plane loads it as the drift-comparison baseline
+        metadata["fleet-health"] = {"version": 1, "baseline": baseline}
     return metadata
 
 
@@ -1110,12 +1137,13 @@ def _dump_machine(
     align_lengths: Optional[int] = None,
     pad_lengths: Optional[int] = None,
     cache_key: Optional[str] = None,
+    baseline: Optional[Dict[str, Any]] = None,
 ) -> None:
     """Serial-path artifact dump: metadata + write + bookkeeping inline."""
     metadata = _machine_metadata(
         m, detector, loaded_entry, fit_seconds, fleet=fleet,
         align_lengths=align_lengths, pad_lengths=pad_lengths,
-        cache_key=cache_key,
+        cache_key=cache_key, baseline=baseline,
     )
     dest = os.path.join(output_dir, m.name)
     _write_artifact(detector, metadata, dest, model_register_dir, cache_key)

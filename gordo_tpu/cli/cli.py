@@ -771,16 +771,25 @@ def telemetry_group():
               help="Merge the shard-local snapshots a project build wrote "
                    "under DIR (a build --output-dir, or its "
                    ".gordo-telemetry/ subdir directly) and print the "
-                   "merged Prometheus text.")
+                   "merged result.")
 @click.option("--url", "scrape_url", default=None,
               help="Scrape a live server's /metrics (base URL or full "
                    "/metrics URL) and print it.")
-def telemetry_dump(snapshot_dir, scrape_url):
-    """Print a metrics snapshot as Prometheus text.
+@click.option("--format", "output_format",
+              type=click.Choice(["prom", "json"]), default="prom",
+              show_default=True,
+              help="Output format: Prometheus text exposition, or the "
+                   "JSON snapshot document (merge-able with "
+                   "telemetry.merge_snapshots). A live /metrics scrape "
+                   "only speaks prom.")
+def telemetry_dump(snapshot_dir, scrape_url, output_format):
+    """Print a metrics snapshot.
 
     Default (no option): this process's own registry — mostly useful under
     ``GORDO_SPAN_LOG``/scripted use.  ``--dir`` merges a (multi-host)
     build's shard-local snapshot files; ``--url`` scrapes a live server.
+    ``--format prom`` (default) prints the Prometheus text exposition,
+    ``--format json`` the JSON snapshot document.
     """
     if snapshot_dir and scrape_url:
         raise click.UsageError("--dir and --url are mutually exclusive")
@@ -798,12 +807,21 @@ def telemetry_dump(snapshot_dir, scrape_url):
             raise click.ClickException(
                 f"no telemetry snapshots under {candidates}"
             )
-        click.echo(
-            telemetry.render_snapshot(telemetry.merge_snapshots(snaps)),
-            nl=False,
-        )
+        merged = telemetry.merge_snapshots(snaps)
+        if output_format == "json":
+            click.echo(json.dumps(merged, indent=1, sort_keys=True))
+        else:
+            click.echo(telemetry.render_snapshot(merged), nl=False)
         return
     if scrape_url:
+        if output_format == "json":
+            # a /metrics scrape is already-rendered text; recovering the
+            # snapshot document from it would be a lossy reparse
+            raise click.UsageError(
+                "--format json is not available with --url (the scrape "
+                "surface speaks Prometheus text); use --dir or the "
+                "default registry dump"
+            )
         import urllib.request
 
         url = scrape_url.rstrip("/")
@@ -815,7 +833,94 @@ def telemetry_dump(snapshot_dir, scrape_url):
         except Exception as exc:
             raise click.ClickException(f"scrape {url} failed: {exc}")
         return
+    if output_format == "json":
+        click.echo(
+            json.dumps(telemetry.REGISTRY.snapshot(), indent=1,
+                       sort_keys=True)
+        )
+        return
     click.echo(telemetry.render(), nl=False)
+
+
+# ---------------------------------------------------------------------------
+# fleet health
+# ---------------------------------------------------------------------------
+
+@gordo.command("fleet-health")
+@click.option("--url", default=None,
+              help="Live surface: an ML-server base URL (the per-replica "
+                   "doc; merged fleet-wide when pointed at a watchman) — "
+                   "tries /gordo/v0/<project>/fleet-health, then the "
+                   "watchman's /fleet-health.")
+@click.option("--dir", "rollup_dir", default=None,
+              help="File surface: an artifact dir holding the rollup "
+                   "JSONL files serving processes append "
+                   "(.gordo-fleet-health/); the latest doc per "
+                   "process/shard is merged.")
+@click.option("--project", envvar="PROJECT_NAME", default="project",
+              show_default=True)
+@click.option("--top", default=10, show_default=True,
+              help="How many machines the drift ranking lists.")
+@click.option("--full/--summary", default=False, show_default=True,
+              help="--full prints the whole per-machine document "
+                   "(sketches included); the default summary prints "
+                   "counts by status and the top-drift ranking.")
+def fleet_health_cmd(url, rollup_dir, project, top, full):
+    """Which machines are drifting, scoring hot, or silent?
+
+    Prints the fleet-health document (docs/observability.md "Fleet
+    health"): per-machine live anomaly-score sketches vs their
+    training-time baselines, drift scores, and statuses — from a live
+    server/watchman (``--url``) or from the rollup files under an
+    artifact dir (``--dir``, no HTTP needed).
+    """
+    if bool(url) == bool(rollup_dir):
+        raise click.UsageError("provide exactly one of --url or --dir")
+    if rollup_dir:
+        docs = telemetry.load_rollups(rollup_dir)
+        if not docs:
+            raise click.ClickException(
+                f"no fleet-health rollups under {rollup_dir!r} "
+                f"(is the server writing them? GORDO_HEALTH_ROLLUP_SECONDS)"
+            )
+        doc = telemetry.merge_health_docs(docs, top=top)
+    else:
+        import urllib.error
+        import urllib.request
+
+        base = url.rstrip("/")
+        candidates = [
+            f"{base}/gordo/v0/{project}/fleet-health?top={int(top)}",
+            f"{base}/fleet-health?top={int(top)}",  # watchman surface
+        ]
+        doc = None
+        last_err = None
+        for candidate in candidates:
+            try:
+                with urllib.request.urlopen(candidate, timeout=30) as resp:
+                    doc = json.loads(resp.read().decode())
+                break
+            except Exception as exc:  # 404 on a watchman, conn errors
+                last_err = exc
+        if doc is None:
+            raise click.ClickException(
+                f"fleet-health fetch failed from {candidates}: {last_err}"
+            )
+    if full:
+        click.echo(json.dumps(doc, indent=1, sort_keys=True))
+        return
+    by_status: Dict[str, int] = {}
+    for entry in (doc.get("machines") or {}).values():
+        by_status[entry.get("status", "?")] = (
+            by_status.get(entry.get("status", "?"), 0) + 1
+        )
+    summary = {
+        "machines": len(doc.get("machines") or {}),
+        "by-status": dict(sorted(by_status.items())),
+        "drift-threshold": doc.get("drift-threshold"),
+        "top-drift": doc.get("top-drift", []),
+    }
+    click.echo(json.dumps(summary, indent=1, sort_keys=True))
 
 
 # ---------------------------------------------------------------------------

@@ -309,6 +309,41 @@ class Client:
     def artifact_info(self) -> Dict[str, Any]:
         return _run(self._with_session(self.artifact_info_async))
 
+    async def fleet_health_async(
+        self, session: aiohttp.ClientSession, top: Optional[int] = None
+    ) -> Dict[str, Any]:
+        """The fleet-health document: per-machine live score sketches,
+        build-time baselines, drift scores and statuses.
+
+        Against a sharded tier (``replica_urls``) every replica's doc is
+        fetched and merged client-side (sketches merge exactly), so the
+        caller sees ONE fleet view identical to what an unsharded server
+        would report.  ``top`` bounds the drift ranking."""
+        from gordo_tpu import telemetry
+
+        bases = (
+            self.replica_urls
+            if self.replica_urls and len(self.replica_urls) > 1
+            else [self.base_url]
+        )
+        query = f"?top={int(top)}" if top is not None else ""
+        docs: List[Dict[str, Any]] = []
+        for base in bases:
+            docs.append(await get_json(
+                session,
+                f"{self._project_url(base)}fleet-health{query}",
+                retries=self.n_retries, timeout=self.timeout,
+            ))
+        if len(docs) == 1:
+            return docs[0]
+        merged = telemetry.merge_health_docs(docs, top=top)
+        merged["project-name"] = self.project
+        merged["instances"] = list(bases)
+        return merged
+
+    def fleet_health(self, top: Optional[int] = None) -> Dict[str, Any]:
+        return _run(self._with_session(self.fleet_health_async, top))
+
     async def machine_metadata_async(
         self, session: aiohttp.ClientSession, machine: str
     ) -> Dict[str, Any]:

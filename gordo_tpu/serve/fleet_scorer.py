@@ -647,6 +647,8 @@ class FleetDispatch:
     def assemble(self) -> Dict[str, Dict[str, np.ndarray]]:
         """Slice each machine's rows out of the stacked host outputs and
         attach its thresholds; idempotent-safe (pending entries drain)."""
+        from gordo_tpu import telemetry
+
         pending, self._pending = self._pending, []
         for out, bucket, slots in pending:
             for name, slot, stack_pos, n_valid in slots:
@@ -661,6 +663,16 @@ class FleetDispatch:
                     res["total-anomaly-threshold"] = float(
                         bucket.agg_thresholds_np[stack_pos]
                     )
+                # fleet-health sketch per stacked machine: the output is
+                # already host numpy (device_get happened at dispatch),
+                # so recording here adds one bincount and no D2H.  The
+                # windows-bound and fallback paths record through their
+                # own named CompiledScorers instead — results landing
+                # directly in ``self.results`` never reach this loop, so
+                # nothing double-counts.
+                telemetry.FLEET_HEALTH.record(
+                    name, res.get("total-anomaly-score")
+                )
                 self.results[name] = res
         return self.results
 
@@ -685,7 +697,7 @@ class FleetScorer:
     def _machine_scorer(self, name: str) -> CompiledScorer:
         if name not in self._machine_scorers:
             self._machine_scorers[name] = CompiledScorer(
-                self.models[name], dtype=self.dtype
+                self.models[name], dtype=self.dtype, machine=name
             )
         return self._machine_scorers[name]
 
@@ -723,7 +735,7 @@ class FleetScorer:
             sig = _signature(chain) if chain else None
             if sig is None:
                 self.fallbacks[name] = CompiledScorer(
-                    model, dtype=self.dtype
+                    model, dtype=self.dtype, machine=name
                 )
                 continue
             names, chains = groups.setdefault(sig, ([], []))

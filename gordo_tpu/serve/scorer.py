@@ -331,17 +331,32 @@ class CompiledScorer:
     (``None`` resolves ``GORDO_SERVE_DTYPE`` per call — the env knob is
     live for tests and embedding callers; collections resolve once and
     pass it explicitly so a whole fleet serves one precision).
+
+    ``machine``: the fleet machine name this scorer serves, when known
+    (``ModelEntry`` and the fleet scorer's per-machine paths set it).
+    With a name, every anomaly response's total-anomaly-score array
+    folds into that machine's fleet-health sketch
+    (:mod:`gordo_tpu.telemetry.fleet_health`) — accumulated from the
+    host arrays already fetched for response encoding, so the hot path
+    pays one vectorized bincount and no extra D2H.  Nameless scorers
+    (ad-hoc/bench embedding) record nothing.
     """
 
     #: max retained pinned pad buffers (power-of-two row bucketing keeps
     #: distinct request shapes log-few; mirrors _Bucket.MAX_STACK_BUFS)
     MAX_PAD_BUFS = 4
 
-    def __init__(self, model, dtype: Optional[str] = None):
+    def __init__(
+        self,
+        model,
+        dtype: Optional[str] = None,
+        machine: Optional[str] = None,
+    ):
         self.model = model
         self.chain = _extract_chain(model)
         self.is_anomaly = isinstance(model, AnomalyDetectorBase)
         self.offset = getattr(model, "offset", 0)
+        self.machine = machine
         self._dtype = precision.canonical(dtype) if dtype else None
         #: pinned host pad buffers keyed by (bucket_rows, n_features),
         #: reused while request shapes repeat: padding writes ONE copy
@@ -538,6 +553,11 @@ class CompiledScorer:
                     result["anomaly-confidence"] = result[
                         "total-anomaly-score"
                     ] / max(float(det["aggregate_threshold"]), 1e-12)
+            # fleet-health sketch: fold the response's (already host-
+            # resident) total scores into this machine's live window
+            telemetry.FLEET_HEALTH.record(
+                self.machine, result["total-anomaly-score"]
+            )
             return result
         # fallback: the model's own pandas path
         frame = self.model.anomaly(X, y)
@@ -556,6 +576,9 @@ class CompiledScorer:
             result["anomaly-confidence"] = frame[
                 ("anomaly-confidence", "")
             ].to_numpy()
+        telemetry.FLEET_HEALTH.record(
+            self.machine, result["total-anomaly-score"]
+        )
         return result
 
 

@@ -32,6 +32,7 @@ from aiohttp import web
 
 import gordo_tpu
 from gordo_tpu import artifacts, serializer, telemetry
+from gordo_tpu.telemetry.fleet_health import drift_top_k
 from gordo_tpu.serve import codec
 from gordo_tpu.serve import coalesce as coalesce_mod
 from gordo_tpu.serve.scorer import CompiledScorer
@@ -153,7 +154,12 @@ class ModelEntry:
         self.directory = ref.ref
         self.model = ref.load_model()
         self.metadata = ref.load_metadata()
-        self.scorer = CompiledScorer(self.model, dtype=serve_dtype)
+        # machine= wires the single-machine scoring route into the
+        # fleet-health plane: every response's total scores fold into
+        # this machine's live sketch
+        self.scorer = CompiledScorer(
+            self.model, dtype=serve_dtype, machine=self.name
+        )
         self.mtime, self.size = ref.stat()
 
     @property
@@ -229,6 +235,12 @@ class ModelCollection:
         # swaps both from an executor thread while bulk requests lazily
         # build the scorer from other executor threads
         self._lock = threading.Lock()
+        # adopt the build-time residual baselines riding the artifact
+        # metadata — the reference distribution the drift signal (and
+        # `gordo refresh`, eventually) compares live sketches against
+        telemetry.FLEET_HEALTH.load_baselines(
+            {name: e.metadata for name, e in entries.items()}
+        )
 
     @property
     def fleet_scorer(self):
@@ -431,6 +443,16 @@ class ModelCollection:
                 self.entries = new_entries
                 self.pack_store = store
                 self._fleet_scorer = None  # stacked params must restack
+            # refresh drift baselines for (re)loaded artifacts — a
+            # rebuilt machine's NEW training distribution is the one its
+            # live window must be compared against from now on
+            telemetry.FLEET_HEALTH.load_baselines(
+                {
+                    name: new_entries[name].metadata
+                    for name in added + reloaded
+                    if name in new_entries
+                }
+            )
         # fleet view refreshes even when this shard's entries didn't
         # change: a machine added to ANOTHER shard must still 421-route
         # (not 404) from here, and the shard table must agree fleet-wide
@@ -959,10 +981,42 @@ async def metrics_endpoint(request: web.Request) -> web.Response:
         if collection.shard is not None:
             _SHARD_INDEX_GAUGE.set(collection.shard.index)
             _SHARD_COUNT_GAUGE.set(collection.shard.count)
+        # fleet-health gauges refresh at scrape time too: top-K by drift
+        # only (bounded cardinality on a 10k-machine fleet; the full
+        # per-machine set lives at /gordo/v0/<p>/fleet-health)
+        telemetry.FLEET_HEALTH.export_gauges(
+            machines=sorted(collection.entries)
+        )
     coalesce_mod.export_gauges(request.app.get(COALESCER_KEY))
     return web.Response(
         text=telemetry.render(), content_type=METRICS_CONTENT_TYPE
     )
+
+
+async def fleet_health(request: web.Request) -> web.Response:
+    """The full per-machine fleet-health document for THIS replica's
+    machines: live score sketch, build-time baseline, drift score and
+    status each, plus the top-K drift ranking (``?top=N`` overrides the
+    default).  Sharded replicas report their shard identity so
+    watchman's ``/fleet-health`` can merge N of these into one fleet
+    view (sketches merge exactly — see telemetry/fleet_health.py)."""
+    collection: ModelCollection = request.app[COLLECTION_KEY]
+    try:
+        top = int(request.query.get("top", "") or drift_top_k())
+    except ValueError:
+        return web.json_response(
+            {"error": "top must be an integer"}, status=400
+        )
+    doc = telemetry.FLEET_HEALTH.doc(
+        machines=sorted(collection.entries), top=top
+    )
+    doc["project-name"] = collection.project
+    if collection.shard is not None:
+        doc["serve-shard"] = {
+            "index": collection.shard.index,
+            "count": collection.shard.count,
+        }
+    return web.json_response(doc)
 
 
 async def project_index(request: web.Request) -> web.Response:
@@ -1046,9 +1100,15 @@ def build_app(
     warmup: bool = False,
     coalesce_min_concurrency: int = 2,
     coalesce_knee_batch: int = 0,
+    health_rollup_interval: float = 0.0,
 ) -> web.Application:
     """``rescan_interval > 0`` starts a background artifact-dir rescan so
     machines built after startup begin serving without a restart.
+    ``health_rollup_interval > 0`` periodically appends this replica's
+    fleet-health doc as one JSONL line under the artifact dir
+    (``.gordo-fleet-health/``, size-capped keep-last-2 rotation) — the
+    no-HTTP interface a ``gordo refresh`` loop (ROADMAP item 3) and
+    ``gordo fleet-health --dir`` consume.
     ``coalesce_window_ms > 0`` micro-batches concurrent single-machine
     anomaly requests into stacked fleet dispatches (``serve/coalesce.py``):
     a continuous drain groups whatever is queued, capping each dispatch at
@@ -1173,6 +1233,58 @@ def build_app(
         app.on_startup.append(_start)
         app.on_cleanup.append(_stop)
 
+    if health_rollup_interval > 0 and collection.source_dir is not None:
+
+        def _write_health_rollup() -> None:
+            doc = telemetry.FLEET_HEALTH.doc(
+                machines=sorted(collection.entries)
+            )
+            doc["project-name"] = collection.project
+            if collection.shard is not None:
+                doc["serve-shard"] = {
+                    "index": collection.shard.index,
+                    "count": collection.shard.count,
+                }
+            telemetry.write_rollup(
+                collection.source_dir, doc, shard=collection.shard
+            )
+
+        async def _rollup_loop(app: web.Application):
+            loop = asyncio.get_running_loop()
+            while True:
+                await asyncio.sleep(health_rollup_interval)
+                try:
+                    # the doc build walks every machine's sketch — off
+                    # the accept loop like the rescan
+                    await loop.run_in_executor(None, _write_health_rollup)
+                except Exception:
+                    logger.exception("fleet-health rollup failed")
+
+        async def _start_rollup(app: web.Application):
+            app["_health_rollup_task"] = (
+                asyncio.get_running_loop().create_task(_rollup_loop(app))
+            )
+
+        async def _stop_rollup(app: web.Application):
+            task = app.get("_health_rollup_task")
+            if task is not None:
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+            # last-gasp rollup at shutdown so a clean drain leaves the
+            # freshest doc on disk for the file-interface consumers
+            try:
+                await asyncio.get_running_loop().run_in_executor(
+                    None, _write_health_rollup
+                )
+            except Exception:
+                logger.exception("final fleet-health rollup failed")
+
+        app.on_startup.append(_start_rollup)
+        app.on_cleanup.append(_stop_rollup)
+
     # scrape surface at the conventional root path (no project segment:
     # one process = one scrape target, whatever it hosts)
     app.router.add_get("/metrics", metrics_endpoint)
@@ -1181,6 +1293,9 @@ def build_app(
     p = f"{API_PREFIX}/{{project}}"
     app.router.add_get(f"{p}/", project_index)
     app.router.add_get(f"{p}/ready", readiness)
+    # the fleet-under-observation surface (per-machine drift/sketches);
+    # registered before the {machine} routes like _bulk
+    app.router.add_get(f"{p}/fleet-health", fleet_health)
     # registered before the {machine} routes so "_bulk" never resolves as a
     # machine name
     app.router.add_post(f"{p}/_bulk/anomaly/prediction", bulk_anomaly_prediction)
@@ -1204,6 +1319,7 @@ def run_server(
     model_parallel: bool = False,
     warmup: bool = False,
     shard: Optional[str] = None,
+    health_rollup_interval: Optional[float] = None,
 ) -> None:
     """Blocking entrypoint (reference: ``gordo run-server``).
 
@@ -1215,7 +1331,18 @@ def run_server(
     — serve only shard i of an N-replica fleet-sharded tier; default is
     the ``GORDO_SERVE_SHARD`` env var (what the generated per-shard
     Deployments stamp), else unsharded.
+
+    ``health_rollup_interval``: seconds between fleet-health JSONL
+    rollup lines under the artifact dir (default: the
+    ``GORDO_HEALTH_ROLLUP_SECONDS`` env var, else 60; 0 disables).
     """
+    if health_rollup_interval is None:
+        try:
+            health_rollup_interval = float(
+                os.environ.get("GORDO_HEALTH_ROLLUP_SECONDS", "") or 60.0
+            )
+        except ValueError:
+            health_rollup_interval = 60.0
     from gordo_tpu.serve.shard import ShardSpec
 
     if isinstance(shard, str):
@@ -1258,6 +1385,7 @@ def run_server(
             coalesce_min_concurrency=coalesce_min_concurrency,
             coalesce_knee_batch=coalesce_knee_batch,
             warmup=warmup,
+            health_rollup_interval=health_rollup_interval,
         ),
         host=host,
         port=port,

@@ -9,6 +9,10 @@ The telemetry plane every layer reports through:
 - :mod:`gordo_tpu.telemetry.spans` — wall-clock trace spans with a
   context-propagated trace id (``X-Gordo-Trace-Id`` header), layered on
   top of the opt-in ``utils/profiling.trace`` jax-profiler hook.
+- :mod:`gordo_tpu.telemetry.fleet_health` — per-machine anomaly-score
+  distribution sketches (mergeable log-bucket histograms), build-time
+  baselines, and the baseline-vs-live drift signal behind the
+  ``gordo_machine_*`` gauges, ``/fleet-health`` docs, and rollup files.
 
 Kill switch: ``GORDO_TELEMETRY=off`` (or :func:`set_enabled`) turns every
 record call into a cheap no-op; ``bench.py --stage telemetry_overhead``
@@ -31,6 +35,17 @@ from gordo_tpu.telemetry.metrics import (  # noqa: F401
     render_snapshot,
     set_enabled,
 )
+from gordo_tpu.telemetry.fleet_health import (  # noqa: F401
+    FLEET_HEALTH,
+    FleetHealth,
+    ScoreSketch,
+    drift_score,
+    load_rollups,
+    merge_health_docs,
+    normalize_health_doc,
+    sketch_from_scores,
+    write_rollup,
+)
 from gordo_tpu.telemetry.spans import (  # noqa: F401
     TRACE_HEADER,
     current_trace_id,
@@ -45,25 +60,34 @@ from gordo_tpu.telemetry.spans import (  # noqa: F401
 SNAPSHOT_DIR = ".gordo-telemetry"
 
 __all__ = [
+    "FLEET_HEALTH",
+    "FleetHealth",
     "REGISTRY",
     "MetricsRegistry",
     "SNAPSHOT_DIR",
+    "ScoreSketch",
     "TRACE_HEADER",
     "add_instance_label",
     "counter",
+    "drift_score",
     "current_trace_id",
     "enabled",
     "ensure_trace_id",
     "gauge",
     "histogram",
+    "load_rollups",
     "load_snapshot_dir",
     "log_event",
     "merge_expositions",
+    "merge_health_docs",
     "merge_snapshots",
     "new_trace_id",
+    "normalize_health_doc",
     "render",
     "render_snapshot",
     "set_enabled",
     "set_trace_id",
+    "sketch_from_scores",
     "span",
+    "write_rollup",
 ]

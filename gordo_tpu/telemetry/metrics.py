@@ -125,6 +125,15 @@ class _Metric:
         lines.extend(self._series_lines())
         return lines
 
+    def reset_series(self) -> None:
+        """Drop every label series (values AND label sets).  Exists for
+        scrape-time-refreshed bounded-cardinality exports — the fleet
+        health plane re-publishes only the current top-K machines per
+        scrape, and without a reset a machine rotating OUT of the top-K
+        would leave its stale sample on /metrics forever."""
+        with self._lock:
+            self._series.clear()
+
     def _label_str(self, key: Tuple[str, ...], extra: str = "") -> str:
         parts = [
             f'{n}="{_escape_label(v)}"'

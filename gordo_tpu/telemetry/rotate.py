@@ -1,0 +1,64 @@
+"""Size-capped JSONL appends with keep-last-2 rotation.
+
+Shared by the span log (``GORDO_SPAN_LOG`` — which previously grew
+unboundedly on long-lived servers) and the fleet-health rollup files:
+both are append-only operational JSONL streams whose old tail matters
+far less than bounding disk use.  Rotation is rename-based (``path`` →
+``path.1``, replacing the previous ``.1``), so a reader always sees at
+most two files and the live file never exceeds ~max_bytes + one line.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+#: one lock for all rotating appenders in the process: appends are rare
+#: (per span / per rollup tick) and a shared lock keeps the
+#: check-size → rotate → append sequence atomic across streams sharing
+#: a path (two threads rotating the same file concurrently would drop a
+#: generation)
+_LOCK = threading.Lock()
+
+
+def rotated_path(path: str) -> str:
+    """Where the previous generation lives after a rotation."""
+    return path + ".1"
+
+
+def rotate_if_large(path: str, max_bytes: int) -> bool:
+    """Rotate ``path`` to ``path.1`` when it has reached ``max_bytes``
+    (the old ``.1`` is replaced — keep-last-2).  Returns True when a
+    rotation happened.  Caller holds no lock; this takes the module
+    lock itself."""
+    with _LOCK:
+        return _rotate_locked(path, max_bytes)
+
+
+def _rotate_locked(path: str, max_bytes: int) -> bool:
+    if max_bytes <= 0:
+        return False
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return False  # nothing there yet
+    if size < max_bytes:
+        return False
+    os.replace(path, rotated_path(path))
+    return True
+
+
+def append_jsonl_line(
+    path: str, line: str, max_bytes: Optional[int] = None
+) -> None:
+    """Append one line to ``path`` (creating parent dirs), rotating
+    first when the file already holds ``max_bytes`` — the line that
+    crosses the cap starts the next generation, so no single append is
+    ever split across files."""
+    with _LOCK:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        if max_bytes:
+            _rotate_locked(path, max_bytes)
+        with open(path, "a") as fh:
+            fh.write(line.rstrip("\n") + "\n")

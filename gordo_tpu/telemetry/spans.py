@@ -16,6 +16,9 @@ log, cheap enough for every request.
 Span log: set ``GORDO_SPAN_LOG=/path/spans.jsonl`` and every finished
 span appends one JSON line ``{ts, trace, span, seconds, ...attrs}``.
 Off by default — the histograms alone carry the aggregate signal.
+The file is size-capped: at ``GORDO_SPAN_LOG_MAX_BYTES`` (default
+64 MiB) it rotates to ``spans.jsonl.1``, keeping the last 2 files — a
+long-lived server under heavy traffic previously grew it unboundedly.
 """
 
 from __future__ import annotations
@@ -31,6 +34,7 @@ import uuid
 from typing import Any, Dict, Iterator, Optional
 
 from gordo_tpu.telemetry import metrics
+from gordo_tpu.telemetry.rotate import append_jsonl_line
 
 logger = logging.getLogger(__name__)
 
@@ -40,6 +44,11 @@ logger = logging.getLogger(__name__)
 TRACE_HEADER = "X-Gordo-Trace-Id"
 
 ENV_SPAN_LOG = "GORDO_SPAN_LOG"
+ENV_SPAN_LOG_MAX_BYTES = "GORDO_SPAN_LOG_MAX_BYTES"
+
+#: span-log rotation threshold (bytes); the crossing line starts the
+#: next generation and the previous one survives as ``<path>.1``
+DEFAULT_SPAN_LOG_MAX_BYTES = 64 * 1024 * 1024
 
 _trace_id: "contextvars.ContextVar[Optional[str]]" = contextvars.ContextVar(
     "gordo_trace_id", default=None
@@ -83,6 +92,16 @@ def span_log_path() -> Optional[str]:
     return os.environ.get(ENV_SPAN_LOG) or None
 
 
+def span_log_max_bytes() -> int:
+    try:
+        return int(
+            os.environ.get(ENV_SPAN_LOG_MAX_BYTES, "")
+            or DEFAULT_SPAN_LOG_MAX_BYTES
+        )
+    except ValueError:
+        return DEFAULT_SPAN_LOG_MAX_BYTES
+
+
 def _write_span_line(doc: Dict[str, Any]) -> None:
     path = span_log_path()
     if not path:
@@ -90,8 +109,9 @@ def _write_span_line(doc: Dict[str, Any]) -> None:
     try:
         line = json.dumps(doc)
         with _log_lock:
-            with open(path, "a") as f:
-                f.write(line + "\n")
+            # size-capped keep-last-2 rotation: a busy server's span log
+            # is bounded at ~2x the cap instead of growing forever
+            append_jsonl_line(path, line, max_bytes=span_log_max_bytes())
     except Exception:  # the span log must never break the traced path
         logger.exception("span log append failed")
 

@@ -22,6 +22,20 @@ from gordo_tpu import telemetry
 
 API_PREFIX = "/gordo/v0"
 
+#: scrape failures were previously SILENT in the merged exposition — a
+#: target contributing nothing is indistinguishable from a target with
+#: no series unless someone reads watchman's logs.  Now every failed
+#: target scrape counts here (labelled ``target=`` like watchman's
+#: other per-target series: the merge adds ``instance="watchman"`` to
+#: watchman's own samples, so an ``instance`` label here would collide)
+#: and the last error text is republished in the status doc's
+#: ``scrape-status``.
+_SCRAPE_FAILURES = telemetry.counter(
+    "gordo_watchman_scrape_failures_total",
+    "Failed /metrics scrapes of target servers, by target base url",
+    labels=("target",),
+)
+
 
 @dataclasses.dataclass
 class EndpointStatus:
@@ -182,6 +196,7 @@ async def scrape_metrics(
     timeout: float = 5.0,
     session: Optional[aiohttp.ClientSession] = None,
     extra: Optional[Sequence[Tuple[str, str]]] = None,
+    errors: Optional[Dict[str, str]] = None,
 ) -> Tuple[str, int]:
     """Scrape every target server's ``/metrics`` and merge them into one
     Prometheus exposition with per-target ``instance`` labels.
@@ -189,11 +204,14 @@ async def scrape_metrics(
     Merging is label-tagging, never arithmetic: summing a ``batch_cap``
     gauge across servers would manufacture a number nobody set, so each
     target's series stay distinct under its ``instance=<base_url>``.
-    Returns ``(merged_text, n_responding)`` — unreachable targets simply
-    contribute nothing (their absence IS the signal; the health poll
-    reports them unhealthy separately).  ``extra`` adds local
-    ``(instance, exposition)`` pairs (e.g. the caller's own registry) to
-    the same merge so the output is ONE spec-valid document."""
+    Returns ``(merged_text, n_responding)`` — an unreachable target
+    contributes no series, but its failure is no longer silent: it
+    counts in ``gordo_watchman_scrape_failures_total{instance=...}``
+    (which rides the merged exposition itself) and lands in ``errors``
+    when the caller passes a dict (the status doc's per-target
+    last-error surface).  ``extra`` adds local ``(instance,
+    exposition)`` pairs (e.g. the caller's own registry) to the same
+    merge so the output is ONE spec-valid document."""
     own_session = session is None
     session = session or aiohttp.ClientSession()
     pairs: List[Tuple[str, str]] = []
@@ -207,11 +225,19 @@ async def scrape_metrics(
                     timeout=aiohttp.ClientTimeout(total=timeout),
                 ) as resp:
                     if resp.status != 200:
+                        _SCRAPE_FAILURES.inc(1.0, base)
+                        if errors is not None:
+                            errors[base] = f"HTTP {resp.status}"
                         return
                     text = await resp.text()
-            except (aiohttp.ClientError, asyncio.TimeoutError):
+            except (aiohttp.ClientError, asyncio.TimeoutError) as exc:
+                _SCRAPE_FAILURES.inc(1.0, base)
+                if errors is not None:
+                    errors[base] = f"{type(exc).__name__}: {exc}"
                 return
             n_responding += 1
+            if errors is not None:
+                errors.pop(base, None)
             pairs.append((base, text))
 
         await asyncio.gather(*(one(b) for b in base_urls))
@@ -221,6 +247,51 @@ async def scrape_metrics(
     pairs.sort()  # deterministic output regardless of response order
     pairs.extend(extra or ())
     return telemetry.merge_expositions(pairs), n_responding
+
+
+async def fetch_fleet_health(
+    project: str,
+    base_urls: Sequence[str],
+    timeout: float = 5.0,
+    session: Optional[aiohttp.ClientSession] = None,
+    top: Optional[int] = None,
+) -> Tuple[List[Dict[str, Any]], List[str]]:
+    """Each target's ``GET /gordo/v0/<project>/fleet-health`` doc.
+
+    Returns ``(docs, responding_targets)`` — per-shard health docs ready
+    for :func:`gordo_tpu.telemetry.merge_health_docs` (sketches are
+    exactly mergeable, so a sharded tier's merged view equals a
+    single-process one).  Unreachable targets contribute nothing; the
+    caller reports them via the health poll as usual."""
+    own_session = session is None
+    session = session or aiohttp.ClientSession()
+    docs: List[Dict[str, Any]] = []
+    responding: List[str] = []
+    try:
+        async def one(base: str) -> None:
+            url = f"{base}{API_PREFIX}/{project}/fleet-health"
+            if top is not None:
+                url += f"?top={int(top)}"
+            try:
+                async with session.get(
+                    url, timeout=aiohttp.ClientTimeout(total=timeout)
+                ) as resp:
+                    if resp.status != 200:
+                        return
+                    doc = await resp.json()
+            except (aiohttp.ClientError, asyncio.TimeoutError, ValueError):
+                return
+            if doc.get("gordo-fleet-health"):
+                docs.append(doc)
+                responding.append(base)
+
+        await asyncio.gather(*(one(b) for b in base_urls))
+    finally:
+        if own_session:
+            await session.close()
+    # deterministic merge order regardless of response arrival
+    order = sorted(range(len(responding)), key=lambda i: responding[i])
+    return [docs[i] for i in order], sorted(responding)
 
 
 async def poll_endpoints(

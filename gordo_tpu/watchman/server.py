@@ -21,6 +21,7 @@ from gordo_tpu import telemetry
 from gordo_tpu.watchman.endpoints_status import (
     EndpointStatus,
     discover_machines_ex,
+    fetch_fleet_health,
     poll_endpoints,
     scrape_metrics,
 )
@@ -103,6 +104,11 @@ class Watchman:
         #: per-target gauges on /metrics, so shard layout and rollout
         #: generation are readable from ONE endpoint
         self.serve_topology: Dict[str, Dict[str, Any]] = {}
+        #: per-target last scrape error ({base_url: message}) from the
+        #: most recent /metrics fan-out — a target that stops answering
+        #: its scrape is now visible in the status doc, not just as a
+        #: silently-thinner merged exposition
+        self.scrape_errors: Dict[str, str] = {}
         self._task: Optional[asyncio.Task] = None
         self._loop_ref: Optional[asyncio.AbstractEventLoop] = None
         self._wake: Optional[asyncio.Event] = None
@@ -261,6 +267,13 @@ class Watchman:
                 base: dict(entry)
                 for base, entry in self.serve_topology.items()
             },
+            # per-target scrape health: last /metrics fan-out error per
+            # target (absent entry = last scrape succeeded); counts live
+            # in gordo_watchman_scrape_failures_total{instance=...}
+            "scrape-status": {
+                base: {"last-error": err}
+                for base, err in sorted(self.scrape_errors.items())
+            },
             "endpoints": [
                 self.statuses[m].to_json()
                 for m in self.machines
@@ -291,10 +304,38 @@ async def _metrics(request: web.Request) -> web.Response:
         targets,
         timeout=watchman.request_timeout,
         extra=[("watchman", telemetry.render())],
+        errors=watchman.scrape_errors,
     )
     resp = web.Response(text=merged, content_type="text/plain")
     resp.headers["X-Gordo-Scraped-Targets"] = str(n_responding)
     return resp
+
+
+async def _fleet_health(request: web.Request) -> web.Response:
+    """The FLEET health surface: every target replica's per-machine
+    fleet-health doc fetched and merged into one view.  Sketches merge
+    exactly (counts add), so for a machine-affinity-sharded tier this
+    doc is the same as a single process serving the whole fleet would
+    produce — one endpoint answers "which of my machines are drifting"
+    regardless of how serving is sharded.  ``?top=N`` bounds the drift
+    ranking."""
+    watchman: Watchman = request.app[WATCHMAN_KEY]
+    try:
+        top = int(request.query.get("top")) if "top" in request.query else None
+    except (TypeError, ValueError):
+        return web.json_response(
+            {"error": "top must be an integer"}, status=400
+        )
+    targets = await watchman._current_targets()
+    docs, responding = await fetch_fleet_health(
+        watchman.project, targets,
+        timeout=watchman.request_timeout, top=top,
+    )
+    merged = telemetry.merge_health_docs(docs, top=top)
+    merged["project-name"] = watchman.project
+    merged["instances"] = responding
+    merged["targets-responding"] = len(responding)
+    return web.json_response(merged)
 
 
 def build_watchman_app(watchman: Watchman) -> web.Application:
@@ -312,6 +353,7 @@ def build_watchman_app(watchman: Watchman) -> web.Application:
     app.router.add_get("/", _index)
     app.router.add_get("/healthcheck", _healthcheck)
     app.router.add_get("/metrics", _metrics)
+    app.router.add_get("/fleet-health", _fleet_health)
     return app
 
 

@@ -211,3 +211,88 @@ def test_telemetry_dump_merges_snapshot_dir(tmp_path):
         gordo, ["telemetry", "dump", "--dir", str(tmp_path / "empty")]
     )
     assert missing.exit_code != 0
+
+
+def test_telemetry_dump_format_json(tmp_path):
+    """Satellite: `--format json` prints the JSON snapshot document
+    (merge-able), `--format prom` (the default) the text exposition, and
+    a live-scrape + json combination is refused rather than guessed."""
+    from gordo_tpu import telemetry
+
+    reg = telemetry.MetricsRegistry(enabled=True)
+    reg.counter("gordo_cli_fmt_total", "x").inc(3)
+    snap_dir = tmp_path / "models" / telemetry.SNAPSHOT_DIR
+    reg.write_snapshot(str(snap_dir / "shard-000-of-001.json"))
+
+    runner = CliRunner()
+    as_json = runner.invoke(
+        gordo,
+        ["telemetry", "dump", "--dir", str(tmp_path / "models"),
+         "--format", "json"],
+    )
+    assert as_json.exit_code == 0, as_json.output
+    doc = json.loads(as_json.output)
+    assert doc["gordo_telemetry_snapshot"] == 1
+    assert "gordo_cli_fmt_total" in doc["metrics"]
+
+    bare_json = runner.invoke(gordo, ["telemetry", "dump", "--format", "json"])
+    assert bare_json.exit_code == 0
+    assert json.loads(bare_json.output)["gordo_telemetry_snapshot"] == 1
+
+    refused = runner.invoke(
+        gordo,
+        ["telemetry", "dump", "--url", "http://localhost:1",
+         "--format", "json"],
+    )
+    assert refused.exit_code != 0
+    assert "not available with --url" in refused.output
+
+
+def test_fleet_health_cli_reads_rollup_dir(tmp_path):
+    """`gordo fleet-health --dir` merges the rollup JSONL files serving
+    processes append and prints the status summary (or the full doc)."""
+    import numpy as np
+
+    from gordo_tpu import telemetry
+    from gordo_tpu.telemetry import fleet_health as fh
+
+    telemetry.FLEET_HEALTH.clear()
+    try:
+        rng = np.random.default_rng(0)
+        base = fh.sketch_from_scores(
+            rng.lognormal(0, 1, 4000), ts=0.0
+        ).to_doc()
+        telemetry.FLEET_HEALTH.set_baseline("cli-m-drift", base)
+        telemetry.FLEET_HEALTH.set_baseline("cli-m-ok", base)
+        telemetry.FLEET_HEALTH.record(
+            "cli-m-drift", rng.lognormal(2.5, 1, 1000)
+        )
+        telemetry.FLEET_HEALTH.record("cli-m-ok", rng.lognormal(0, 1, 1000))
+        fh.write_rollup(str(tmp_path), telemetry.FLEET_HEALTH.doc())
+    finally:
+        telemetry.FLEET_HEALTH.clear()
+
+    runner = CliRunner()
+    summary = runner.invoke(gordo, ["fleet-health", "--dir", str(tmp_path)])
+    assert summary.exit_code == 0, summary.output
+    doc = json.loads(summary.output)
+    assert doc["machines"] == 2
+    assert doc["by-status"]["drifting"] == 1
+    assert doc["top-drift"][0]["machine"] == "cli-m-drift"
+
+    full = runner.invoke(
+        gordo, ["fleet-health", "--dir", str(tmp_path), "--full"]
+    )
+    assert full.exit_code == 0
+    assert "cli-m-ok" in json.loads(full.output)["machines"]
+
+    both = runner.invoke(
+        gordo,
+        ["fleet-health", "--dir", str(tmp_path), "--url", "http://x:1"],
+    )
+    assert both.exit_code != 0
+
+    empty = runner.invoke(
+        gordo, ["fleet-health", "--dir", str(tmp_path / "nope")]
+    )
+    assert empty.exit_code != 0

@@ -1,0 +1,554 @@
+"""Fleet health plane tests: sketch merge algebra (associativity /
+commutativity / split-vs-single equality), drift-score order invariance
+and shift detection, the recording wiring through the serving scorers,
+the ``/fleet-health`` HTTP surfaces (server + watchman merge), rollup
+files + rotation, the top-K gauge export, and the end-to-end acceptance
+pin: shifted machines — and exactly those — rank top-K by drift."""
+
+import asyncio
+import json
+import os
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from gordo_tpu import telemetry
+from gordo_tpu.builder import build_project
+from gordo_tpu.serve import ModelCollection, build_app
+from gordo_tpu.serve.shard import ShardSpec, shard_map
+from gordo_tpu.telemetry import fleet_health as fh
+from gordo_tpu.workflow import NormalizedConfig
+
+MACHINES = [f"fh-machine-{i}" for i in range(4)]
+
+PROJECT = {
+    "machines": [
+        {
+            "name": name,
+            "dataset": {
+                "type": "RandomDataset",
+                "tags": ["fh-1", "fh-2", "fh-3"],
+                "train_start_date": "2017-12-25T06:00:00Z",
+                "train_end_date": "2017-12-26T06:00:00Z",
+            },
+        }
+        for name in MACHINES
+    ],
+    "globals": {
+        "model": {
+            "gordo_tpu.anomaly.diff.DiffBasedAnomalyDetector": {
+                "base_estimator": {
+                    "gordo_tpu.pipeline.Pipeline": {
+                        "steps": [
+                            "gordo_tpu.ops.scalers.MinMaxScaler",
+                            {
+                                "gordo_tpu.models.estimator.AutoEncoder": {
+                                    "kind": "feedforward_hourglass",
+                                    "epochs": 1,
+                                    "batch_size": 64,
+                                }
+                            },
+                        ]
+                    }
+                }
+            }
+        }
+    },
+}
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    out = tmp_path_factory.mktemp("fh-artifacts")
+    result = build_project(
+        NormalizedConfig(PROJECT, "fhproj").machines, str(out)
+    )
+    assert not result.failed
+    return str(out)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_fleet_health():
+    telemetry.FLEET_HEALTH.clear()
+    yield
+    telemetry.FLEET_HEALTH.clear()
+
+
+def _sketch(*arrays, ts=1.0):
+    sk = fh.ScoreSketch()
+    for a in arrays:
+        sk.observe(a, ts=ts)
+    return sk
+
+
+def _rng(seed=0):
+    return np.random.default_rng(seed)
+
+
+# ---------------------------------------------------------------------------
+# sketch algebra
+# ---------------------------------------------------------------------------
+
+class TestSketchMergeAlgebra:
+    def test_doc_roundtrip(self):
+        sk = _sketch(_rng().lognormal(0, 1, 500))
+        doc = sk.to_doc()
+        again = fh.ScoreSketch.from_doc(doc).to_doc()
+        assert json.dumps(doc, sort_keys=True) == json.dumps(
+            again, sort_keys=True
+        )
+
+    def test_merge_commutes(self):
+        """shard A + shard B == shard B + shard A, byte-for-byte."""
+        xs = [_rng(i).lognormal(0, 1, 200) for i in range(2)]
+        ab = _sketch(xs[0])
+        ab.merge(_sketch(xs[1]))
+        ba = _sketch(xs[1])
+        ba.merge(_sketch(xs[0]))
+        assert json.dumps(ab.to_doc(), sort_keys=True) == json.dumps(
+            ba.to_doc(), sort_keys=True
+        )
+
+    def test_merge_associates(self):
+        """(A+B)+C == A+(B+C): counts exactly, float fields to within
+        IEEE reassociation noise (weights are counts, so the weighted
+        EWMA reduces to the same sum either way)."""
+        xs = [_rng(i).lognormal(0, 1, 150) for i in range(3)]
+        left = _sketch(xs[0])
+        left.merge(_sketch(xs[1]))
+        left.merge(_sketch(xs[2]))
+        bc = _sketch(xs[1])
+        bc.merge(_sketch(xs[2]))
+        right = _sketch(xs[0])
+        right.merge(bc)
+        assert left.to_doc()["counts"] == right.to_doc()["counts"]
+        assert left.count == right.count
+        assert left.sum == pytest.approx(right.sum, rel=1e-12)
+        assert left.ewma_mean == pytest.approx(right.ewma_mean, rel=1e-12)
+
+    def test_shard_split_equals_single_process(self):
+        """A stream split across shards (in arrival order) merges to the
+        EXACT single-process sketch — the bench's byte-parity gate at
+        unit scale."""
+        batches = [_rng(i).lognormal(0, 1, 128) for i in range(4)]
+        single = _sketch(*batches)
+        shard_a = _sketch(batches[0], batches[1])
+        shard_b = _sketch(batches[2], batches[3])
+        shard_a.merge(shard_b)
+        a_doc, s_doc = shard_a.to_doc(), single.to_doc()
+        assert a_doc["counts"] == s_doc["counts"]
+        assert a_doc["count"] == s_doc["count"]
+        assert a_doc["sum"] == s_doc["sum"]
+        assert a_doc["sum-sq"] == s_doc["sum-sq"]
+
+    def test_edges_version_mismatch_rejected(self):
+        doc = _sketch(_rng().lognormal(0, 1, 300)).to_doc()
+        alien = dict(doc, **{"edges-version": 99})
+        with pytest.raises(ValueError, match="edges-version"):
+            fh.ScoreSketch.from_doc(alien)
+        with pytest.raises(ValueError, match="edges-version"):
+            fh.drift_score(alien, doc)
+
+
+class TestDriftScore:
+    def test_order_invariant(self):
+        """Resorting (or re-batching) the live stream cannot change the
+        drift score — it reads bucket counts only."""
+        rng = _rng(7)
+        base = _sketch(rng.lognormal(0, 1, 4000)).to_doc()
+        scores = rng.lognormal(0.3, 1, 1000)
+        forward = _sketch(scores).to_doc()
+        perm = scores[rng.permutation(scores.size)]
+        shuffled = _sketch(perm[:100], perm[100:]).to_doc()
+        d1, d2 = fh.drift_score(base, forward), fh.drift_score(base, shuffled)
+        assert d1 is not None and d1 == d2
+
+    def test_detects_shift_and_stays_low_on_same_distribution(self):
+        rng = _rng(3)
+        base = _sketch(rng.lognormal(0, 1, 4000)).to_doc()
+        same = _sketch(rng.lognormal(0, 1, 2000)).to_doc()
+        shifted = _sketch(rng.lognormal(2.0, 1, 2000)).to_doc()
+        d_same = fh.drift_score(base, same)
+        d_shift = fh.drift_score(base, shifted)
+        assert d_same < 0.15
+        assert d_shift > 0.5
+        assert d_shift <= 1.0
+
+    def test_thin_windows_report_none_not_noise(self):
+        """Below MIN_DRIFT_COUNT the sampling bias of a Hellinger
+        estimate dominates any signal — the score must be None, not an
+        arithmetically-true false alarm."""
+        rng = _rng(5)
+        base = _sketch(rng.lognormal(0, 1, 4000)).to_doc()
+        thin = _sketch(rng.lognormal(0, 1, fh.MIN_DRIFT_COUNT - 1)).to_doc()
+        assert fh.drift_score(base, thin) is None
+        assert fh.drift_score(base, None) is None
+        assert fh.drift_score(None, base) is None
+
+
+# ---------------------------------------------------------------------------
+# registry + statuses + gauges
+# ---------------------------------------------------------------------------
+
+class TestFleetHealthRegistry:
+    def test_record_and_statuses(self):
+        rng = _rng(11)
+        reg = telemetry.FLEET_HEALTH
+        base = _sketch(rng.lognormal(0, 1, 4000)).to_doc()
+        for name in ("st-ok", "st-drift", "st-silent"):
+            reg.set_baseline(name, base)
+        reg.record("st-ok", rng.lognormal(0, 1, 2000))
+        reg.record("st-drift", rng.lognormal(2.5, 1, 2000))
+        reg.record("st-orphan", rng.lognormal(0, 1, 2000))
+        doc = reg.doc(
+            machines=["st-ok", "st-drift", "st-silent", "st-orphan"]
+        )
+        statuses = {n: e["status"] for n, e in doc["machines"].items()}
+        assert statuses == {
+            "st-ok": "ok",
+            "st-drift": "drifting",
+            "st-silent": "silent",
+            "st-orphan": "no-baseline",
+        }
+        assert doc["top-drift"][0]["machine"] == "st-drift"
+
+    def test_kill_switch_and_suspension_stop_recording(self):
+        reg = telemetry.FLEET_HEALTH
+        telemetry.set_enabled(False)
+        try:
+            reg.record("kw-machine", np.ones(10))
+        finally:
+            telemetry.set_enabled(True)
+        with reg.suspended():
+            reg.record("kw-machine", np.ones(10))
+        assert reg.doc(machines=["kw-machine"])["machines"][
+            "kw-machine"
+        ]["live"] is None
+        reg.record("kw-machine", np.ones(10))
+        assert reg.doc(machines=["kw-machine"])["machines"][
+            "kw-machine"
+        ]["live"]["count"] == 10
+
+    def test_gauge_export_is_topk_bounded_and_resets(self):
+        rng = _rng(13)
+        reg = telemetry.FLEET_HEALTH
+        base = _sketch(rng.lognormal(0, 1, 4000)).to_doc()
+        for i in range(6):
+            name = f"gk-{i}"
+            reg.set_baseline(name, base)
+            # increasing shift: gk-5 drifts most
+            reg.record(name, rng.lognormal(0.6 * i, 1, 1000))
+        reg.export_gauges(machines=[f"gk-{i}" for i in range(6)], top=2)
+        text = telemetry.render()
+        top2 = [
+            line for line in text.splitlines()
+            if line.startswith("gordo_machine_drift{")
+        ]
+        assert len(top2) == 2
+        assert any('machine="gk-5"' in line for line in top2)
+        assert 'gordo_fleet_health_machines{status="drifting"}' in text
+        # a machine rotating OUT of the top-K leaves no stale series
+        reg.clear(["gk-5"])
+        reg.export_gauges(machines=[f"gk-{i}" for i in range(5)], top=2)
+        text = telemetry.render()
+        assert 'gordo_machine_drift{machine="gk-5"}' not in text
+
+    def test_merge_health_docs_disjoint_equals_union(self):
+        rng = _rng(17)
+        reg = telemetry.FLEET_HEALTH
+        base = _sketch(rng.lognormal(0, 1, 4000)).to_doc()
+        for name, shift in (("mh-a", 0.0), ("mh-b", 2.0)):
+            reg.set_baseline(name, base)
+            reg.record(name, rng.lognormal(shift, 1, 1000))
+        doc_a = reg.doc(machines=["mh-a"])
+        doc_b = reg.doc(machines=["mh-b"])
+        both = reg.doc(machines=["mh-a", "mh-b"])
+        merged = telemetry.merge_health_docs([doc_a, doc_b])
+        assert json.dumps(
+            telemetry.normalize_health_doc(merged), sort_keys=True
+        ) == json.dumps(
+            telemetry.normalize_health_doc(both), sort_keys=True
+        )
+
+
+# ---------------------------------------------------------------------------
+# rollup files
+# ---------------------------------------------------------------------------
+
+class TestRollups:
+    def test_write_load_merge(self, tmp_path):
+        rng = _rng(19)
+        reg = telemetry.FLEET_HEALTH
+        reg.set_baseline("ru-a", _sketch(rng.lognormal(0, 1, 4000)).to_doc())
+        reg.record("ru-a", rng.lognormal(0, 1, 500))
+        d = str(tmp_path)
+        # two "processes": an unsharded one and shard 1/2
+        assert fh.write_rollup(d, reg.doc(machines=["ru-a"])) is not None
+        reg.record("ru-b", rng.lognormal(0, 1, 500))
+        fh.write_rollup(
+            d, reg.doc(machines=["ru-b"]), shard=ShardSpec(1, 2)
+        )
+        docs = telemetry.load_rollups(d)
+        assert len(docs) == 2
+        merged = telemetry.merge_health_docs(docs)
+        assert set(merged["machines"]) == {"ru-a", "ru-b"}
+
+    def test_rollup_rotation_keeps_last_two(self, tmp_path):
+        doc = {"gordo-fleet-health": 1, "machines": {}}
+        d = str(tmp_path)
+        for _ in range(50):
+            fh.write_rollup(d, doc, max_bytes=200)
+        rolldir = tmp_path / fh.ROLLUP_DIR
+        files = sorted(p.name for p in rolldir.iterdir())
+        assert files == [
+            "rollup-unsharded.jsonl", "rollup-unsharded.jsonl.1",
+        ]
+        # live file stays bounded near the cap (one line of slack)
+        assert (rolldir / files[0]).stat().st_size < 400
+        # the loader still reads the latest doc
+        assert telemetry.load_rollups(d)
+
+    def test_torn_tail_line_is_skipped(self, tmp_path):
+        d = str(tmp_path)
+        fh.write_rollup(d, {"gordo-fleet-health": 1, "machines": {"x": {}}})
+        path = fh.rollup_path(d)
+        with open(path, "a") as f:
+            f.write('{"gordo-fleet-health": 1, "mach')  # SIGKILL mid-append
+        docs = telemetry.load_rollups(d)
+        assert len(docs) == 1 and "x" in docs[0]["machines"]
+
+
+# ---------------------------------------------------------------------------
+# serve-path wiring + the end-to-end acceptance pin
+# ---------------------------------------------------------------------------
+
+def _training_matrix():
+    """The machines' actual training data (RandomDataset is
+    deterministic per tags/dates): live traffic drawn from it scores
+    exactly like the training residuals, so unshifted machines stay
+    near drift 0 and only a genuine input shift moves the signal."""
+    from gordo_tpu.dataset.base import GordoBaseDataset
+
+    ds = GordoBaseDataset.from_dict(
+        dict(PROJECT["machines"][0]["dataset"])
+    )
+    X, _ = ds.get_data()
+    return np.asarray(X, np.float32)
+
+
+def _serve_traffic(collection, shifted=(), rounds=3):
+    """Score every machine through its single-machine scorer: the
+    training matrix as-is for healthy machines, scaled far outside the
+    training range for ``shifted`` ones."""
+    X = _training_matrix()
+    for _ in range(rounds):
+        for name in sorted(collection.entries):
+            scale = 8.0 if name in shifted else 1.0
+            collection.get(name).scorer.anomaly_arrays(X * scale)
+
+
+class TestEndToEndDrift:
+    def test_builder_records_baselines(self, model_dir):
+        collection = ModelCollection.from_directory(
+            model_dir, project="fhproj"
+        )
+        for name, entry in collection.entries.items():
+            doc = (entry.metadata.get("fleet-health") or {}).get("baseline")
+            assert doc, f"{name} has no training baseline"
+            assert doc["count"] >= fh.MIN_DRIFT_COUNT
+            assert doc["last-seen"] == 0.0  # training artifacts carry no ts
+        # loading the collection adopted them
+        assert telemetry.FLEET_HEALTH.baseline(MACHINES[0])
+
+    def test_shifted_machines_rank_topk_and_flag(self, model_dir):
+        """ISSUE 9 acceptance: serve shifted input to a subset; exactly
+        those machines rank top-K by drift and flag in /fleet-health,
+        and their gauges ride /metrics."""
+        collection = ModelCollection.from_directory(
+            model_dir, project="fhproj"
+        )
+        shifted = {MACHINES[1], MACHINES[3]}
+        _serve_traffic(collection, shifted=shifted)
+
+        async def fn(client):
+            health = await (
+                await client.get("/gordo/v0/fhproj/fleet-health?top=2")
+            ).json()
+            metrics_text = await (await client.get("/metrics")).text()
+            return health, metrics_text
+
+        async def runner():
+            client = TestClient(TestServer(build_app(collection)))
+            await client.start_server()
+            try:
+                return await fn(client)
+            finally:
+                await client.close()
+
+        health, metrics_text = asyncio.run(runner())
+        top = [t["machine"] for t in health["top-drift"]]
+        assert sorted(top) == sorted(shifted)
+        flagged = {
+            n for n, e in health["machines"].items()
+            if e["status"] == "drifting"
+        }
+        assert flagged == shifted
+        for name in shifted:
+            assert health["machines"][name]["drift"] > 0.5
+            assert f'gordo_machine_drift{{machine="{name}"}}' in metrics_text
+        for name in set(MACHINES) - shifted:
+            assert health["machines"][name]["status"] == "ok"
+
+    def test_bulk_path_records_without_double_count(self, model_dir):
+        """score_all must record each machine exactly once per request —
+        stacked machines via assemble, fallback/windows-bound machines
+        via their own named scorers, never both."""
+        collection = ModelCollection.from_directory(
+            model_dir, project="fhproj"
+        )
+        rng = _rng(29)
+        X_by = {
+            n: rng.uniform(0, 1, (300, 3)).astype(np.float32)
+            for n in MACHINES
+        }
+        collection.fleet_scorer.score_all(X_by)
+        doc = telemetry.FLEET_HEALTH.doc(machines=MACHINES)
+        for name in MACHINES:
+            live = doc["machines"][name]["live"]
+            assert live is not None and live["count"] == 300
+
+    def test_rollup_task_writes_under_artifact_dir(self, model_dir):
+        collection = ModelCollection.from_directory(
+            model_dir, project="fhproj"
+        )
+        _serve_traffic(collection, rounds=1)
+
+        async def runner():
+            client = TestClient(TestServer(
+                build_app(collection, health_rollup_interval=0.05)
+            ))
+            await client.start_server()
+            try:
+                await asyncio.sleep(0.3)
+            finally:
+                await client.close()
+
+        asyncio.run(runner())
+        docs = telemetry.load_rollups(model_dir)
+        assert docs and set(docs[-1]["machines"]) == set(MACHINES)
+
+
+class TestWatchmanMerge:
+    def test_watchman_merges_shard_docs(self, model_dir):
+        """Two shard replicas (machine-affinity partition) + a watchman:
+        its /fleet-health doc covers the whole fleet, merged from the
+        per-shard docs."""
+        from gordo_tpu.watchman import Watchman, build_watchman_app
+
+        shard_cols = [
+            ModelCollection.from_directory(
+                model_dir, project="fhproj", shard=ShardSpec(i, 2)
+            )
+            for i in range(2)
+        ]
+        owners = shard_map(MACHINES, 2)
+        for col in shard_cols:
+            _serve_traffic(col)
+
+        async def main():
+            servers = []
+            targets = []
+            for col in shard_cols:
+                client = TestClient(TestServer(build_app(col)))
+                await client.start_server()
+                servers.append(client)
+                targets.append(
+                    f"http://{client.server.host}:{client.server.port}"
+                )
+            watchman = Watchman(
+                "fhproj", [], targets, poll_interval=3600, discover=False
+            )
+            wm_client = TestClient(TestServer(build_watchman_app(watchman)))
+            await wm_client.start_server()
+            try:
+                return await (await wm_client.get("/fleet-health")).json()
+            finally:
+                await wm_client.close()
+                for s in servers:
+                    await s.close()
+
+        merged = asyncio.run(main())
+        assert merged["targets-responding"] == 2
+        assert set(merged["machines"]) == set(MACHINES)
+        for name, entry in merged["machines"].items():
+            assert entry["live"]["count"] > 0, (name, owners[name])
+            assert entry["baseline"] is not None
+
+
+@pytest.mark.slow
+def test_two_shard_merged_doc_byte_equivalent_to_single_process(model_dir):
+    """The cross-shard merge parity pin (slow lane, next to the PR 8
+    scatter-gather parity suite): the same deterministic request stream
+    scored through (a) one full collection and (b) two machine-affinity
+    shard collections; the shards' docs merged through
+    telemetry.merge_health_docs must equal the single-process doc
+    byte-for-byte modulo timestamps."""
+    rng = _rng(31)
+    streams = {
+        n: [rng.uniform(0, 1, (512, 3)).astype(np.float32) for _ in range(3)]
+        for n in MACHINES
+    }
+
+    telemetry.FLEET_HEALTH.clear()
+    full = ModelCollection.from_directory(model_dir, project="fhproj")
+    for rnd in range(3):
+        full.fleet_scorer.score_all({n: streams[n][rnd] for n in MACHINES})
+    doc_full = telemetry.normalize_health_doc(
+        telemetry.FLEET_HEALTH.doc(machines=MACHINES, top=3)
+    )
+
+    telemetry.FLEET_HEALTH.clear()
+    owners = shard_map(MACHINES, 2)
+    shard_docs = []
+    for idx in range(2):
+        col = ModelCollection.from_directory(
+            model_dir, project="fhproj", shard=ShardSpec(idx, 2)
+        )
+        owned = sorted(col.entries)
+        assert owned == sorted(n for n in MACHINES if owners[n] == idx)
+        for rnd in range(3):
+            col.fleet_scorer.score_all({n: streams[n][rnd] for n in owned})
+        shard_docs.append(telemetry.FLEET_HEALTH.doc(machines=owned, top=3))
+    merged = telemetry.normalize_health_doc(
+        telemetry.merge_health_docs(shard_docs, top=3)
+    )
+    assert json.dumps(merged, sort_keys=True) == json.dumps(
+        doc_full, sort_keys=True
+    )
+
+
+def test_baseline_kill_switch(monkeypatch):
+    monkeypatch.setenv("GORDO_FLEET_BASELINE", "off")
+    assert fh.training_baseline(object(), np.zeros((10, 2))) is None
+    assert fh.training_baselines({"m": object()}, {"m": np.zeros((10, 2))}) \
+        == {}
+
+
+def test_span_log_rotation(tmp_path, monkeypatch):
+    """Satellite: GORDO_SPAN_LOG rolls over at the size cap, keeping the
+    last 2 files — it previously grew unboundedly on long-lived
+    servers."""
+    log_path = str(tmp_path / "spans.jsonl")
+    monkeypatch.setenv("GORDO_SPAN_LOG", log_path)
+    monkeypatch.setenv("GORDO_SPAN_LOG_MAX_BYTES", "300")
+    for i in range(60):
+        with telemetry.span("rotate.section", i=i):
+            pass
+    assert sorted(os.listdir(tmp_path)) == [
+        "spans.jsonl", "spans.jsonl.1",
+    ]
+    assert os.path.getsize(log_path) < 600
+    with open(log_path) as f:
+        last = [json.loads(line) for line in f if line.strip()][-1]
+    assert last["span"] == "rotate.section" and last["i"] == 59

@@ -36,6 +36,13 @@ def _golden_registry() -> metrics_mod.MetricsRegistry:
     c.inc(1, 'we"ird\\route', "200")  # label escaping exercised
     g = reg.gauge("gordo_golden_queue_depth", "Queue depth")
     g.set(4)
+    # a gordo_machine_* family pins the fleet-health gauge rendering
+    # (top-K per-machine series with the machine label)
+    d = reg.gauge(
+        "gordo_machine_drift", "Baseline-vs-live drift", labels=("machine",)
+    )
+    d.set(0.75, "m-001")
+    d.set(0.5, "m-002")
     h = reg.histogram(
         "gordo_golden_request_seconds", "Latency", labels=("route",),
         buckets=(0.005, 0.05, 0.5),

@@ -145,6 +145,73 @@ def test_watchman_metrics_merges_targets_and_self(model_dir):
     assert 'instance="watchman"' in text
 
 
+def test_watchman_scrape_failures_are_counted_and_surfaced():
+    """Satellite: a target that fails its /metrics scrape is no longer
+    silent — it counts in gordo_watchman_scrape_failures_total under its
+    instance label, and its last error rides the status doc's
+    scrape-status block."""
+    from gordo_tpu import telemetry
+
+    dead = "http://127.0.0.1:1"  # connection refused
+
+    async def main():
+        watchman = Watchman("p", [], [dead], poll_interval=3600,
+                            discover=False)
+        client = TestClient(TestServer(build_watchman_app(watchman)))
+        await client.start_server()
+        try:
+            await client.get("/metrics")  # first fan-out counts the failure
+            # the second scrape's exposition includes the already-counted
+            # failure series (watchman renders its own registry at
+            # fan-out start)
+            text = await (await client.get("/metrics")).text()
+            status_doc = await (await client.get("/")).json()
+            return text, status_doc
+        finally:
+            await client.close()
+
+    text, status_doc = asyncio.run(main())
+    counter = telemetry.REGISTRY.get("gordo_watchman_scrape_failures_total")
+    assert counter.value(dead) >= 1
+    # the failure series rides the merged exposition itself (as a
+    # target=-labelled series under watchman's own instance label)
+    assert "gordo_watchman_scrape_failures_total{target=" in text
+    assert dead in status_doc["scrape-status"]
+    assert status_doc["scrape-status"][dead]["last-error"]
+
+
+def test_scrape_errors_clear_on_recovery(model_dir):
+    """A target that failed once and then answers drops out of
+    scrape-status (the dict reflects the LATEST fan-out, not history)."""
+    from aiohttp import web
+
+    from gordo_tpu.serve import ModelCollection, build_app
+
+    async def main():
+        collection = ModelCollection.from_directory(model_dir, project="wm")
+        ml_runner = web.AppRunner(build_app(collection))
+        await ml_runner.setup()
+        site = web.TCPSite(ml_runner, "127.0.0.1", 0)
+        await site.start()
+        port = ml_runner.addresses[0][1]
+        base = f"http://127.0.0.1:{port}"
+        watchman = Watchman("wm", [], [base], poll_interval=3600,
+                            discover=False)
+        watchman.scrape_errors[base] = "ConnectionError: stale"
+        client = TestClient(TestServer(build_watchman_app(watchman)))
+        await client.start_server()
+        try:
+            await client.get("/metrics")
+            status_doc = await (await client.get("/")).json()
+            return status_doc
+        finally:
+            await client.close()
+            await ml_runner.cleanup()
+
+    status_doc = asyncio.run(main())
+    assert status_doc["scrape-status"] == {}
+
+
 def test_client_discovers_via_watchman(model_dir):
     """Reference behavior: the client gets its machine list from watchman
     and skips unhealthy endpoints."""
