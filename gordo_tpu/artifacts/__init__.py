@@ -23,9 +23,16 @@ import shutil
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from gordo_tpu import serializer
+from gordo_tpu.artifacts.generations import (  # noqa: F401
+    gc_generations,
+    read_generation,
+    stamp_generation,
+)
 from gordo_tpu.artifacts.pack import (  # noqa: F401
     ENV_FORMAT,
+    ENV_GC_KEEP,
     FORMATS,
+    GENERATION_FILE,
     PACK_REF_PREFIX,
     PACKS_DIR,
     PackCorruptError,
@@ -46,13 +53,15 @@ from gordo_tpu.artifacts.pack import (  # noqa: F401
 logger = logging.getLogger(__name__)
 
 __all__ = [
-    "ENV_FORMAT", "FORMATS", "PACKS_DIR", "PACK_REF_PREFIX",
+    "ENV_FORMAT", "ENV_GC_KEEP", "FORMATS", "GENERATION_FILE",
+    "PACKS_DIR", "PACK_REF_PREFIX",
     "PackError", "PackCorruptError", "PackStore",
     "ArtifactRef", "discover", "open_store", "is_artifact_dir",
     "machines_on_disk", "resolve_cached", "resolve_format",
     "machine_ref", "parse_ref", "is_pack_ref",
     "write_pack", "delta_write", "flatten_model", "to_device",
     "device_put_count", "repack", "unpack", "store_info", "packs_dir",
+    "stamp_generation", "read_generation", "gc_generations",
 ]
 
 
@@ -314,5 +323,7 @@ def store_info(path: str) -> Dict[str, Any]:
             packs=len(store.packs),
             packed_machines=len(store.machines),
             pack_bytes=store.total_bytes(),
+            generation=store.generation,
+            generations_retained=len(store.generations),
         )
     return info

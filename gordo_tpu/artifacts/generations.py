@@ -1,0 +1,128 @@
+"""Versioned artifact generations: atomic flips over the pack index.
+
+Reference equivalent: TensorFlow Serving's ``AspiredVersionsManager`` —
+a servable advances through monotonically numbered versions and the
+serving loop loads the new version while the old one keeps answering.
+Here the version unit is the whole pack index: pack writes land as
+*pending* rows (``gen = active + 1``) without touching the published
+``generation``; one :func:`stamp_generation` at the end of a build flips
+the id atomically under the index flock (``delta_write`` stamps inside
+its own index swap).  The flip is the ONLY reload signal the server
+acts on — pack mtimes can tick mid-rewrite, the generation id cannot.
+
+Retention: superseded packs are retired (file kept on disk, entry moved
+to the index's ``retired`` table) and each generation record lists the
+pack files live at its flip, so any retained generation stays loadable.
+:func:`gc_generations` prunes history to the newest ``keep`` records and
+unlinks retired files nothing references; it refuses to delete the live
+generation, and ``GORDO_GC_KEEP`` makes every stamp auto-prune.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+from gordo_tpu.artifacts.pack import (
+    _GENERATIONS_GAUGE,
+    _index_path,
+    _locked_index_update,
+    _prune_generations,
+    _read_index,
+    _record_generation,
+    _write_generation_file,
+    GENERATION_FILE,
+    packs_dir,
+)
+
+__all__ = ["stamp_generation", "read_generation", "gc_generations"]
+
+
+def read_generation(output_dir: str) -> int:
+    """The published generation id, 0 when the project has no packs (or
+    predates the generations layer).  Reads the tiny ``GENERATION``
+    sidecar first — the cheap per-poll path for the server's watch
+    loop — falling back to the index document."""
+    directory = packs_dir(output_dir)
+    try:
+        with open(os.path.join(directory, GENERATION_FILE)) as fh:
+            return int(fh.read().strip())
+    except (OSError, ValueError):
+        pass
+    doc = _read_index(directory)
+    return int(doc.get("generation", 0)) if doc else 0
+
+
+def stamp_generation(
+    output_dir: str, keep: Optional[int] = None
+) -> int:
+    """Publish every pending pack row as ONE new generation.
+
+    Idempotent: when no rows are pending (a fully-cached rebuild, or a
+    second stamp) the published generation is returned unchanged — no
+    flip, no reload churn downstream.  ``keep`` prunes history to the
+    newest N generations after the flip (the ``GORDO_GC_KEEP`` env var
+    does the same on every stamp).  Returns the published generation.
+    """
+    directory = packs_dir(output_dir)
+    if not os.path.exists(_index_path(directory)):
+        return 0
+
+    def mutate(doc: Dict[str, Any]) -> None:
+        current = int(doc.get("generation", 0))
+        pending = sorted(
+            name for name, row in doc["machines"].items()
+            if int(row.get("gen", 0)) > current
+        )
+        if pending:
+            _record_generation(directory, doc, pending)
+        if keep is not None:
+            _prune_generations(directory, doc, max(1, int(keep)))
+            _GENERATIONS_GAUGE.set(
+                float(len(doc.get("generations", {})))
+            )
+
+    doc = _locked_index_update(
+        directory, mutate,
+        # rewriting the sidecar even on a no-op stamp heals a missing /
+        # stale GENERATION file (e.g. an index copied without it)
+        after=lambda d: _write_generation_file(
+            directory, int(d.get("generation", 0))
+        ),
+    )
+    return int(doc.get("generation", 0))
+
+
+def gc_generations(output_dir: str, keep: int) -> Dict[str, Any]:
+    """Prune generation history to the newest ``keep`` records and
+    unlink retired pack files no retained generation (nor the live
+    index) references.  Refuses ``keep < 1`` — the live generation is
+    never collectable.  Returns a summary for the CLI."""
+    if int(keep) < 1:
+        raise ValueError(
+            "refusing to delete the live generation: keep must be >= 1"
+        )
+    directory = packs_dir(output_dir)
+    if not os.path.exists(_index_path(directory)):
+        return {
+            "generation": 0, "retained": [], "removed-files": [],
+            "retired-remaining": 0,
+        }
+    summary: Dict[str, Any] = {}
+
+    def mutate(doc: Dict[str, Any]) -> None:
+        removed = _prune_generations(directory, doc, int(keep))
+        _GENERATIONS_GAUGE.set(float(len(doc.get("generations", {}))))
+        summary.update(
+            {
+                "generation": int(doc.get("generation", 0)),
+                "retained": sorted(
+                    int(g) for g in doc.get("generations", {})
+                ),
+                "removed-files": sorted(removed),
+                "retired-remaining": len(doc.get("retired", {})),
+            }
+        )
+
+    _locked_index_update(directory, mutate)
+    return summary

@@ -37,6 +37,20 @@ Delta writes: :func:`delta_write` rewrites only the changed machines'
 slot segments in place (O(changed-machines) bytes) plus an atomic index
 swap — the primitive incremental rebuilds (ROADMAP item 3) need.
 
+Generations: the index carries a monotonic ``generation`` id, and every
+machine row records the generation (``gen``) that last rewrote it.  Pack
+writes record rows as *pending* (``gen = active + 1``) without touching
+the published generation; one flock-serialized
+:func:`~gordo_tpu.artifacts.generations.stamp_generation` at the end of
+a build flips the id atomically (``delta_write`` stamps inside its own
+index flip).  Readers — the server's delta hot reload above all — never
+act on pack mtimes: the generation flip is the ONLY reload signal, and
+it happens strictly after the pack bytes it publishes are durable, so a
+mid-rewrite pack can never be observed as "new".  Superseded packs are
+*retired* (entry moved aside, file retained on disk) rather than
+unlinked, so previous generations stay loadable until
+:func:`~gordo_tpu.artifacts.generations.gc_generations` prunes them.
+
 Durability matches the registry/round-file convention: every rename is
 ``tmp + os.replace`` followed by a parent-directory fsync, so an index
 can never reference a pack that a crash kept off disk.
@@ -79,11 +93,25 @@ _LEAF_TAG = "gordo-pack-leaf"
 ENV_FORMAT = "GORDO_ARTIFACT_FORMAT"
 FORMATS = ("v1", "v2")
 
+#: tiny sidecar holding just the active generation int — the cheap
+#: watch-poll target (one small read per poll instead of parsing the
+#: whole index); rewritten under the index flock so it can never run
+#: ahead of the index it summarizes
+GENERATION_FILE = "GENERATION"
+#: when set, every generation stamp auto-prunes to the newest N
+#: generations (``gordo artifacts gc --keep N`` is the explicit form)
+ENV_GC_KEEP = "GORDO_GC_KEEP"
+
 # -- telemetry instruments (docs/observability.md) --------------------------
 _PACKS_TOTAL = telemetry.counter(
     "gordo_artifact_packs_total",
-    "Pack operations by kind (written | opened | delta | gc)",
+    "Pack operations by kind (written | opened | delta | retired | gc)",
     labels=("op",),
+)
+_GENERATIONS_GAUGE = telemetry.gauge(
+    "gordo_artifact_generations",
+    "Generation records retained in the pack index (active + history "
+    "still reloadable on disk)",
 )
 _PACK_BYTES_TOTAL = telemetry.counter(
     "gordo_artifact_pack_bytes_total",
@@ -237,12 +265,17 @@ def _read_index(directory: str) -> Optional[Dict[str, Any]]:
 
 
 def _locked_index_update(
-    directory: str, mutate: Callable[[Dict[str, Any]], None]
+    directory: str,
+    mutate: Callable[[Dict[str, Any]], None],
+    after: Optional[Callable[[Dict[str, Any]], None]] = None,
 ) -> Dict[str, Any]:
     """Read-modify-write the index under an exclusive flock, swapping the
     new index in atomically (tmp + rename + dir fsync).  The lock
     serializes concurrent writers — multi-host build shards write
-    disjoint chunks into ONE shared index."""
+    disjoint chunks into ONE shared index.  ``after`` runs with the lock
+    STILL HELD once the new index is durable (the generation sidecar
+    write rides here, so two concurrent stamps can't publish sidecars
+    out of order)."""
     os.makedirs(directory, exist_ok=True)
     with open(os.path.join(directory, ".lock"), "a+") as lock:
         fcntl.flock(lock.fileno(), fcntl.LOCK_EX)
@@ -258,24 +291,106 @@ def _locked_index_update(
             os.fsync(fh.fileno())
         os.replace(tmp, path)
         fsync_dir(directory)
+        if after is not None:
+            after(doc)
         return doc
 
 
+def _write_generation_file(directory: str, generation: int) -> None:
+    """Publish the tiny ``GENERATION`` sidecar (tmp + replace + fsync) —
+    what the server's watch loop polls instead of re-parsing the index.
+    Callers hold the index flock, so sidecars publish in stamp order."""
+    path = os.path.join(directory, GENERATION_FILE)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        fh.write(f"{int(generation)}\n")
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+    fsync_dir(directory)
+
+
+def _record_generation(
+    directory: str, doc: Dict[str, Any], changed: Sequence[str]
+) -> int:
+    """Flip ``doc`` to the next generation (caller is inside a locked
+    index mutate): bump the id, stamp the changed rows, and append a
+    generation record carrying the live pack refs — what keeps retired
+    pack files reachable (and gc-able) per generation."""
+    new_gen = int(doc.get("generation", 0)) + 1
+    doc["generation"] = new_gen
+    for name in changed:
+        row = doc["machines"].get(name)
+        if row is not None:
+            row["gen"] = new_gen
+    doc.setdefault("generations", {})[str(new_gen)] = {
+        "created_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "changed_count": len(changed),
+        "packs": sorted(
+            {e["file"] for e in doc["packs"].values()}
+        ),
+    }
+    keep = os.environ.get(ENV_GC_KEEP, "").strip()
+    if keep:
+        try:
+            _prune_generations(directory, doc, max(1, int(keep)))
+        except ValueError:
+            logger.warning("ignoring non-integer %s=%r", ENV_GC_KEEP, keep)
+    _GENERATIONS_GAUGE.set(float(len(doc.get("generations", {}))))
+    return new_gen
+
+
+def _prune_generations(
+    directory: str, doc: Dict[str, Any], keep: int
+) -> List[str]:
+    """Drop all but the newest ``keep`` generation records and unlink
+    retired pack files no retained record (nor the live index)
+    references.  Runs inside a locked index mutate; the active
+    generation is always retained (``keep >= 1`` is enforced by
+    callers).  Returns the file names actually removed."""
+    gens = doc.get("generations", {})
+    retained = sorted((int(g) for g in gens), reverse=True)[:keep]
+    for g in [g for g in gens if int(g) not in retained]:
+        del gens[g]
+    referenced = {e["file"] for e in doc["packs"].values()}
+    referenced |= {e["meta_file"] for e in doc["packs"].values()}
+    for rec in gens.values():
+        referenced.update(rec.get("packs", ()))
+    removed: List[str] = []
+    retired = doc.get("retired", {})
+    for pack_id in [
+        p for p, e in retired.items() if e["file"] not in referenced
+    ]:
+        entry = retired.pop(pack_id)
+        _PACKS_TOTAL.inc(1.0, "gc")
+        for key in ("file", "meta_file"):
+            if entry.get(key) and entry[key] not in referenced:
+                try:
+                    os.unlink(os.path.join(directory, entry[key]))
+                    removed.append(entry[key])
+                except OSError:
+                    pass
+    return removed
+
+
 def _gc_dead_packs(directory: str, doc: Dict[str, Any]) -> None:
-    """Drop pack entries (and files, best effort) whose machines were all
-    superseded by newer packs — a rebuilt chunk must not leave its old
-    bytes addressable forever."""
+    """Retire pack entries whose machines were all superseded by newer
+    packs: the entry moves to the index's ``retired`` table but the FILE
+    stays on disk — a previous generation's packs must remain loadable
+    until :func:`~gordo_tpu.artifacts.generations.gc_generations` (or
+    the ``GORDO_GC_KEEP`` auto-prune) decides history is deep enough."""
     live: Dict[str, int] = {}
     for row in doc["machines"].values():
         live[row["pack"]] = live.get(row["pack"], 0) + 1
     for pack_id in [p for p in doc["packs"] if not live.get(p)]:
         entry = doc["packs"].pop(pack_id)
-        _PACKS_TOTAL.inc(1.0, "gc")
-        for key in ("file", "meta_file"):
-            try:
-                os.unlink(os.path.join(directory, entry[key]))
-            except OSError:
-                pass
+        _PACKS_TOTAL.inc(1.0, "retired")
+        doc.setdefault("retired", {})[pack_id] = {
+            "file": entry["file"],
+            "meta_file": entry["meta_file"],
+            "bytes": entry.get("bytes", 0),
+            "retired_after": int(doc.get("generation", 0)),
+        }
 
 
 # ---------------------------------------------------------------------------
@@ -316,9 +431,18 @@ def write_pack(
 
     directory = packs_dir(output_dir)
     os.makedirs(directory, exist_ok=True)
+    # generation-qualify the pack id: a rebuild of the same chunk in a
+    # LATER generation must land in a fresh file so the previous
+    # generation's bytes survive until gc — same names + same pending
+    # generation still collapse to one file (idempotent re-runs)
+    try:
+        existing = _read_index(directory)
+    except PackCorruptError:
+        existing = None
+    pending_gen = int((existing or {}).get("generation", 0)) + 1
     pack_id = "pack-" + hashlib.md5(
         ",".join(names).encode()
-    ).hexdigest()[:12]
+    ).hexdigest()[:12] + f"-g{pending_gen}"
     pack_file = f"{pack_id}.pack"
     meta_file = f"{pack_id}.meta.json"
 
@@ -373,8 +497,16 @@ def write_pack(
 
     def mutate(doc: Dict[str, Any]) -> None:
         doc["packs"][pack_id] = entry
+        # rows land PENDING: gen is one past the published generation,
+        # so readers gating on the generation id don't reload mid-build;
+        # stamp_generation at build end publishes every pending row in
+        # one atomic flip (recomputed under the lock — a stamp that
+        # slipped in between makes these rows part of the NEXT flip)
+        row_gen = int(doc.get("generation", 0)) + 1
         for slot, name in enumerate(names):
-            row: Dict[str, Any] = {"pack": pack_id, "slot": slot}
+            row: Dict[str, Any] = {
+                "pack": pack_id, "slot": slot, "gen": row_gen,
+            }
             key = (cache_keys or {}).get(name)
             if key:
                 row["cache_key"] = key
@@ -471,8 +603,18 @@ def delta_write(
             entry["bytes"] = doc["packs"][pack_id]["bytes"]
             for slot, (offset, length) in slots.items():
                 entry["skeletons"][slot] = [offset, length]
+        # a delta IS a generation: the pack bytes above are already
+        # durable (fsync'd before this flip), so stamping here makes the
+        # index swap the one atomic publish — readers gating reloads on
+        # the generation can never observe the rewrite half-done
+        _record_generation(directory, idx, sorted(models))
 
-    _locked_index_update(directory, mutate)
+    _locked_index_update(
+        directory, mutate,
+        after=lambda idx: _write_generation_file(
+            directory, int(idx["generation"])
+        ),
+    )
     _PACKS_TOTAL.inc(float(len(by_pack)), "delta")
     _PACK_BYTES_TOTAL.inc(float(delta_bytes), "delta")
     return sorted(models)
@@ -501,6 +643,13 @@ class PackStore:
             raise FileNotFoundError(f"no pack index under {directory}")
         self.packs: Dict[str, Dict[str, Any]] = doc["packs"]
         self.machines: Dict[str, Dict[str, Any]] = doc["machines"]
+        #: published generation id at open (0 for a pre-generations
+        #: index) — the value the server's project index republishes
+        self.generation: int = int(doc.get("generation", 0))
+        #: retained generation records (newest last), for store_info/gc
+        self.generations: Dict[str, Dict[str, Any]] = dict(
+            doc.get("generations", {})
+        )
         try:
             st = os.stat(_index_path(directory))
             self.index_stat = (st.st_mtime, st.st_size)
@@ -657,9 +806,28 @@ class PackStore:
         pack_id, _ = self.location(name)
         return self._meta_doc(pack_id).get("definition")
 
+    def row_generation(self, name: str) -> int:
+        """The generation that last (re)wrote this machine's slot —
+        what the server's delta reload compares against its own
+        generation to build the changed-machine set.  0 for rows written
+        before the generations layer existed."""
+        row = self.machines.get(name)
+        return int(row.get("gen", 0)) if row else 0
+
+    def changed_since(self, generation: int) -> List[str]:
+        """Machines whose rows were rewritten after ``generation`` —
+        the O(changed) set a delta hot reload re-stacks."""
+        return sorted(
+            name for name, row in self.machines.items()
+            if int(row.get("gen", 0)) > int(generation)
+        )
+
     def stat(self, name: str) -> Tuple[float, int]:
-        """(mtime, size) of the machine's pack file — the reload signal
-        the server's rescan compares, mirroring v1's model.pkl stat."""
+        """(mtime, size) of the machine's pack file.  Historical reload
+        signal, kept for v1-parity surfaces only: a ``delta_write``
+        mutates the pack in place, so mtime can tick while the rewrite
+        is still torn — rescan gates pack reloads on
+        :meth:`row_generation` instead."""
         pack_id, _ = self.location(name)
         try:
             st = os.stat(

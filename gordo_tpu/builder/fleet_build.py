@@ -969,6 +969,21 @@ def build_project(
         writer.drain()
         shutil.rmtree(tmp_root, ignore_errors=True)
 
+    if artifact_fmt == "v2":
+        # ONE atomic generation flip publishes every pending pack row
+        # this build wrote — the only reload signal serving replicas act
+        # on, so a mid-build index is never mistaken for a new fleet.
+        # No-op (returns the current id) when the run was fully cached.
+        try:
+            generation = artifacts.stamp_generation(output_dir)
+            if generation:
+                logger.info(
+                    "published artifact generation %d", generation
+                )
+        except Exception:
+            logger.exception("generation stamp failed — serving "
+                             "replicas will not hot-reload this build")
+
     if shard_state is not None:
         if result.failed:
             shard_state.mark_resumable(

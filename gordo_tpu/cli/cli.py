@@ -404,9 +404,16 @@ def _run_multihost_build(dist_cfg, machines, output_dir, model_register_dir,
                    "shard's machines (the same deterministic partition "
                    "the client and watchman compute; docs/serving.md "
                    "'Sharded serving tier'). Default: unsharded.")
+@click.option("--reload-watch", default=None, type=float,
+              help="Seconds between artifact-generation polls for the "
+                   "zero-downtime delta hot reload (one tiny sidecar "
+                   "read per poll; a flip re-stacks only the changed "
+                   "machines while the old generation keeps serving). "
+                   "Default: GORDO_RELOAD_WATCH_SECONDS, else 5; 0 "
+                   "disables.")
 def run_server_cmd(model_dir, host, port, project, rescan_interval,
                    coalesce_ms, coalesce_min_concurrency, coalesce_knee,
-                   model_parallel, warmup, shard):
+                   model_parallel, warmup, shard, reload_watch):
     """Serve model(s) over the /gordo/v0/<project>/<machine>/ routes."""
     from gordo_tpu.serve.server import run_server
     from gordo_tpu.serve.shard import ShardSpec
@@ -425,6 +432,7 @@ def run_server_cmd(model_dir, host, port, project, rescan_interval,
         model_parallel=model_parallel,
         warmup=warmup,
         shard=shard or None,
+        reload_watch_interval=reload_watch,
     )
 
 
@@ -755,6 +763,29 @@ def artifacts_unpack(output_dir, dest):
     except artifacts.PackError as exc:
         raise click.ClickException(str(exc))
     click.echo(json.dumps({"unpacked": len(written), "dest": dest}))
+
+
+@artifacts_group.command("gc")
+@click.option("--dir", "output_dir", required=True,
+              help="A v2 build output dir (its pack index is read).")
+@click.option("--keep", default=2, show_default=True,
+              help="Generation records to retain (newest first). The "
+                   "live generation always survives; retired pack files "
+                   "no retained generation references are unlinked.")
+def artifacts_gc(output_dir, keep):
+    """Prune artifact-generation history and the retired pack files it
+    kept reloadable.  Builds and delta writes retire superseded packs
+    instead of deleting them (so any retained generation stays loadable
+    for rollback); this reclaims the disk once the history is no longer
+    wanted.  Refuses --keep 0: the live generation is never collectable.
+    Set GORDO_GC_KEEP to auto-prune on every build's generation stamp."""
+    from gordo_tpu import artifacts
+
+    try:
+        summary = artifacts.gc_generations(output_dir, keep)
+    except (artifacts.PackError, ValueError) as exc:
+        raise click.ClickException(str(exc))
+    click.echo(json.dumps(summary, indent=1))
 
 
 # ---------------------------------------------------------------------------

@@ -303,11 +303,78 @@ class Client:
         )
         return {
             k: v for k, v in body.items()
-            if k.startswith("artifact-")
+            if k.startswith("artifact-") or k == "fleet-generation"
         }
 
     def artifact_info(self) -> Dict[str, Any]:
         return _run(self._with_session(self.artifact_info_async))
+
+    async def fleet_generation_async(
+        self, session: aiohttp.ClientSession
+    ) -> Dict[str, int]:
+        """The artifact generation each replica currently serves, keyed
+        by replica base URL (one entry against an unsharded server)."""
+        bases = (
+            self.replica_urls
+            if self.replica_urls and len(self.replica_urls) > 1
+            else [self.base_url]
+        )
+        out: Dict[str, int] = {}
+        for base in bases:
+            body = await get_json(
+                session, self._project_url(base),
+                retries=self.n_retries, timeout=self.timeout,
+            )
+            out[base] = int(body.get("fleet-generation", 0))
+        return out
+
+    async def wait_for_generation_async(
+        self,
+        session: aiohttp.ClientSession,
+        generation: int,
+        timeout: float = 120.0,
+        poll_interval: float = 0.5,
+    ) -> Dict[str, int]:
+        """Block until EVERY replica reports ``fleet-generation >=
+        generation`` — the rollout handshake after a build publishes a
+        new artifact generation: stamp, then wait here before flipping
+        traffic expectations.  Replicas that error mid-poll (rolling
+        restarts) are retried until the deadline.  Returns the final
+        per-replica generation map; raises :class:`TimeoutError` when
+        the deadline passes first."""
+        import time
+
+        deadline = time.monotonic() + float(timeout)
+        last: Dict[str, int] = {}
+        while True:
+            try:
+                last = await self.fleet_generation_async(session)
+            except Exception as exc:
+                logger.debug("generation poll failed: %s", exc)
+            if last and all(
+                g >= int(generation) for g in last.values()
+            ):
+                return last
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"fleet did not reach generation {generation} within "
+                    f"{timeout}s (last seen: {last or 'unreachable'})"
+                )
+            await asyncio.sleep(poll_interval)
+
+    def wait_for_generation(
+        self,
+        generation: int,
+        timeout: float = 120.0,
+        poll_interval: float = 0.5,
+    ) -> Dict[str, int]:
+        return _run(self._with_session(
+            self.wait_for_generation_async, generation, timeout,
+            poll_interval,
+        ))
+
+    def fleet_generation(self) -> Dict[str, int]:
+        return _run(self._with_session(self.fleet_generation_async))
 
     async def fleet_health_async(
         self, session: aiohttp.ClientSession, top: Optional[int] = None

@@ -497,6 +497,160 @@ class _PrestackMiss(Exception):
     fall back to the generic per-leaf stacking path."""
 
 
+def _delta_bucket(
+    bucket: _Bucket, store, touched: List[str], dtype: str
+) -> _Bucket:
+    """One bucket's successor after a delta generation flip: re-stack
+    ONLY the touched pack runs into the prestacked device buffers.
+
+    The delta contract (``artifacts.delta_write``) guarantees stable
+    membership, slots, and leaf shapes, so the new bucket's device
+    tensors are assembled from the OLD bucket's device arrays (zero-copy
+    device slices for every untouched pack run) plus one
+    ``artifacts.to_device`` per TOUCHED pack — host→device traffic is
+    O(changed packs), and identical shapes mean the compile plane
+    resolves every program of the new bucket from cache (zero compiles).
+    Raises :class:`_PrestackMiss` whenever the geometry drifted (members
+    moved packs, slots went non-contiguous, sharded placement) — the
+    caller falls back to a full restack, never serves a misaligned view.
+    """
+    if bucket.mesh is not None:
+        # sharded buckets interleave pad slots — rebuild wholesale
+        raise _PrestackMiss()
+    member_set = set(bucket.names)
+    pack_ids: List[str] = []
+    for n in bucket.names:
+        if n not in store:
+            raise _PrestackMiss()
+        pid = store.location(n)[0]
+        if pid not in pack_ids:
+            pack_ids.append(pid)
+    runs: Dict[str, Tuple[int, int, int]] = {}
+    expect: List[str] = []
+    for pid in pack_ids:
+        live = store.machines_of(pid)
+        owned = [i for i, m in enumerate(live) if m in member_set]
+        lo, hi = owned[0], owned[-1] + 1
+        if owned != list(range(lo, hi)):
+            raise _PrestackMiss()
+        runs[pid] = (lo, hi, len(live))
+        expect.extend(live[lo:hi])
+    if expect != list(bucket.names):
+        raise _PrestackMiss()
+    touched_packs: List[str] = []
+    for n in touched:
+        pid = store.location(n)[0]
+        if pid not in touched_packs:
+            touched_packs.append(pid)
+
+    def lift(pid, live_count, a):
+        loc = store.leaf_of(a)
+        if loc is None or loc[0] != pid:
+            raise _PrestackMiss()
+        stacked = store.stacked(pid)[loc[1]]
+        if stacked.shape[0] != live_count:
+            raise _PrestackMiss()
+        lo, hi, _ = runs[pid]
+        return stacked[lo:hi]
+
+    new_parts: Dict[str, Any] = {}
+    thr_rows: Dict[str, np.ndarray] = {}
+    for pid in touched_packs:
+        lo, hi, n_live = runs[pid]
+        rep = _extract_chain(store.load_model(store.machines_of(pid)[lo]))
+        if rep is None:
+            raise _PrestackMiss()
+        take = lambda a, p=pid, m=n_live: lift(p, m, a)  # noqa: E731
+        host = (
+            jax.tree.map(take, rep["params"]),
+            tuple(jax.tree.map(take, st) for _, st in rep["scalers"]),
+            jax.tree.map(take, rep["detector"]["scaler_stats"]),
+        )
+        new_parts[pid] = artifacts.to_device(
+            host, dtype=precision.storage_np_dtype(dtype)
+        )
+        if bucket.with_thresholds:
+            ft = rep["detector"]["feature_thresholds"]
+            if ft is None:
+                raise _PrestackMiss()
+            thr_rows[pid] = np.asarray(take(ft))
+
+    old_leaves, treedef = jax.tree.flatten(
+        (bucket.params, bucket.scaler_stats, bucket.det_stats)
+    )
+    parts_leaves = {
+        pid: jax.tree.flatten(t)[0] for pid, t in new_parts.items()
+    }
+    offsets: Dict[str, Tuple[int, int]] = {}
+    pos = 0
+    for pid in pack_ids:
+        lo, hi, _ = runs[pid]
+        offsets[pid] = (pos, pos + (hi - lo))
+        pos += hi - lo
+    new_leaves = []
+    for i, old_leaf in enumerate(old_leaves):
+        pieces = []
+        for pid in pack_ids:
+            start, stop = offsets[pid]
+            if pid in parts_leaves:
+                pieces.append(parts_leaves[pid][i])
+            else:
+                # untouched run: a device slice of the resident stacked
+                # tensor — no host copy, no transfer
+                pieces.append(old_leaf[start:stop])
+        new_leaves.append(
+            pieces[0] if len(pieces) == 1
+            else jnp.concatenate(pieces, axis=0)
+        )
+    params, scaler_stats, det_stats = jax.tree.unflatten(
+        treedef, new_leaves
+    )
+
+    thresholds_np = bucket.thresholds_np
+    agg_np = bucket.agg_thresholds_np
+    if bucket.with_thresholds:
+        # COPIES, never in-place: in-flight dispatches against the old
+        # bucket assemble from its threshold arrays after their device
+        # work completes — mutating them would mix generations within
+        # one response
+        thresholds_np = np.array(bucket.thresholds_np, copy=True)
+        agg_np = np.array(bucket.agg_thresholds_np, copy=True)
+        for pid in touched_packs:
+            start, stop = offsets[pid]
+            thresholds_np[start:stop] = thr_rows[pid]
+        pos_of = {n: i for i, n in enumerate(bucket.names)}
+        for n in touched:
+            c = _extract_chain(store.load_model(n))
+            if c is None:
+                raise _PrestackMiss()
+            agg_np[pos_of[n]] = float(
+                c["detector"]["aggregate_threshold"] or 0.0
+            )
+
+    nb = _Bucket.__new__(_Bucket)
+    for attr in (
+        "names", "module", "scaler_classes", "mode", "lookback",
+        "det_cls", "smooth_window", "dtype", "with_thresholds", "mesh",
+        "m_pad", "n_features",
+    ):
+        setattr(nb, attr, getattr(bucket, attr))
+    nb.params, nb.scaler_stats, nb.det_stats = (
+        params, scaler_stats, det_stats
+    )
+    nb.thresholds_np = thresholds_np
+    nb.agg_thresholds_np = agg_np
+    nb.agg_thresholds = (
+        jnp.asarray(agg_np) if bucket.with_thresholds else None
+    )
+    # share the dispatch lock + pinned stacking buffers with the
+    # predecessor: old-scorer and new-scorer dispatches against "the
+    # same" bucket must serialize on one lock or a shared buffer could
+    # be overwritten mid-transfer during the handover window
+    nb._lock = bucket._lock
+    nb._stack_bufs = bucket._stack_bufs
+    return nb
+
+
 def _prestack_group(
     store, names: List[str], chains: List[Dict[str, Any]]
 ):
@@ -756,6 +910,72 @@ class FleetScorer:
             for pos, name in enumerate(names):
                 self.machine_bucket[name] = (idx, pos)
         return self
+
+    def delta_restack(
+        self,
+        models: Dict[str, Any],
+        pack_store: Optional[Any],
+        changed: List[str],
+        mesh: Optional[Any] = None,
+    ) -> "FleetScorer":
+        """O(changed-machines) successor scorer after a generation flip.
+
+        Buckets with no changed member are REUSED wholesale — same
+        ``_Bucket`` object, same resident device arrays, zero transfers.
+        Buckets with changed members rebuild through
+        :func:`_delta_bucket`: one ``to_device`` per touched pack, device
+        slices for everything else.  Every bucket (reused or rebuilt)
+        keeps its dispatch shapes, so the compile plane serves all of the
+        successor's programs from cache — a delta reload compiles
+        nothing.
+
+        The delta contract is checked, not assumed: membership drift
+        (machines added/removed), signature drift, or geometry drift in
+        any touched bucket falls back to a full :meth:`from_models`
+        restack.  The old scorer is never mutated — callers keep serving
+        it until they swap the returned one in.
+        """
+        def full() -> "FleetScorer":
+            return FleetScorer.from_models(
+                models, mesh=mesh, pack_store=pack_store, dtype=self.dtype
+            )
+
+        changed_set = set(changed)
+        if set(models) != set(self.models):
+            return full()
+        if pack_store is None and changed_set:
+            return full()
+        known = set(self.machine_bucket) | set(self.fallbacks)
+        if not changed_set <= known:
+            return full()
+        new = FleetScorer()
+        new.dtype = self.dtype
+        new.models = dict(models)
+        new.fallbacks = dict(self.fallbacks)
+        new._machine_scorers = {
+            n: s for n, s in self._machine_scorers.items()
+            if n not in changed_set
+        }
+        try:
+            for n in changed_set & set(new.fallbacks):
+                new.fallbacks[n] = CompiledScorer(
+                    models[n], dtype=self.dtype, machine=n
+                )
+            for bucket in self.buckets:
+                touched = [n for n in bucket.names if n in changed_set]
+                nb = (
+                    bucket if not touched
+                    else _delta_bucket(
+                        bucket, pack_store, touched, self.dtype
+                    )
+                )
+                idx = len(new.buckets)
+                new.buckets.append(nb)
+                for pos, name in enumerate(nb.names):
+                    new.machine_bucket[name] = (idx, pos)
+        except _PrestackMiss:
+            return full()
+        return new
 
     @property
     def n_stacked(self) -> int:

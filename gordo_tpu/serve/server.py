@@ -68,6 +68,16 @@ _SHARD_COUNT_GAUGE = telemetry.gauge(
     "gordo_server_shard_count",
     "Shard count of the serving tier this replica belongs to",
 )
+_FLEET_GENERATION_GAUGE = telemetry.gauge(
+    "gordo_fleet_generation",
+    "Artifact generation this replica is serving (set at scrape time)",
+)
+_RELOADS_TOTAL = telemetry.counter(
+    "gordo_server_reloads_total",
+    "Completed artifact reloads by kind (delta = O(changed-machines) "
+    "restack; full = complete scorer rebuild)",
+    labels=("kind",),
+)
 
 #: Prometheus exposition content type (text format 0.0.4)
 METRICS_CONTENT_TYPE = "text/plain"
@@ -161,6 +171,19 @@ class ModelEntry:
             self.model, dtype=serve_dtype, machine=self.name
         )
         self.mtime, self.size = ref.stat()
+        #: the artifact generation whose bytes this entry serves.  Pack
+        #: rows written but not yet stamped carry ``gen = active + 1``;
+        #: clamping to the store's published id makes a pending-loaded
+        #: entry reload once when its stamp lands (bytes identical —
+        #: harmless) instead of silently skipping the flip.  v1 dirs
+        #: have no generations and stay at 0.
+        if ref.kind == "pack" and ref._store is not None:
+            self.generation = min(
+                ref._store.row_generation(ref.name),
+                ref._store.generation,
+            )
+        else:
+            self.generation = 0
 
     @property
     def tags(self) -> List[str]:
@@ -231,6 +254,18 @@ class ModelCollection:
             serve_dtype
         ) else precision.serve_dtype()
         self._fleet_scorer = None
+        #: the published artifact generation these entries serve (0 for
+        #: v1 layouts / pre-generation indexes) — the value the watch
+        #: loop compares the on-disk GENERATION sidecar against
+        self.artifact_generation: int = (
+            int(getattr(pack_store, "generation", 0)) if pack_store else 0
+        )
+        #: True while a generation flip is being absorbed (entry rebuild
+        #: + delta restack in an executor thread).  Scoring NEVER blocks
+        #: on this — the old scorer keeps serving until the swap — but
+        #: /healthz surfaces it so rollout tooling can see a reload in
+        #: flight.
+        self.reloading: bool = False
         # guards the (entries, _fleet_scorer) pair: the background rescan
         # swaps both from an executor thread while bulk requests lazily
         # build the scorer from other executor threads
@@ -346,13 +381,16 @@ class ModelCollection:
 
     @property
     def generation(self) -> int:
-        """Fleet-generation stamp: a monotone-enough integer that changes
-        whenever the artifacts backing this collection change — the v2
-        pack index's mtime (nanosecond-truncated to ms), else the newest
-        loaded artifact's.  Watchman republishes it per target so a
-        rollout's propagation across shard replicas is visible from one
-        endpoint; it is a CHANGE DETECTOR, not a version: artifact
-        registry generations with atomic flips are ROADMAP item 1."""
+        """Fleet-generation stamp: for v2 packs with a generations layer,
+        the REAL published artifact generation id (small monotone ints —
+        what ``client.wait_for_generation`` converges on and watchman
+        republishes per target).  Layouts predating the generations layer
+        fall back to the old change-detector integers: the pack index's
+        mtime-in-ms, else the newest loaded artifact's — still monotone
+        enough for rollout visibility, never confusable with real ids
+        (ms timestamps are 13 digits, generation ids start at 1)."""
+        if self.artifact_generation > 0:
+            return self.artifact_generation
         if self.pack_store is not None:
             return int(self.pack_store.index_stat[0] * 1000)
         return int(
@@ -360,16 +398,43 @@ class ModelCollection:
             * 1000
         )
 
+    def maybe_delta_reload(self) -> Dict[str, List[str]]:
+        """The generation watch loop's poll: read the tiny ``GENERATION``
+        sidecar (one small file, no index parse, no pack validation) and
+        run a rescan only when the published id advanced past what this
+        collection serves.  Nothing blocks scoring either way."""
+        unchanged = {"added": [], "reloaded": [], "removed": []}
+        if self.source_dir is None:
+            return unchanged
+        try:
+            gen = artifacts.read_generation(self.source_dir)
+        except Exception:
+            logger.exception("generation poll failed")
+            return unchanged
+        if gen <= self.artifact_generation:
+            return unchanged
+        return self.rescan()
+
     def rescan(self) -> Dict[str, List[str]]:
         """Pick up artifacts dumped/rebuilt/removed after startup.
 
         The reference got this "for free" from its pod-per-model design (a
         new machine = a new pod); one process serving a whole project must
-        instead watch its artifact dir.  New artifacts load, changed ones
-        ((mtime, size) of model.pkl for v1 dirs, of the pack file for v2
-        slots — a delta-rewritten pack reloads all its machines) reload,
-        vanished ones drop.  The entries dict is replaced atomically so
-        in-flight requests keep a consistent view.
+        instead watch its artifact dir.  New artifacts load, vanished ones
+        drop, changed ones reload — v1 dirs on (mtime, size) of model.pkl,
+        v2 pack slots on the flock-serialized index GENERATION: a pack
+        machine reloads iff its row's generation is newer than its entry's
+        and no newer than the published id.  Pack mtimes are NOT a signal
+        (``delta_write`` mutates pack bytes in place, so mtime ticks while
+        a write is still torn; the generation flips only after the bytes
+        are fsync'd) and pending rows (``gen > published``) are invisible
+        until their build stamps.  When every change is a generation-gated
+        pack reload, the fleet scorer is rebuilt by ``delta_restack`` —
+        O(changed machines), one device transfer per touched pack, zero
+        compiles — and swapped under the lock while the old scorer keeps
+        serving; structural changes fall back to the full restack.  The
+        entries dict is replaced atomically so in-flight requests keep a
+        consistent view.
         """
         if self.source_dir is None or not os.path.isdir(self.source_dir):
             return {"added": [], "reloaded": [], "removed": []}
@@ -404,55 +469,120 @@ class ModelCollection:
             for ref in refs:
                 if ref.kind == "pack":
                     ref._store = store
-        added, reloaded = [], []
-        new_entries: Dict[str, ModelEntry] = {}
-        for ref in refs:
-            current = self.entries.get(ref.name)
-            # an index swap remaps every pack: reload its machines (cheap
-            # skeleton unpickles) so their views — and the fleet scorer's
-            # one-transfer prestacking — bind to the new store
-            force = ref.kind == "pack" and store is not self.pack_store
-            try:
-                if current is None:
-                    new_entries[ref.name] = ModelEntry.from_artifact(
-                        ref, serve_dtype=self.serve_dtype
+        store_generation = int(getattr(store, "generation", 0) or 0)
+        flip = (
+            store is not None
+            and store_generation != self.artifact_generation
+        )
+        if flip:
+            self.reloading = True
+        try:
+            added, reloaded, reloaded_dirs = [], [], []
+            new_entries: Dict[str, ModelEntry] = {}
+            for ref in refs:
+                current = self.entries.get(ref.name)
+                stale = False
+                if current is not None:
+                    if ref.kind == "pack" and store_generation > 0:
+                        # generation gating — the torn-write-safe signal:
+                        # delta_write rewrites pack bytes in place, so a
+                        # stat-based compare can reload mid-write; the
+                        # index generation flips only after fsync.  Rows
+                        # newer than the published id are pending (a
+                        # build still running) and must NOT load yet.
+                        row_gen = store.row_generation(ref.name)
+                        stale = (
+                            current.generation < row_gen <= store_generation
+                            # a restored/rolled-back index publishes an
+                            # OLDER id than the entry serves: adopt it
+                            or current.generation > store_generation
+                        )
+                    elif ref.kind == "pack":
+                        # pre-generation index (never stamped): the old
+                        # whole-store signals — an index swap remaps
+                        # every pack, and (mtime, size) drift reloads
+                        stale = store is not self.pack_store or (
+                            ref.stat() != (current.mtime, current.size)
+                        )
+                    else:
+                        # (mtime, size) inequality, not mtime>: a rebuild
+                        # can land with an equal-or-older mtime (cache
+                        # copies, clock skew) and must still reload.
+                        # Known blind spot: an mtime-preserving copy
+                        # (cp -p) of a same-size artifact is
+                        # indistinguishable without hashing content.
+                        stale = ref.stat() != (current.mtime, current.size)
+                try:
+                    if current is None:
+                        new_entries[ref.name] = ModelEntry.from_artifact(
+                            ref, serve_dtype=self.serve_dtype
+                        )
+                        added.append(ref.name)
+                    elif stale:
+                        new_entries[ref.name] = ModelEntry.from_artifact(
+                            ref, serve_dtype=self.serve_dtype
+                        )
+                        reloaded.append(ref.name)
+                        if ref.kind != "pack":
+                            reloaded_dirs.append(ref.name)
+                    else:
+                        new_entries[ref.name] = current
+                except Exception:
+                    logger.exception(
+                        "Failed to (re)load artifact %s", ref.ref
                     )
-                    added.append(ref.name)
-                elif force or ref.stat() != (current.mtime, current.size):
-                    # (mtime, size) inequality, not mtime>: a rebuild can
-                    # land with an equal-or-older mtime (cache copies, clock
-                    # skew) and must still reload.  Known blind spot: an
-                    # mtime-preserving copy (cp -p) of a same-size artifact
-                    # is indistinguishable without hashing content.
-                    new_entries[ref.name] = ModelEntry.from_artifact(
-                        ref, serve_dtype=self.serve_dtype
-                    )
-                    reloaded.append(ref.name)
-                else:
-                    new_entries[ref.name] = current
-            except Exception:
-                logger.exception("Failed to (re)load artifact %s", ref.ref)
-                if current is not None:  # keep serving the old model
-                    new_entries[ref.name] = current
-        removed = sorted(set(self.entries) - set(new_entries))
-        if added or reloaded or removed:
-            logger.info(
-                "Collection rescan: +%s ~%s -%s", added, reloaded, removed
-            )
-            with self._lock:  # swap entries + scorer reset atomically
-                self.entries = new_entries
-                self.pack_store = store
-                self._fleet_scorer = None  # stacked params must restack
-            # refresh drift baselines for (re)loaded artifacts — a
-            # rebuilt machine's NEW training distribution is the one its
-            # live window must be compared against from now on
-            telemetry.FLEET_HEALTH.load_baselines(
-                {
-                    name: new_entries[name].metadata
-                    for name in added + reloaded
-                    if name in new_entries
-                }
-            )
+                    if current is not None:  # keep serving the old model
+                        new_entries[ref.name] = current
+            removed = sorted(set(self.entries) - set(new_entries))
+            if added or reloaded or removed or flip:
+                logger.info(
+                    "Collection rescan: +%s ~%s -%s (generation %d -> %d)",
+                    added, reloaded, removed,
+                    self.artifact_generation, store_generation,
+                )
+                # while the successor scorer builds, the OLD one keeps
+                # serving — nothing below blocks a request until the
+                # quick swap under the lock
+                with self._lock:
+                    old_scorer = self._fleet_scorer
+                new_scorer = None
+                if (
+                    old_scorer is not None
+                    and store is not None
+                    and not added and not removed and not reloaded_dirs
+                ):
+                    try:
+                        new_scorer = old_scorer.delta_restack(
+                            {n: e.model for n, e in new_entries.items()},
+                            store,
+                            reloaded,
+                            mesh=self.serve_mesh,
+                        )
+                    except Exception:
+                        # a failed delta restack falls back to the lazy
+                        # full rebuild — never to a stale scorer
+                        logger.exception("delta restack failed")
+                        new_scorer = None
+                with self._lock:  # swap entries + scorer atomically
+                    self.entries = new_entries
+                    self.pack_store = store
+                    self._fleet_scorer = new_scorer
+                    self.artifact_generation = store_generation
+                _RELOADS_TOTAL.inc(
+                    1.0, "delta" if new_scorer is not None else "full"
+                )
+                # refresh drift baselines for (re)loaded artifacts — a
+                # rebuilt machine's NEW training distribution is the one
+                # its live window must be compared against from now on
+                telemetry.FLEET_HEALTH.load_baselines(
+                    {
+                        name: new_entries[name].metadata
+                        for name in added + reloaded
+                        if name in new_entries
+                    }
+                )
+        finally:
+            self.reloading = False
         # fleet view refreshes even when this shard's entries didn't
         # change: a machine added to ANOTHER shard must still 421-route
         # (not 404) from here, and the shard table must agree fleet-wide
@@ -951,10 +1081,18 @@ async def healthz(request: web.Request) -> web.Response:
     """
     fut = request.app.get(WARMUP_TASK_KEY)
     state = "warming" if (fut is not None and not fut.done()) else "ready"
+    collection = request.app.get(COLLECTION_KEY)
+    if state == "ready" and collection is not None and collection.reloading:
+        # a generation flip is being absorbed in the background; the OLD
+        # scorer keeps serving throughout, so this state never gates
+        # traffic — it is rollout visibility, not readiness
+        state = "reloading"
     doc: Dict[str, Any] = {
         "state": state,
         "gordo-server-version": gordo_tpu.__version__,
     }
+    if collection is not None:
+        doc["fleet-generation"] = collection.generation
     if state == "ready" and fut is not None:
         # a FAILED warmup still goes ready (the pod can serve; programs
         # compile lazily) but says so, so the init-container gate can tell
@@ -978,6 +1116,7 @@ async def metrics_endpoint(request: web.Request) -> web.Response:
     collection = request.app.get(COLLECTION_KEY)
     if collection is not None:
         _MACHINES_GAUGE.set(len(collection.entries))
+        _FLEET_GENERATION_GAUGE.set(float(collection.generation))
         if collection.shard is not None:
             _SHARD_INDEX_GAUGE.set(collection.shard.index)
             _SHARD_COUNT_GAUGE.set(collection.shard.count)
@@ -1050,6 +1189,7 @@ async def project_index(request: web.Request) -> web.Response:
     if store is not None:
         doc["artifact-packs"] = len(store.packs)
         doc["artifact-pack-bytes"] = store.total_bytes()
+        doc["artifact-generations-retained"] = len(store.generations)
     return web.json_response(doc)
 
 
@@ -1101,6 +1241,7 @@ def build_app(
     coalesce_min_concurrency: int = 2,
     coalesce_knee_batch: int = 0,
     health_rollup_interval: float = 0.0,
+    reload_watch_interval: float = 0.0,
 ) -> web.Application:
     """``rescan_interval > 0`` starts a background artifact-dir rescan so
     machines built after startup begin serving without a restart.
@@ -1120,6 +1261,13 @@ def build_app(
     lightly-loaded server keeps uncoalesced latency.
     ``coalesce_knee_batch`` pins the batch cap explicitly (0 = estimate
     it from a short warmup sweep on first use).
+    ``reload_watch_interval > 0`` starts the generation watch: a cheap
+    poll of the artifact index's ``GENERATION`` sidecar that triggers a
+    delta hot reload the moment a build (or ``delta_write``) stamps a
+    new generation — O(changed machines), zero compiles, the old scorer
+    serving until the swap.  It complements (does not replace) the
+    coarser full ``rescan_interval`` sweep, which also covers v1 dirs
+    and fleet membership changes.
     ``warmup`` precompiles the serving programs in a background executor
     task at startup (``warmup_scorers``) — the server accepts traffic
     immediately; an early request races the warmup at worst."""
@@ -1233,6 +1381,41 @@ def build_app(
         app.on_startup.append(_start)
         app.on_cleanup.append(_stop)
 
+    if reload_watch_interval > 0 and collection.source_dir is not None:
+
+        async def _reload_watch_loop(app: web.Application):
+            loop = asyncio.get_running_loop()
+            while True:
+                await asyncio.sleep(reload_watch_interval)
+                try:
+                    # the poll itself is one tiny file read; a detected
+                    # flip runs the (heavier) delta reload in the
+                    # executor so the accept loop never stalls
+                    await loop.run_in_executor(
+                        None, collection.maybe_delta_reload
+                    )
+                except Exception:
+                    logger.exception("generation watch failed")
+
+        async def _start_watch(app: web.Application):
+            app["_reload_watch_task"] = (
+                asyncio.get_running_loop().create_task(
+                    _reload_watch_loop(app)
+                )
+            )
+
+        async def _stop_watch(app: web.Application):
+            task = app.get("_reload_watch_task")
+            if task is not None:
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+
+        app.on_startup.append(_start_watch)
+        app.on_cleanup.append(_stop_watch)
+
     if health_rollup_interval > 0 and collection.source_dir is not None:
 
         def _write_health_rollup() -> None:
@@ -1320,6 +1503,7 @@ def run_server(
     warmup: bool = False,
     shard: Optional[str] = None,
     health_rollup_interval: Optional[float] = None,
+    reload_watch_interval: Optional[float] = None,
 ) -> None:
     """Blocking entrypoint (reference: ``gordo run-server``).
 
@@ -1335,6 +1519,11 @@ def run_server(
     ``health_rollup_interval``: seconds between fleet-health JSONL
     rollup lines under the artifact dir (default: the
     ``GORDO_HEALTH_ROLLUP_SECONDS`` env var, else 60; 0 disables).
+
+    ``reload_watch_interval``: seconds between generation-sidecar polls
+    for the delta hot reload (default: the ``GORDO_RELOAD_WATCH_SECONDS``
+    env var, else 5; 0 disables — the coarse rescan still reloads, just
+    slower and via a full restack).
     """
     if health_rollup_interval is None:
         try:
@@ -1343,6 +1532,13 @@ def run_server(
             )
         except ValueError:
             health_rollup_interval = 60.0
+    if reload_watch_interval is None:
+        try:
+            reload_watch_interval = float(
+                os.environ.get("GORDO_RELOAD_WATCH_SECONDS", "") or 5.0
+            )
+        except ValueError:
+            reload_watch_interval = 5.0
     from gordo_tpu.serve.shard import ShardSpec
 
     if isinstance(shard, str):
@@ -1386,6 +1582,7 @@ def run_server(
             coalesce_knee_batch=coalesce_knee_batch,
             warmup=warmup,
             health_rollup_interval=health_rollup_interval,
+            reload_watch_interval=reload_watch_interval,
         ),
         host=host,
         port=port,

@@ -313,6 +313,16 @@ def _serve_dtype_env(serve_dtype: Optional[str]) -> List[Dict[str, str]]:
     return [{"name": "GORDO_SERVE_DTYPE", "value": canonical(serve_dtype)}]
 
 
+def _reload_watch_env() -> Dict[str, str]:
+    """``GORDO_RELOAD_WATCH_SECONDS`` for server pods: poll the artifact
+    index's generation sidecar (one tiny file read off the models PVC)
+    so a builder Job's generation stamp hot-reloads only the changed
+    machines into the running replicas — no pod restart, no recompile.
+    Stamped explicitly (even though 5 is also the library default) so
+    the manifest documents the knob where operators tune it."""
+    return {"name": "GORDO_RELOAD_WATCH_SECONDS", "value": "5"}
+
+
 def _builder_job(
     project: str,
     image: str,
@@ -449,6 +459,7 @@ def _server_deployment(
                             # time
                             "env": [
                                 _compile_cache_env(),
+                                _reload_watch_env(),
                                 *shard_env,
                                 *_serve_dtype_env(serve_dtype),
                             ],

@@ -149,6 +149,133 @@ class TestDeltaWrite:
             artifacts.delta_write(str(tmp_path), {"m-0": bad})
 
 
+class TestGenerations:
+    """ISSUE 11: the versioned-generations layer over the pack index."""
+
+    def test_stamp_publishes_pending_rows_once(self, tmp_path):
+        names, _, _ = _write(tmp_path)
+        # pack writes land pending — nothing published until the stamp
+        assert artifacts.read_generation(str(tmp_path)) == 0
+        assert artifacts.stamp_generation(str(tmp_path)) == 1
+        # idempotent: a second stamp with nothing pending is a no-op
+        assert artifacts.stamp_generation(str(tmp_path)) == 1
+        store = artifacts.open_store(str(tmp_path))
+        assert store.generation == 1
+        assert all(int(store.machines[n]["gen"]) == 1 for n in names)
+
+    def test_delta_write_stamps_its_own_flip(self, tmp_path):
+        _, models, _ = _write(tmp_path)
+        artifacts.stamp_generation(str(tmp_path))
+        new = dict(models[1])
+        new["w"] = new["w_again"] = np.full((8, 3), 5.0, np.float32)
+        artifacts.delta_write(str(tmp_path), {"m-1": new})
+        assert artifacts.read_generation(str(tmp_path)) == 2
+        store = artifacts.open_store(str(tmp_path))
+        assert int(store.machines["m-1"]["gen"]) == 2
+        assert int(store.machines["m-0"]["gen"]) == 1
+
+    def test_generation_sidecar_heals_on_stamp(self, tmp_path):
+        _write(tmp_path)
+        artifacts.stamp_generation(str(tmp_path))
+        sidecar = os.path.join(
+            artifacts.packs_dir(str(tmp_path)), artifacts.GENERATION_FILE
+        )
+        os.remove(sidecar)
+        # reads fall back to the index document...
+        assert artifacts.read_generation(str(tmp_path)) == 1
+        # ...and a no-op stamp rewrites the sidecar
+        assert artifacts.stamp_generation(str(tmp_path)) == 1
+        assert os.path.exists(sidecar)
+
+    def test_gc_refuses_keep_below_one(self, tmp_path):
+        _write(tmp_path)
+        with pytest.raises(ValueError, match="live generation"):
+            artifacts.gc_generations(str(tmp_path), 0)
+
+    def test_gc_prunes_history_to_keep(self, tmp_path):
+        _, models, _ = _write(tmp_path)
+        artifacts.stamp_generation(str(tmp_path))
+        for v in (5.0, 6.0, 7.0):
+            new = dict(models[1])
+            new["w"] = new["w_again"] = np.full((8, 3), v, np.float32)
+            artifacts.delta_write(str(tmp_path), {"m-1": new})
+        assert artifacts.read_generation(str(tmp_path)) == 4
+        summary = artifacts.gc_generations(str(tmp_path), 2)
+        assert summary["generation"] == 4
+        assert summary["retained"] == [3, 4]
+        store = artifacts.open_store(str(tmp_path))
+        assert sorted(int(g) for g in store.generations) == [3, 4]
+
+
+class TestGenerationGatedRescan:
+    """ISSUE 11 satellite: the rescan reload signal is the published
+    generation, never pack mtimes — ``delta_write`` mutates pack bytes
+    in place, so mtime ticks while a write is still torn."""
+
+    @staticmethod
+    def _publish(tmp_path, generation, row_gens=None):
+        pdir = artifacts.packs_dir(str(tmp_path))
+        idx = os.path.join(pdir, "index.json")
+        with open(idx) as fh:
+            doc = json.load(fh)
+        doc["generation"] = generation
+        for name, g in (row_gens or {}).items():
+            doc["machines"][name]["gen"] = g
+        with open(idx, "w") as fh:
+            json.dump(doc, fh)
+        with open(
+            os.path.join(pdir, artifacts.GENERATION_FILE), "w"
+        ) as fh:
+            fh.write(str(generation))
+
+    def test_torn_write_defers_reload_until_flip(self, tmp_path):
+        from gordo_tpu.serve.server import ModelCollection
+
+        _, models, _ = _write(tmp_path)
+        artifacts.stamp_generation(str(tmp_path))
+        coll = ModelCollection.from_directory(str(tmp_path))
+        assert coll.generation == 1
+        unchanged = {"added": [], "reloaded": [], "removed": []}
+        assert coll.rescan() == unchanged
+
+        new = dict(models[1])
+        new["w"] = new["w_again"] = np.full((8, 3), 9.0, np.float32)
+        artifacts.delta_write(str(tmp_path), {"m-1": new})
+        # reopen the torn window: bytes + row gen landed, flip did not
+        self._publish(tmp_path, 1, {"m-1": 2})
+        # mtime ticked and bytes changed — and the rescan must NOT act
+        assert coll.maybe_delta_reload() == unchanged
+        assert coll.rescan() == unchanged
+        assert coll.entries["m-1"].generation == 1
+
+        # land the flip: exactly the changed machine reloads
+        self._publish(tmp_path, 2, {"m-1": 2})
+        changes = coll.maybe_delta_reload()
+        assert changes["reloaded"] == ["m-1"]
+        assert coll.entries["m-1"].generation == 2
+        assert coll.generation == 2
+        # and the watch poll goes quiet again
+        assert coll.maybe_delta_reload() == unchanged
+
+    def test_generation_rollback_reloads_newer_entries(self, tmp_path):
+        from gordo_tpu.serve.server import ModelCollection
+
+        _, models, _ = _write(tmp_path)
+        artifacts.stamp_generation(str(tmp_path))
+        coll = ModelCollection.from_directory(str(tmp_path))
+        new = dict(models[1])
+        new["w"] = new["w_again"] = np.full((8, 3), 4.0, np.float32)
+        artifacts.delta_write(str(tmp_path), {"m-1": new})
+        assert coll.rescan()["reloaded"] == ["m-1"]
+        assert coll.generation == 2
+        # a restored backup can publish an OLDER id: entries newer than
+        # the store must reload instead of pinning stale device state
+        self._publish(tmp_path, 1, {"m-1": 1})
+        assert coll.rescan()["reloaded"] == ["m-1"]
+        assert coll.generation == 1
+        assert coll.entries["m-1"].generation == 1
+
+
 class TestCorruptionIsLoud:
     def test_truncated_pack_fails_open(self, tmp_path):
         _, _, pack_id = _write(tmp_path)
@@ -583,3 +710,162 @@ gordo_tpu.pipeline.Pipeline:
         assert coll.entries["plain-0"].scorer.predict(X).shape == (40, 3)
         out_fleet = coll.fleet_scorer.score_all({"fleet-0": X})
         assert "total-anomaly-score" in out_fleet["fleet-0"]
+
+
+@pytest.mark.slow
+class TestHotReload:
+    """ISSUE 11: zero-downtime delta hot reload of a serving collection.
+
+    One built 5-machine v2 project (class-scoped, like TestV1V2Parity);
+    the tests advance its generation with delta_writes and assert the
+    serving collection follows in O(changed-machines): pack-granular
+    device transfers, wholesale bucket reuse, byte-identity with a cold
+    load, and per-machine generation consistency under concurrent
+    scoring."""
+
+    @pytest.fixture(scope="class")
+    def served(self, tmp_path_factory):
+        from gordo_tpu.builder import build_project
+        from gordo_tpu.workflow.config import Machine
+
+        out = str(tmp_path_factory.mktemp("hotreload") / "v2")
+        machines = [
+            Machine.from_config({
+                "name": f"pm-{i}",
+                "dataset": {
+                    "type": "RandomDataset",
+                    "tag_list": ["a", "b", "c"],
+                    "train_start_date": "2017-12-25T06:00:00Z",
+                    "train_end_date": "2017-12-26T06:00:00Z",
+                },
+            })
+            for i in range(5)
+        ]
+        result = build_project(
+            machines, out, max_bucket_size=2, artifact_format="v2",
+        )
+        assert not result.failed
+        assert artifacts.read_generation(out) >= 1
+        return out
+
+    def test_delta_reload_is_pack_granular_and_byte_identical(self, served):
+        import pickle
+
+        from gordo_tpu.serve.server import ModelCollection
+
+        coll = ModelCollection.from_directory(served)
+        rng = np.random.default_rng(0)
+        X = {
+            n: rng.standard_normal((300, 3)).astype(np.float32)
+            for n in coll.entries
+        }
+        before = coll.fleet_scorer.score_all(X)
+        scorer_before = coll._fleet_scorer
+        buckets_before = list(scorer_before.buckets)
+
+        name = "pm-0"
+        rebuilt = pickle.loads(pickle.dumps(coll.entries[name].model))
+        rebuilt.aggregate_threshold_ = 123.0
+        artifacts.delta_write(served, {name: rebuilt})
+        d0 = artifacts.device_put_count()
+        changes = coll.maybe_delta_reload()
+        dputs = artifacts.device_put_count() - d0
+
+        assert changes["reloaded"] == [name]
+        assert coll.generation == artifacts.read_generation(served)
+        assert coll.entries[name].model.aggregate_threshold_ == 123.0
+        # O(changed): ONE whole-pack transfer for the one touched pack
+        assert dputs == 1
+        # the swapped-in scorer reuses every untouched bucket wholesale
+        after_scorer = coll._fleet_scorer
+        assert after_scorer is not None
+        assert after_scorer is not scorer_before
+        touched = scorer_before.machine_bucket[name][0]
+        for i, (b_old, b_new) in enumerate(
+            zip(buckets_before, after_scorer.buckets)
+        ):
+            assert (b_new is not b_old) == (i == touched), i
+
+        # post-flip scoring is byte-identical to a cold load of the new
+        # generation; unchanged machines byte-identical to before
+        hot = coll.fleet_scorer.score_all(X)
+        cold = ModelCollection.from_directory(
+            served
+        ).fleet_scorer.score_all(X)
+        for n in hot:
+            for k in hot[n]:
+                assert (
+                    np.asarray(hot[n][k]).tobytes()
+                    == np.asarray(cold[n][k]).tobytes()
+                ), (n, k)
+                if n != name:
+                    assert (
+                        np.asarray(hot[n][k]).tobytes()
+                        == np.asarray(before[n][k]).tobytes()
+                    ), (n, k)
+        assert (
+            hot[name]["anomaly-confidence"].tobytes()
+            != before[name]["anomaly-confidence"].tobytes()
+        )
+
+    def test_concurrent_scoring_during_flip_stays_consistent(self, served):
+        import pickle
+        import threading
+
+        from gordo_tpu.serve.server import ModelCollection
+
+        coll = ModelCollection.from_directory(served)
+        rng = np.random.default_rng(1)
+        X = {
+            n: rng.standard_normal((200, 3)).astype(np.float32)
+            for n in coll.entries
+        }
+        base = coll.fleet_scorer.score_all(X)
+        name = "pm-1"
+        rebuilt = pickle.loads(pickle.dumps(coll.entries[name].model))
+        rebuilt.aggregate_threshold_ = 77.0
+
+        errors, outputs = [], []
+        stop = threading.Event()
+
+        def loop():
+            try:
+                while not stop.is_set():
+                    outputs.append(coll.fleet_scorer.score_all(X))
+            except Exception as exc:  # pragma: no cover - the assertion
+                errors.append(exc)
+
+        th = threading.Thread(target=loop)
+        th.start()
+        try:
+            artifacts.delta_write(served, {name: rebuilt})
+            changes = coll.maybe_delta_reload()
+            outputs_len_at_flip = len(outputs)
+        finally:
+            stop.set()
+            th.join(timeout=60)
+
+        assert not errors, errors
+        assert changes["reloaded"] == [name]
+        assert outputs, "scoring ran concurrently with the flip"
+
+        cold = ModelCollection.from_directory(served)
+        new = cold.fleet_scorer.score_all(X)
+        keys = sorted(base[name])
+        old_bytes = tuple(np.asarray(base[name][k]).tobytes() for k in keys)
+        new_bytes = tuple(np.asarray(new[name][k]).tobytes() for k in keys)
+        assert old_bytes != new_bytes
+        for o in outputs:
+            got = tuple(np.asarray(o[name][k]).tobytes() for k in keys)
+            # every response is one generation or the other — never a
+            # torn mix of old params with new thresholds
+            assert got in (old_bytes, new_bytes)
+            for n in o:
+                if n == name:
+                    continue
+                for k in o[n]:
+                    assert (
+                        np.asarray(o[n][k]).tobytes()
+                        == np.asarray(base[n][k]).tobytes()
+                    ), (n, k)
+        del outputs_len_at_flip

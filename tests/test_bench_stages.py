@@ -12,7 +12,7 @@ import bench
 
 def test_default_runs_every_stage_in_priority_order():
     assert bench.parse_stages([]) == [
-        "build", "build_pipeline", "artifact_io", "serving",
+        "build", "build_pipeline", "artifact_io", "hot_reload", "serving",
         "serving_precision", "serving_sharded", "serving_openloop",
         "telemetry_overhead", "health_overhead", "cold_start", "lstm",
     ]

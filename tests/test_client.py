@@ -255,3 +255,25 @@ def test_client_roundtrip_returns_server_time_columns(model_dir):
     # end - start is the dataset resolution (10min for RandomDataset builds)
     deltas = (frame[("end", "")] - frame.index).unique()
     assert len(deltas) == 1
+
+
+def test_fleet_generation_and_wait(model_dir):
+    """ISSUE 11 satellite: clients surface each replica's active artifact
+    generation and can await a generation fleet-wide."""
+
+    def run(port):
+        c = Client("cliproj", port=port)
+        gens = c.fleet_generation()
+        # already-satisfied wait returns immediately with the same map
+        waited = c.wait_for_generation(max(gens.values()), timeout=10)
+        try:
+            c.wait_for_generation(max(gens.values()) + 1, timeout=1.0)
+            timed_out = False
+        except TimeoutError:
+            timed_out = True
+        return gens, waited, timed_out
+
+    gens, waited, timed_out = _serve_and(model_dir, run)
+    assert gens and all(g > 0 for g in gens.values())
+    assert waited == gens
+    assert timed_out, "an unreached generation must raise TimeoutError"
