@@ -23,6 +23,7 @@ import shutil
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from gordo_tpu import serializer
+from gordo_tpu.artifacts.fsck import fsck  # noqa: F401
 from gordo_tpu.artifacts.generations import (  # noqa: F401
     gc_generations,
     read_generation,
@@ -61,7 +62,7 @@ __all__ = [
     "machine_ref", "parse_ref", "is_pack_ref",
     "write_pack", "delta_write", "flatten_model", "to_device",
     "device_put_count", "repack", "unpack", "store_info", "packs_dir",
-    "stamp_generation", "read_generation", "gc_generations",
+    "stamp_generation", "read_generation", "gc_generations", "fsck",
 ]
 
 
@@ -109,18 +110,22 @@ class ArtifactRef:
             return 0.0, -1
 
 
-def open_store(path: str) -> Optional[PackStore]:
+def open_store(path: str, quarantine: bool = False) -> Optional[PackStore]:
     """The :class:`PackStore` for ``path`` (a build output dir, or its
     ``.gordo-packs/`` directly); None when no v2 index exists.  A present
-    but corrupt index raises :class:`PackCorruptError` — loudly."""
+    but corrupt index raises :class:`PackCorruptError` — loudly.
+    ``quarantine`` records corrupt PACKS on the store instead of raising
+    (the serving path; see :class:`PackStore`)."""
     candidates = [path, packs_dir(path)]
     for directory in candidates:
         if os.path.exists(os.path.join(directory, "index.json")):
-            return PackStore(directory)
+            return PackStore(directory, quarantine=quarantine)
     return None
 
 
-def discover(path: str) -> Tuple[Optional[PackStore], List[ArtifactRef]]:
+def discover(
+    path: str, quarantine: bool = False
+) -> Tuple[Optional[PackStore], List[ArtifactRef]]:
     """Every machine artifact under ``path``, both formats unified.
 
     v2 pack machines come from the index; v1 per-machine dirs fill in
@@ -129,9 +134,11 @@ def discover(path: str) -> Tuple[Optional[PackStore], List[ArtifactRef]]:
     machine present in both resolves to its pack entry: the index is
     authoritative, leftovers are stale.  ``path`` may also be a single
     machine's artifact dir (the v1 single-machine serve case).
+    ``quarantine`` opens the store in quarantine mode (corrupt packs
+    recorded on it, their machines absent from the refs).
     """
     refs: List[ArtifactRef] = []
-    store = open_store(path)
+    store = open_store(path, quarantine=quarantine)
     packed: Set[str] = set()
     if store is not None:
         for name in store.names():

@@ -1,0 +1,232 @@
+"""Crash-safe writer audit: ``gordo artifacts fsck``.
+
+Every write in the v2 pack layout is ``tmp + os.replace`` + dir fsync,
+so a crash can leave exactly two classes of debris: orphaned
+``*.tmp.<pid>`` files (a writer died between the durable tmp write and
+the rename) and a stale ``GENERATION`` sidecar (the sidecar publish
+rides the index flock, but a crash between index replace and sidecar
+replace leaves the sidecar one generation behind).  Everything else the
+format can detect — a truncated pack, an index segment pointing past
+EOF, an unreadable meta doc — is a *finding* that quarantine (serve
+plane) or a rebuild must address; fsck reports it but never deletes a
+referenced file.
+
+:func:`fsck` walks every invariant and returns a report; with
+``repair=True`` it sweeps orphan tmp files and re-publishes a lagging
+sidecar.  The server runs ``fsck(repair=True)`` at start
+(``run_server``), and operators run ``gordo artifacts fsck [--repair]``
+— the playbook lives in docs/operations.md.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import struct
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from gordo_tpu import telemetry
+from gordo_tpu.artifacts.pack import (
+    GENERATION_FILE,
+    PACK_MAGIC,
+    PACK_VERSION,
+    PackCorruptError,
+    _locked_index_update,
+    _read_index,
+    _write_generation_file,
+    packs_dir,
+)
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["fsck"]
+
+_FSCK_FINDINGS = telemetry.counter(
+    "gordo_artifact_fsck_findings_total",
+    "fsck findings by kind (orphan_tmp | pack | meta | index | sidecar | "
+    "machine_row)",
+    labels=("kind",),
+)
+
+
+def _finding(
+    report: Dict[str, Any], kind: str, detail: str, **extra: Any
+) -> None:
+    _FSCK_FINDINGS.inc(1.0, kind)
+    report["findings"].append({"kind": kind, "detail": detail, **extra})
+
+
+def _check_pack_entry(
+    directory: str, pack_id: str, entry: Dict[str, Any],
+    report: Dict[str, Any],
+) -> None:
+    path = os.path.join(directory, entry["file"])
+    try:
+        size = os.stat(path).st_size
+        with open(path, "rb") as fh:
+            header = fh.read(8)
+    except OSError as exc:
+        _finding(report, "pack", f"pack {pack_id} unreadable: {exc}",
+                 pack=pack_id)
+        return
+    if header[:4] != PACK_MAGIC:
+        _finding(report, "pack",
+                 f"pack {pack_id} has bad magic {header[:4]!r}", pack=pack_id)
+        return
+    (version,) = struct.unpack("<I", header[4:8])
+    if version != PACK_VERSION:
+        _finding(report, "pack",
+                 f"pack {pack_id} has version {version}, reader speaks "
+                 f"{PACK_VERSION}", pack=pack_id)
+    ends = [
+        t["offset"]
+        + int(np.prod(t["shape"])) * np.dtype(t["dtype"]).itemsize
+        for t in entry["tensors"]
+    ] + [off + length for off, length in entry["skeletons"]]
+    if ends and max(ends) > size:
+        _finding(report, "pack",
+                 f"pack {pack_id} truncated: index addresses byte "
+                 f"{max(ends)} but the file has {size}", pack=pack_id)
+    meta_path = os.path.join(directory, entry["meta_file"])
+    try:
+        with open(meta_path) as fh:
+            json.load(fh)
+    except FileNotFoundError:
+        pass  # meta is optional at read time (defaults apply)
+    except (OSError, ValueError) as exc:
+        _finding(report, "meta",
+                 f"pack {pack_id} metadata unreadable: {exc}", pack=pack_id)
+
+
+def fsck(output_dir: str, repair: bool = False) -> Dict[str, Any]:
+    """Audit (and optionally repair) the pack layout under ``output_dir``.
+
+    Returns a report dict: ``ok`` (no findings), ``findings`` (each with
+    a ``kind`` — see the module counter), ``repaired`` (actions taken
+    when ``repair=True``), plus counts.  Never raises on corrupt state —
+    the whole point is to enumerate it.
+    """
+    directory = packs_dir(output_dir)
+    if not os.path.isdir(directory):
+        # also accept the packs dir itself, the way open_store does
+        if os.path.exists(os.path.join(output_dir, "index.json")):
+            directory = output_dir
+        else:
+            return {
+                "directory": directory, "ok": True, "findings": [],
+                "repaired": [], "packs_checked": 0, "machine_rows": 0,
+                "note": "no v2 pack index (nothing to check)",
+            }
+
+    report: Dict[str, Any] = {
+        "directory": directory, "findings": [], "repaired": [],
+        "packs_checked": 0, "machine_rows": 0,
+    }
+
+    # 1) orphaned tmp files — debris of a writer that died before rename.
+    #    tmp names end in the writer's pid; a live writer's files are in
+    #    flight, not orphans.
+    for fname in sorted(os.listdir(directory)):
+        if ".tmp." not in fname:
+            continue
+        path = os.path.join(directory, fname)
+        try:
+            writer_pid = int(fname.rsplit(".", 1)[-1])
+        except ValueError:
+            writer_pid = None
+        writer_alive = False
+        if writer_pid is not None and writer_pid != os.getpid():
+            try:
+                os.kill(writer_pid, 0)
+                writer_alive = True
+            except OSError:
+                writer_alive = False
+        if writer_alive:
+            continue
+        _finding(report, "orphan_tmp",
+                 f"orphaned tmp file {fname} (writer died before rename)",
+                 file=fname)
+        if repair:
+            try:
+                os.unlink(path)
+                report["repaired"].append(f"removed {fname}")
+            except OSError as exc:
+                logger.warning("fsck could not remove %s: %s", path, exc)
+
+    # 2) the index itself
+    doc: Optional[Dict[str, Any]] = None
+    try:
+        doc = _read_index(directory)
+    except PackCorruptError as exc:
+        _finding(report, "index", str(exc))
+    if doc is None:
+        if not report["findings"]:
+            report["note"] = "no index.json (nothing to check)"
+        report["ok"] = not report["findings"]
+        return report
+
+    # 3) every pack entry: file present, magic/version, segments in range,
+    #    meta readable
+    for pack_id, entry in sorted(doc.get("packs", {}).items()):
+        report["packs_checked"] += 1
+        _check_pack_entry(directory, pack_id, entry, report)
+
+    # 4) machine rows point at live packs and valid slots
+    for name, row in sorted(doc.get("machines", {}).items()):
+        report["machine_rows"] += 1
+        entry = doc["packs"].get(row.get("pack"))
+        if entry is None:
+            _finding(report, "machine_row",
+                     f"machine {name!r} references missing pack "
+                     f"{row.get('pack')!r}", machine=name)
+        elif not 0 <= int(row.get("slot", -1)) < len(entry["skeletons"]):
+            _finding(report, "machine_row",
+                     f"machine {name!r} slot {row.get('slot')} outside pack "
+                     f"{row['pack']} ({len(entry['skeletons'])} slots)",
+                     machine=name)
+
+    # 5) GENERATION sidecar agrees with the index (a crash between the
+    #    index replace and the sidecar replace leaves it behind)
+    index_gen = int(doc.get("generation", 0))
+    sidecar_path = os.path.join(directory, GENERATION_FILE)
+    sidecar_gen: Optional[int] = None
+    if os.path.exists(sidecar_path):
+        try:
+            with open(sidecar_path) as fh:
+                sidecar_gen = int(fh.read().strip() or 0)
+        except (OSError, ValueError) as exc:
+            _finding(report, "sidecar",
+                     f"GENERATION sidecar unreadable: {exc}")
+    if sidecar_gen is not None and sidecar_gen != index_gen:
+        _finding(report, "sidecar",
+                 f"GENERATION sidecar says {sidecar_gen} but the index is "
+                 f"at {index_gen}")
+        if repair:
+            # re-publish under the index flock, same as a stamp would —
+            # the sidecar may never run ahead of the index it summarizes
+            try:
+                _locked_index_update(
+                    directory, lambda d: None,
+                    after=lambda d: _write_generation_file(
+                        directory, int(d.get("generation", 0))
+                    ),
+                )
+                report["repaired"].append(
+                    f"re-published GENERATION sidecar at {index_gen}"
+                )
+            except Exception as exc:
+                logger.warning("fsck sidecar repair failed: %s", exc)
+
+    report["generation"] = index_gen
+    report["ok"] = not report["findings"] or (
+        repair
+        and all(
+            f["kind"] in ("orphan_tmp", "sidecar")
+            for f in report["findings"]
+        )
+        and bool(report["repaired"])
+    )
+    return report

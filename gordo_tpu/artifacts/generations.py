@@ -54,15 +54,20 @@ def read_generation(output_dir: str) -> int:
 
 
 def stamp_generation(
-    output_dir: str, keep: Optional[int] = None
+    output_dir: str, keep: Optional[int] = None, force: bool = False
 ) -> int:
     """Publish every pending pack row as ONE new generation.
 
     Idempotent: when no rows are pending (a fully-cached rebuild, or a
     second stamp) the published generation is returned unchanged — no
-    flip, no reload churn downstream.  ``keep`` prunes history to the
-    newest N generations after the flip (the ``GORDO_GC_KEEP`` env var
-    does the same on every stamp).  Returns the published generation.
+    flip, no reload churn downstream.  ``force=True`` flips anyway,
+    republishing EVERY machine row: the operator heal path for pack
+    bytes restored out-of-band (from a healthy replica, say) — no build
+    wrote pending rows, yet serving replicas must be made to re-validate
+    and drop their quarantine (``gordo artifacts flip``).  ``keep``
+    prunes history to the newest N generations after the flip (the
+    ``GORDO_GC_KEEP`` env var does the same on every stamp).  Returns
+    the published generation.
     """
     directory = packs_dir(output_dir)
     if not os.path.exists(_index_path(directory)):
@@ -74,6 +79,8 @@ def stamp_generation(
             name for name, row in doc["machines"].items()
             if int(row.get("gen", 0)) > current
         )
+        if not pending and force:
+            pending = sorted(doc["machines"])
         if pending:
             _record_generation(directory, doc, pending)
         if keep is not None:

@@ -72,7 +72,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
-from gordo_tpu import telemetry
+from gordo_tpu import faults, telemetry
 from gordo_tpu.utils.disk_registry import fsync_dir
 
 logger = logging.getLogger(__name__)
@@ -469,6 +469,10 @@ def write_pack(
         fh.flush()
         os.fsync(fh.fileno())
         n_bytes = fh.tell()
+    # injection seam: "enospc" surfaces as OSError to the caller, "crash"
+    # aborts between the durable tmp write and the rename — exactly the
+    # torn state `gordo artifacts fsck` must detect and sweep
+    faults.check("artifact.write", op="write_pack", file=pack_file)
     os.replace(tmp, os.path.join(directory, pack_file))
 
     meta_doc = {
@@ -535,6 +539,7 @@ def delta_write(
     boundary.  Returns the machine names rewritten.
     """
     directory = packs_dir(output_dir)
+    faults.check("artifact.write", op="delta_write")
     doc = _read_index(directory)
     if doc is None:
         raise PackError(f"no pack index under {directory}")
@@ -633,9 +638,16 @@ class PackStore:
     serving garbage views later.  All reads after that are zero-copy:
     one ``np.memmap`` per pack, ``np.ndarray`` views into it per tensor
     and per machine slot.
+
+    ``quarantine=True`` (the serving path) records a failing pack in
+    ``quarantined_packs``/``quarantined_machines`` instead of raising:
+    the rest of the store stays readable, and the collection layer
+    serves 503 ``quarantined`` for only the affected machines.  The
+    default stays loud — registry/CLI callers want corruption to stop
+    them, not shrink results silently.
     """
 
-    def __init__(self, directory: str):
+    def __init__(self, directory: str, quarantine: bool = False):
         t0 = time.monotonic()
         self.directory = directory
         doc = _read_index(directory)
@@ -663,8 +675,25 @@ class PackStore:
         #: fleet scorer map a reconstructed model's array leaves back to
         #: their stacked pack tensors without copying anything
         self._leaf_ids: Dict[int, Tuple[str, int]] = {}
+        #: packs that failed open-validation, {pack_id: error} (always
+        #: empty without ``quarantine`` — failures raise instead)
+        self.quarantined_packs: Dict[str, str] = {}
+        #: machines whose pack is quarantined, {name: error}
+        self.quarantined_machines: Dict[str, str] = {}
         for pack_id, entry in self.packs.items():
-            self._validate(pack_id, entry)
+            try:
+                self._validate(pack_id, entry)
+            except PackCorruptError as exc:
+                if not quarantine:
+                    raise
+                logger.error("quarantining pack %s: %s", pack_id, exc)
+                self.quarantined_packs[pack_id] = str(exc)
+        if self.quarantined_packs:
+            self.quarantined_machines = {
+                name: self.quarantined_packs[row["pack"]]
+                for name, row in self.machines.items()
+                if row["pack"] in self.quarantined_packs
+            }
         _PACKS_TOTAL.inc(float(len(self.packs)), "opened")
         _PACK_LOAD_SECONDS.observe(time.monotonic() - t0)
 
@@ -672,9 +701,12 @@ class PackStore:
     def _validate(self, pack_id: str, entry: Dict[str, Any]) -> None:
         path = os.path.join(self.directory, entry["file"])
         try:
+            faults.check("pack.open", pack=pack_id, path=path)
             size = os.stat(path).st_size
             with open(path, "rb") as fh:
                 header = fh.read(8)
+        except faults.InjectedFault as exc:
+            raise PackCorruptError(f"pack {pack_id}: {exc}")
         except OSError as exc:
             raise PackCorruptError(f"pack {pack_id} unreadable: {exc}")
         if header[:4] != PACK_MAGIC:
@@ -740,7 +772,13 @@ class PackStore:
 
     # -- per-machine surface ------------------------------------------------
     def names(self) -> List[str]:
-        return sorted(self.machines)
+        """Loadable machine names (quarantined packs' machines excluded —
+        the collection layer reports those separately)."""
+        if not self.quarantined_machines:
+            return sorted(self.machines)
+        return sorted(
+            n for n in self.machines if n not in self.quarantined_machines
+        )
 
     def __contains__(self, name: str) -> bool:
         return name in self.machines
@@ -765,7 +803,16 @@ class PackStore:
         """Reconstruct one machine's model: unpickle its tiny skeleton,
         resolving each array leaf to a zero-copy view of the stacked
         memmap — no per-machine file opens, no array copies."""
+        if name in self.quarantined_machines:
+            raise PackCorruptError(
+                f"machine {name!r} is quarantined: "
+                f"{self.quarantined_machines[name]}"
+            )
         pack_id, slot = self.location(name)
+        try:
+            faults.check("pack.read", pack=pack_id, machine=name)
+        except (faults.InjectedFault, OSError) as exc:
+            raise PackCorruptError(f"machine {name!r}: {exc}")
         offset, length = self.packs[pack_id]["skeletons"][slot]
         data = bytes(self._mmap(pack_id)[offset: offset + length])
         try:

@@ -788,6 +788,50 @@ def artifacts_gc(output_dir, keep):
     click.echo(json.dumps(summary, indent=1))
 
 
+@artifacts_group.command("flip")
+@click.option("--dir", "output_dir", required=True,
+              help="A v2 build output dir (its pack index is read).")
+def artifacts_flip(output_dir):
+    """Force-publish a new artifact generation, republishing every
+    machine row.  The operator heal path when pack bytes were restored
+    out-of-band (e.g. copied back from a healthy replica): no build
+    wrote pending rows, so the ordinary stamp is a no-op, yet serving
+    replicas only re-validate — and drop a quarantine — when the
+    published generation advances.  A no-op on stores with no machines."""
+    from gordo_tpu import artifacts
+
+    try:
+        gen = artifacts.stamp_generation(output_dir, force=True)
+    except artifacts.PackError as exc:
+        raise click.ClickException(str(exc))
+    click.echo(json.dumps({"generation": gen}))
+
+
+@artifacts_group.command("fsck")
+@click.option("--dir", "output_dir", required=True,
+              help="A build output dir (either format, or mixed).")
+@click.option("--repair", is_flag=True,
+              help="Fix what is safely fixable: unlink orphaned tmp files "
+                   "from dead writers, restamp a stale GENERATION sidecar. "
+                   "Corrupt packs are never 'repaired' — they are reported "
+                   "(and quarantined by a serving load).")
+def artifacts_fsck(output_dir, repair):
+    """Verify every artifact invariant under --dir — index rows resolve,
+    pack files exist with the recorded size, meta sidecars parse, tensor
+    extents stay inside the pack — and report findings as JSON.  The
+    server runs this automatically (with repair) at startup; exits
+    non-zero when unrepaired findings remain."""
+    from gordo_tpu import artifacts
+
+    try:
+        report = artifacts.fsck(output_dir, repair=repair)
+    except artifacts.PackError as exc:
+        raise click.ClickException(str(exc))
+    click.echo(json.dumps(report, indent=1))
+    if not report["ok"]:
+        raise SystemExit(1)
+
+
 # ---------------------------------------------------------------------------
 # telemetry
 # ---------------------------------------------------------------------------

@@ -18,12 +18,14 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 import logging
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import aiohttp
 import numpy as np
 import pandas as pd
 
+from gordo_tpu import faults, telemetry
 from gordo_tpu.client.forwarders import PredictionForwarder
 from gordo_tpu.client.io import (
     HttpUnprocessableEntity,
@@ -37,6 +39,33 @@ from gordo_tpu.dataset.datasets import TimeSeriesDataset
 logger = logging.getLogger(__name__)
 
 API_PREFIX = "/gordo/v0"
+
+_FAILOVER_TOTAL = telemetry.counter(
+    "gordo_client_failover_total",
+    "Bulk sub-requests retried against an alternate replica, by outcome "
+    "(attempt | recovered | exhausted)",
+    labels=("outcome",),
+)
+_HEDGES_TOTAL = telemetry.counter(
+    "gordo_client_hedges_total",
+    "Tail sub-requests hedged to an alternate replica",
+)
+
+
+def _check_scatter_fault(base: str) -> None:
+    """``replica.scatter`` injection seam: mode ``dead`` makes a replica
+    look SIGKILLed from this client's seat (connection refused), driving
+    the real failover path in ``post_shard``."""
+    if not faults.enabled():
+        return
+    try:
+        faults.check("replica.scatter", replica=base)
+    except faults.InjectedFault as exc:
+        if exc.mode == "dead":
+            raise aiohttp.ClientConnectionError(
+                f"replica {base} is dead: {exc}"
+            ) from None
+        raise
 
 
 @dataclasses.dataclass
@@ -157,6 +186,8 @@ class Client:
         watchman_url: Optional[str] = None,
         timeout: float = 120.0,
         replica_urls: Optional[Sequence[str]] = None,
+        deadline_s: Optional[float] = None,
+        hedge_after_p99: Optional[Any] = None,
     ):
         self.project = project
         #: fleet-sharded serving tier: base URLs ordered by shard index
@@ -185,6 +216,22 @@ class Client:
         self.use_msgpack = use_msgpack
         self.watchman_url = watchman_url
         self.timeout = timeout
+        #: end-to-end budget for one predict() call, retries included:
+        #: each request restamps the remaining millis into the
+        #: X-Gordo-Deadline-Ms header, so the server (and its coalescer)
+        #: drops work this client has already given up on
+        self.deadline_s = float(deadline_s) if deadline_s else None
+        #: tail hedging: after this many seconds (a float), or after the
+        #: client's own observed p99 sub-request latency (``True``), a
+        #: still-running bulk sub-request is duplicated against an
+        #: alternate replica and the first success wins
+        self.hedge_after_p99 = hedge_after_p99
+        #: recent successful sub-request latencies (seconds) backing the
+        #: ``hedge_after_p99=True`` threshold
+        self._latencies: List[float] = []
+        #: replica base urls watchman currently marks ``down`` — skipped
+        #: as first-choice routes and as failover candidates
+        self._down_bases: set = set()
 
     # -- URLs ----------------------------------------------------------------
     def _project_url(self, base: Optional[str] = None) -> str:
@@ -199,23 +246,129 @@ class Client:
                 pass  # unknown to the fleet list: let the server answer
         return f"{base}{API_PREFIX}/{self.project}/{machine}"
 
+    def _note_down_targets(self, body: Dict[str, Any]) -> None:
+        """Record which replica bases watchman marks ``down`` (failed
+        ``GORDO_WATCHMAN_EVICT_AFTER`` consecutive scrapes): they stop
+        being first-choice routes and failover candidates."""
+        self._down_bases = {
+            base for base, entry in (body.get("targets") or {}).items()
+            if entry.get("down")
+        }
+
+    def _note_latency(self, seconds: float) -> None:
+        """Record a successful sub-request latency for the tracked-p99
+        hedge threshold (bounded window — last 512 samples)."""
+        self._latencies.append(seconds)
+        if len(self._latencies) > 512:
+            del self._latencies[: len(self._latencies) - 512]
+
+    def _hedge_delay(self) -> Optional[float]:
+        """Seconds to wait before duplicating a sub-request, or None when
+        hedging is off / can't be computed yet.  A float configures a
+        fixed threshold; ``True`` tracks the client's own p99 over recent
+        successful sub-requests (needs >= 20 samples to engage)."""
+        if not self.hedge_after_p99:
+            return None
+        if self.hedge_after_p99 is not True:
+            return float(self.hedge_after_p99)
+        if len(self._latencies) < 20:
+            return None
+        ordered = sorted(self._latencies)
+        return ordered[min(len(ordered) - 1, int(len(ordered) * 0.99))]
+
+    async def _post_with_hedge(
+        self, do_post, base: str, alternates: List[str]
+    ) -> Dict[str, Any]:
+        """POST to ``base``; when the hedge threshold elapses first, race
+        a duplicate against the next alternate and take the first
+        success.  Both failing re-raises the primary's error."""
+        delay = self._hedge_delay()
+        alternates = [a for a in alternates if a not in self._down_bases]
+        if delay is None or not alternates:
+            return await do_post(base)
+        primary = asyncio.ensure_future(do_post(base))
+        try:
+            return await asyncio.wait_for(asyncio.shield(primary), delay)
+        except asyncio.TimeoutError:
+            pass  # threshold hit with the primary still running: hedge
+        except Exception:
+            primary.cancel()
+            raise
+        _HEDGES_TOTAL.inc()
+        hedge = asyncio.ensure_future(do_post(alternates[0]))
+        pending = {primary, hedge}
+        try:
+            while pending:
+                done, pending = await asyncio.wait(
+                    pending, return_when=asyncio.FIRST_COMPLETED
+                )
+                for task in done:
+                    if task.exception() is None:
+                        return task.result()
+            raise primary.exception()  # both failed: surface the primary's
+        finally:
+            for task in (primary, hedge):
+                if not task.done():
+                    task.cancel()
+
+    @staticmethod
+    def _replicas_from_topology(
+        topology: Dict[str, Dict[str, Any]], down: set
+    ) -> Optional[List[str]]:
+        """Order watchman's target roster into a replica-urls list.
+
+        Sharded targets order by shard index — and a down target is only
+        excluded when another target covers its shard, because the shard
+        TABLE is positional and a hole would shift every later machine.
+        Unsharded tiers (every replica serves the full fleet) simply drop
+        down targets."""
+        sharded = {
+            b: e for b, e in topology.items() if "shard-index" in e
+        }
+        if sharded:
+            count = max(
+                int(e.get("shard-count", 1)) for e in sharded.values()
+            )
+            by_idx: Dict[int, str] = {}
+            for base, e in sorted(sharded.items()):
+                idx = int(e["shard-index"])
+                if idx not in by_idx or by_idx[idx] in down:
+                    by_idx[idx] = base
+            if set(by_idx) == set(range(count)) and count >= 2:
+                return [by_idx[i] for i in range(count)]
+            return None
+        bases = sorted(b for b in topology if b not in down)
+        return bases if len(bases) >= 2 else None
+
     async def _ensure_router(self, session: aiohttp.ClientSession):
         """Build the shard router once per client: the table derives from
         the FULL fleet machine list (watchman's endpoint roster, or a
         replica's reported ``fleet-machines``), never from a request's
         machine subset — the partition is defined over the whole fleet."""
-        if self.replica_urls is None or len(self.replica_urls) < 2:
-            return None
         if self._router is not None:
             return self._router
-        from gordo_tpu.serve.shard import ShardRouter
-
-        fleet: List[str] = []
+        body: Optional[Dict[str, Any]] = None
         if self.watchman_url:
             body = await get_json(
                 session, self.watchman_url.rstrip("/") + "/",
                 retries=self.n_retries, timeout=self.timeout,
             )
+            self._note_down_targets(body)
+            if self.replica_urls is None:
+                # bootstrap the replica roster from watchman's serve
+                # topology; targets marked down are excluded (unsharded)
+                # or replaced per shard slot when coverage allows
+                bootstrapped = self._replicas_from_topology(
+                    body.get("serve-topology") or {}, self._down_bases
+                )
+                if bootstrapped:
+                    self.replica_urls = bootstrapped
+        if self.replica_urls is None or len(self.replica_urls) < 2:
+            return None
+        from gordo_tpu.serve.shard import ShardRouter
+
+        fleet: List[str] = []
+        if body is not None:
             # ALL endpoints, healthy or not: an unhealthy machine still
             # owns its shard slot, and dropping it would shift every
             # machine after it onto the wrong replica
@@ -342,8 +495,6 @@ class Client:
         restarts) are retried until the deadline.  Returns the final
         per-replica generation map; raises :class:`TimeoutError` when
         the deadline passes first."""
-        import time
-
         deadline = time.monotonic() + float(timeout)
         last: Dict[str, int] = {}
         while True:
@@ -495,6 +646,9 @@ class Client:
         carries every machine's i-th chunk, so the server dispatches one
         vmapped program per chunk instead of ``machines x chunks`` singles."""
         loop = asyncio.get_running_loop()
+        deadline = (
+            time.monotonic() + self.deadline_s if self.deadline_s else None
+        )
 
         async def fetch(name: str):
             meta = await self.machine_metadata_async(session, name)
@@ -555,10 +709,6 @@ class Client:
             async def post_shard(
                 base: str, members: List[str]
             ) -> Dict[str, Any]:
-                url = (
-                    f"{base}{API_PREFIX}/{self.project}"
-                    "/_bulk/anomaly/prediction"
-                )
                 payload: Dict[str, Any] = {
                     "X": {m: payload_X[m] for m in members}
                 }
@@ -568,19 +718,62 @@ class Client:
                 }
                 if sub_index:
                     payload["index"] = sub_index
-                try:
+
+                async def do_post(b: str) -> Dict[str, Any]:
+                    _check_scatter_fault(b)
+                    url = (
+                        f"{b}{API_PREFIX}/{self.project}"
+                        "/_bulk/anomaly/prediction"
+                    )
                     async with sem:
-                        body = await poster(
+                        return await poster(
                             session, url, payload,
                             retries=self.n_retries, timeout=self.timeout,
+                            deadline=deadline,
                         )
-                except Exception as exc:
-                    # a failed sub-request affects ONLY the machines whose
-                    # chunks rode in it — other replicas' machines (and
-                    # other rounds) stay ok
+
+                # failover order: the owning replica first (unless
+                # watchman marks it down), then every other replica not
+                # marked down.  An alternate that doesn't host a member
+                # reports it unknown in-slot — a per-machine error, never
+                # a torn response.
+                candidates = [base] + [
+                    alt for alt in (self.replica_urls or [])
+                    if alt != base and alt not in self._down_bases
+                ]
+                if base in self._down_bases and len(candidates) > 1:
+                    candidates = candidates[1:] + [base]
+                body: Optional[Dict[str, Any]] = None
+                last_exc: Optional[Exception] = None
+                t0 = time.monotonic()
+                for n_try, b in enumerate(candidates):
+                    try:
+                        if n_try == 0:
+                            body = await self._post_with_hedge(
+                                do_post, b, candidates[1:]
+                            )
+                        else:
+                            _FAILOVER_TOTAL.inc(1.0, "attempt")
+                            body = await do_post(b)
+                            _FAILOVER_TOTAL.inc(1.0, "recovered")
+                        break
+                    except HttpUnprocessableEntity:
+                        raise
+                    except Exception as exc:
+                        last_exc = exc
+                        logger.warning(
+                            "bulk sub-request to %s failed (chunk %d): %s",
+                            b, idx, exc,
+                        )
+                if body is None:
+                    # every candidate failed: the machines whose chunks
+                    # rode in this sub-request error out; other replicas'
+                    # machines (and other rounds) stay ok
+                    _FAILOVER_TOTAL.inc(1.0, "exhausted")
                     for name in members:
-                        errors[name].append(f"chunk {idx}: {exc}")
+                        errors[name].append(f"chunk {idx}: {last_exc}")
                     return {}
+                self._note_latency(time.monotonic() - t0)
                 return body["data"]
 
             parts = await asyncio.gather(
@@ -645,6 +838,9 @@ class Client:
         end: Any,
     ) -> PredictionResult:
         loop = asyncio.get_running_loop()
+        deadline = (
+            time.monotonic() + self.deadline_s if self.deadline_s else None
+        )
         try:
             meta = await self.machine_metadata_async(session, machine)
             X = await loop.run_in_executor(
@@ -671,6 +867,7 @@ class Client:
                     body = await post_json(
                         session, url, payload,
                         retries=self.n_retries, timeout=self.timeout,
+                        deadline=deadline,
                     )
                 except HttpUnprocessableEntity:
                     # not an anomaly model — retry on the plain route
@@ -680,6 +877,7 @@ class Client:
                         payload,
                         retries=self.n_retries,
                         timeout=self.timeout,
+                        deadline=deadline,
                     )
             return _frame_from_payload(body["data"], tags, chunk.index)
 

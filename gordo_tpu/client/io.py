@@ -9,11 +9,13 @@ wrappers (``fetch_json``/``post_json``) raising ``HttpUnprocessableEntity``
 from __future__ import annotations
 
 import asyncio
+import random
+import time
 from typing import Any, Dict, Optional
 
 import aiohttp
 
-from gordo_tpu import telemetry
+from gordo_tpu import faults, telemetry
 
 
 class HttpUnprocessableEntity(Exception):
@@ -23,6 +25,12 @@ class HttpUnprocessableEntity(Exception):
 
 class BadGordoRequest(Exception):
     """4xx — permanent client-side error; retrying cannot help."""
+
+
+class DeadlineExceeded(Exception):
+    """The caller's deadline ran out (locally, or the server answered 504
+    after dropping the rider) — retrying inside the same deadline is
+    pointless by definition."""
 
 
 class BadGordoResponse(Exception):
@@ -62,8 +70,9 @@ async def request_json(
     retries: int = 3,
     backoff: float = 0.5,
     timeout: float = 120.0,
+    deadline: Optional[float] = None,
 ) -> Dict[str, Any]:
-    """``method url`` → parsed body with bounded exponential-backoff retry.
+    """``method url`` → parsed body with jittered exponential-backoff retry.
 
     Responses decode by content type: ``application/x-msgpack`` through the
     binary codec (array leaves come back as ndarrays), anything else as
@@ -73,19 +82,40 @@ async def request_json(
     ``X-Gordo-Trace-Id`` header (minted here when the caller hasn't bound
     one): the server tags its handler/coalescer/scorer spans with it and
     echoes it on the response, so one id stitches a request's timeline
-    from this client through the whole serving stack."""
+    from this client through the whole serving stack.
+
+    ``deadline`` (a ``time.monotonic()`` timestamp) bounds the WHOLE
+    call, retries included: each attempt restamps the remaining budget
+    into the ``X-Gordo-Deadline-Ms`` header (the server drops riders
+    whose budget expired before dispatch), the per-attempt timeout
+    shrinks to the remaining budget, and an exhausted budget raises
+    :class:`DeadlineExceeded` instead of sleeping into a retry that
+    cannot answer in time."""
     headers = dict(headers or {})
     headers.setdefault(telemetry.TRACE_HEADER, telemetry.ensure_trace_id())
     last_exc: Optional[Exception] = None
     for attempt in range(retries + 1):
+        attempt_timeout = timeout
+        if deadline is not None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise DeadlineExceeded(
+                    f"{method} {url}: deadline exhausted after "
+                    f"{attempt} attempt(s)"
+                ) from last_exc
+            attempt_timeout = min(timeout, remaining)
+            headers[telemetry.DEADLINE_HEADER] = str(
+                max(1, int(remaining * 1000))
+            )
         try:
+            _check_http_fault(method, url)
             async with session.request(
                 method,
                 url,
                 json=json,
                 data=data,
                 headers=headers,
-                timeout=aiohttp.ClientTimeout(total=timeout),
+                timeout=aiohttp.ClientTimeout(total=attempt_timeout),
             ) as resp:
                 if resp.status == 422:
                     raise HttpUnprocessableEntity(await resp.text())
@@ -109,12 +139,16 @@ async def request_json(
                 if resp.content_type == codec.MSGPACK_CONTENT_TYPE:
                     return codec.unpackb(await resp.read())
                 return await resp.json()
-        except (HttpUnprocessableEntity, BadGordoRequest):
+        except (HttpUnprocessableEntity, BadGordoRequest, DeadlineExceeded):
             raise
         except (aiohttp.ClientError, asyncio.TimeoutError, BadGordoResponse) as exc:
             last_exc = exc
             if attempt < retries:
-                delay = backoff * (2 ** attempt)
+                # FULL jitter: uniform over [0, backoff * 2^attempt].  A
+                # deterministic schedule synchronizes every client that
+                # failed together, so they thundering-herd the replica
+                # the moment it recovers; jitter decorrelates the wave.
+                delay = random.uniform(0.0, backoff * (2 ** attempt))
                 retry_after = getattr(exc, "retry_after", None)
                 if retry_after is not None:
                     # server-stated delay wins over the schedule, capped
@@ -123,8 +157,37 @@ async def request_json(
                     delay = min(
                         retry_after, backoff * (2 ** max(retries - 1, 0))
                     )
+                if deadline is not None:
+                    # never sleep past the deadline: the remaining budget
+                    # caps total retry wall-clock, and a budget too small
+                    # to retry in fails NOW with the real cause attached
+                    remaining = deadline - time.monotonic()
+                    if remaining <= delay:
+                        raise DeadlineExceeded(
+                            f"{method} {url}: deadline exhausted after "
+                            f"{attempt + 1} attempt(s)"
+                        ) from exc
                 await asyncio.sleep(delay)
     raise BadGordoResponse(f"{method} {url} failed after {retries + 1} attempts") from last_exc
+
+
+def _check_http_fault(method: str, url: str) -> None:
+    """``http.request`` injection seam, translated to the wire-level
+    failures this module's retry loop already classifies."""
+    if not faults.enabled():
+        return
+    try:
+        faults.check("http.request", method=method, url=url)
+    except faults.InjectedFault as exc:
+        if exc.mode == "blackhole":
+            raise asyncio.TimeoutError(str(exc)) from None
+        if exc.mode == "reset":
+            raise aiohttp.ClientConnectionError(str(exc)) from None
+        if exc.mode in ("http_500", "http_503"):
+            raise BadGordoResponse(
+                f"{method} {url} -> {exc.mode[-3:]}: {exc}"
+            ) from None
+        raise
 
 
 async def get_json(session: aiohttp.ClientSession, url: str, **kw) -> Dict[str, Any]:

@@ -36,7 +36,7 @@ import time
 from dataclasses import dataclass
 from typing import Optional
 
-from gordo_tpu import telemetry
+from gordo_tpu import faults, telemetry
 
 logger = logging.getLogger(__name__)
 
@@ -319,6 +319,22 @@ class DistributedRuntime:
 
         client = jax_distributed.global_state.client
         t0 = time.monotonic()
+        if faults.enabled():
+            # chaos seam: an injected peer loss behaves exactly like the
+            # real thing — the barrier "expires", the timeout is counted,
+            # and the caller takes the resumable-exit path
+            try:
+                faults.check(
+                    "barrier.wait", barrier=name,
+                    process_id=self.config.process_id,
+                )
+            except faults.InjectedFault as exc:
+                self._note_barrier_timeout(name, timeout, t0)
+                raise BarrierTimeout(
+                    f"barrier {name!r}: injected peer loss "
+                    f"(process {self.config.process_id}/"
+                    f"{self.config.num_processes}): {exc}"
+                ) from exc
         try:
             if client is not None and hasattr(client, "wait_at_barrier"):
                 client.wait_at_barrier(

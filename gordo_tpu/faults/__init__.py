@@ -1,0 +1,319 @@
+"""Deterministic, seedable fault-injection plane.
+
+Every robustness property the fleet claims (quarantine, failover,
+deadline shedding, crash-safe writes) is exercised by *injecting* the
+fault it defends against, at the seam where the real fault would land.
+The plane is off by default: ``_PLANE`` is module-level ``None`` and
+:func:`check` is a single attribute load + ``is None`` test, so product
+seams pay nothing when no spec is configured.  Injection points are
+seam-level (per pack open, per HTTP request, per barrier wait) — never
+inside hot loop bodies, which ``scripts/lint.py`` gates.
+
+Spec grammar (``GORDO_FAULTS`` env var or :func:`configure`)::
+
+    spec     := clause (";" clause)*
+    clause   := "seed=" int
+              | point "=" mode [":" rate] [":" params]
+    point    := dotted name, e.g. "pack.open", "http.request"
+    rate     := float in [0, 1]        (default 1.0 — always fire)
+    params   := key "=" value ("," key "=" value)*
+                keys: ms (latency millis), times (max fires),
+                      after (skip the first N matching calls),
+                      match (substring filter on context values)
+
+Example::
+
+    GORDO_FAULTS="seed=7;pack.open=eio:0.5;http.request=latency:1:ms=40"
+
+Registered points and their modes (the seams translate
+:class:`InjectedFault` into the domain's native failure):
+
+=================  =============================================
+point              modes
+=================  =============================================
+pack.open          eio, corrupt, truncate
+pack.read          eio, corrupt
+artifact.write     enospc, crash  (crash = before the atomic rename)
+http.request       latency, blackhole, reset, http_500, http_503
+server.request     latency, http_500, reset
+replica.scatter    dead
+watchman.scrape    blackhole
+barrier.wait       peer_loss
+=================  =============================================
+
+Determinism: every rule draws from its own ``random.Random`` seeded
+from ``(seed, point, mode, rule-index)``, and per-rule call counters are
+lock-protected, so the same spec over the same call sequence fires the
+same faults — the chaos suite's replayability contract.
+"""
+
+from __future__ import annotations
+
+import errno
+import logging
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from gordo_tpu import telemetry
+
+__all__ = [
+    "FaultSpecError",
+    "InjectedFault",
+    "FaultRule",
+    "FaultPlane",
+    "parse_spec",
+    "configure",
+    "clear",
+    "enabled",
+    "check",
+    "injected",
+]
+
+ENV_FAULTS = "GORDO_FAULTS"
+
+logger = logging.getLogger(__name__)
+
+_INJECTED_TOTAL = telemetry.counter(
+    "gordo_faults_injected_total",
+    "Faults fired by the injection plane",
+    ("point", "mode"),
+)
+
+
+class FaultSpecError(ValueError):
+    """A ``GORDO_FAULTS`` spec that does not parse."""
+
+
+class InjectedFault(Exception):
+    """An injected fault, raised at a seam.
+
+    Seams translate this into the domain's native failure (a pack seam
+    maps ``corrupt`` to ``PackCorruptError``, an HTTP seam maps
+    ``reset`` to a connection error) so downstream code exercises the
+    exact path a real fault would take.
+    """
+
+    def __init__(self, point: str, mode: str, detail: str = ""):
+        self.point = point
+        self.mode = mode
+        self.detail = detail
+        super().__init__(
+            f"injected fault {mode!r} at {point}"
+            + (f" ({detail})" if detail else "")
+        )
+
+
+@dataclass
+class FaultRule:
+    point: str
+    mode: str
+    rate: float = 1.0
+    ms: float = 0.0
+    times: Optional[int] = None
+    after: int = 0
+    match: Optional[str] = None
+    _calls: int = field(default=0, repr=False)
+    _fired: int = field(default=0, repr=False)
+    _rng: random.Random = field(default=None, repr=False)  # type: ignore
+
+    def should_fire(self, ctx: Dict[str, Any]) -> bool:
+        """Decide (and record) whether this rule fires for one call.
+
+        Caller holds the plane lock — counters and the RNG draw are
+        part of the deterministic schedule and must be serialized.
+        """
+        if self.match is not None and not any(
+            self.match in str(v) for v in ctx.values()
+        ):
+            return False
+        self._calls += 1
+        if self._calls <= self.after:
+            return False
+        if self.times is not None and self._fired >= self.times:
+            return False
+        if self.rate < 1.0 and self._rng.random() >= self.rate:
+            return False
+        self._fired += 1
+        return True
+
+
+class FaultPlane:
+    """A parsed, seeded fault schedule; install with :func:`configure`."""
+
+    def __init__(self, rules: List[FaultRule], seed: int = 0):
+        self.seed = seed
+        self.rules: Dict[str, List[FaultRule]] = {}
+        self._lock = threading.Lock()
+        for i, rule in enumerate(rules):
+            rule._rng = random.Random(f"{seed}:{rule.point}:{rule.mode}:{i}")
+            self.rules.setdefault(rule.point, []).append(rule)
+
+    def fire(self, point: str, ctx: Dict[str, Any]) -> None:
+        rules = self.rules.get(point)
+        if not rules:
+            return
+        for rule in rules:
+            with self._lock:
+                firing = rule.should_fire(ctx)
+            if not firing:
+                continue
+            _INJECTED_TOTAL.inc(1.0, point, rule.mode)
+            telemetry.log_event(
+                logger, "fault.injected", point=point, mode=rule.mode,
+                **{k: str(v) for k, v in ctx.items()},
+            )
+            if rule.mode == "latency":
+                time.sleep(rule.ms / 1000.0)
+                continue
+            if rule.mode == "eio":
+                raise OSError(errno.EIO, f"injected EIO at {point}")
+            if rule.mode == "enospc":
+                raise OSError(
+                    errno.ENOSPC, f"injected disk-full at {point}"
+                )
+            raise InjectedFault(point, rule.mode, detail=str(ctx or ""))
+
+    def stats(self) -> Dict[str, Dict[str, int]]:
+        out: Dict[str, Dict[str, int]] = {}
+        with self._lock:
+            for point, rules in self.rules.items():
+                for rule in rules:
+                    key = f"{point}:{rule.mode}"
+                    out[key] = {"calls": rule._calls, "fired": rule._fired}
+        return out
+
+
+def _parse_params(raw: str, rule: FaultRule) -> None:
+    for pair in raw.split(","):
+        if not pair:
+            continue
+        if "=" not in pair:
+            raise FaultSpecError(f"bad fault param {pair!r} (want key=value)")
+        key, value = pair.split("=", 1)
+        if key == "ms":
+            rule.ms = float(value)
+        elif key == "times":
+            rule.times = int(value)
+        elif key == "after":
+            rule.after = int(value)
+        elif key == "match":
+            rule.match = value
+        else:
+            raise FaultSpecError(f"unknown fault param {key!r}")
+
+
+def parse_spec(spec: str) -> FaultPlane:
+    seed = 0
+    rules: List[FaultRule] = []
+    for clause in spec.split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        if "=" not in clause:
+            raise FaultSpecError(
+                f"bad fault clause {clause!r} (want point=mode[:rate][:params])"
+            )
+        point, rhs = clause.split("=", 1)
+        point = point.strip()
+        if point == "seed":
+            try:
+                seed = int(rhs)
+            except ValueError:
+                raise FaultSpecError(f"bad seed {rhs!r}") from None
+            continue
+        parts = rhs.split(":")
+        rule = FaultRule(point=point, mode=parts[0].strip())
+        if not rule.mode:
+            raise FaultSpecError(f"empty mode in clause {clause!r}")
+        if len(parts) > 1 and parts[1]:
+            try:
+                rule.rate = float(parts[1])
+            except ValueError:
+                raise FaultSpecError(
+                    f"bad rate {parts[1]!r} in clause {clause!r}"
+                ) from None
+            if not 0.0 <= rule.rate <= 1.0:
+                raise FaultSpecError(f"rate out of [0,1] in clause {clause!r}")
+        if len(parts) > 2:
+            _parse_params(":".join(parts[2:]), rule)
+        rules.append(rule)
+    return FaultPlane(rules, seed=seed)
+
+
+#: the installed plane; ``None`` means faults are off and every seam's
+#: :func:`check` is a single ``is None`` test.
+_PLANE: Optional[FaultPlane] = None
+
+
+def configure(spec: Optional[str] = None) -> Optional[FaultPlane]:
+    """Install a fault plane from ``spec`` (or ``GORDO_FAULTS``).
+
+    Passing ``None`` with no env var set clears the plane.  Returns the
+    installed plane (or ``None``).
+    """
+    global _PLANE
+    if spec is None:
+        spec = os.environ.get(ENV_FAULTS) or None
+    _PLANE = parse_spec(spec) if spec else None
+    return _PLANE
+
+
+def clear() -> None:
+    global _PLANE
+    _PLANE = None
+
+
+def enabled() -> bool:
+    return _PLANE is not None
+
+
+def plane() -> Optional[FaultPlane]:
+    return _PLANE
+
+
+def check(point: str, **ctx: Any) -> None:
+    """Injection point: raise/delay if a fault is scheduled for ``point``.
+
+    The no-plane path is one global load and an ``is None`` test.  Do
+    not call this inside hot loop bodies (lint-gated) — register the
+    point at the enclosing seam instead.
+    """
+    plane = _PLANE
+    if plane is None:
+        return
+    plane.fire(point, ctx)
+
+
+class injected:
+    """Context manager installing a plane for a scope (tests)::
+
+        with faults.injected("seed=3;pack.open=eio"):
+            ...
+    """
+
+    def __init__(self, spec: str):
+        self.spec = spec
+        self.plane: Optional[FaultPlane] = None
+        self._prev: Optional[FaultPlane] = None
+
+    def __enter__(self) -> FaultPlane:
+        global _PLANE
+        self._prev = _PLANE
+        self.plane = parse_spec(self.spec)
+        _PLANE = self.plane
+        return self.plane
+
+    def __exit__(self, *exc: Any) -> None:
+        global _PLANE
+        _PLANE = self._prev
+        return None
+
+
+# honor the env var at import so any entrypoint (server, CLI, builder)
+# picks the spec up without plumbing; imports stay cheap when unset.
+if os.environ.get(ENV_FAULTS):
+    configure()

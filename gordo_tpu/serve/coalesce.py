@@ -120,6 +120,18 @@ _SHEDDING_GAUGE = telemetry.gauge(
     "gordo_coalesce_shedding",
     "1 while escalated saturation sheds new requests with 429",
 )
+_EXPIRED_TOTAL = telemetry.counter(
+    "gordo_coalesce_expired_total",
+    "Queued riders dropped before dispatch because their propagated "
+    "deadline (X-Gordo-Deadline-Ms) expired while waiting",
+)
+
+
+class DeadlineExpired(Exception):
+    """A queued rider's propagated deadline passed before its batch
+    dispatched — the client upstream has already given up, so scoring it
+    would spend device time on a dead response.  The handler maps this
+    to 504."""
 
 
 def export_gauges(coalescer: Optional["CoalescingScorer"]) -> None:
@@ -314,7 +326,8 @@ class CoalescingScorer:
         #: (name, X, future, enqueue time, trace id) — the trace id rides
         #: the queue so dispatch spans can name every rider they carried
         self._queue: List[
-            Tuple[str, np.ndarray, Future, float, Optional[str]]
+            Tuple[str, np.ndarray, Future, float, Optional[str],
+                  Optional[float]]
         ] = []
         self._closed = False
         self.n_dispatches = 0
@@ -502,17 +515,23 @@ class CoalescingScorer:
         self.n_standdowns = 0
 
     def submit(
-        self, name: str, X: np.ndarray, trace_id: Optional[str] = None
+        self, name: str, X: np.ndarray, trace_id: Optional[str] = None,
+        deadline: Optional[float] = None,
     ) -> Future:
         """Enqueue one machine's rows; the Future resolves to the same
         arrays dict ``CompiledScorer.anomaly_arrays`` returns.
         ``trace_id`` (the request's propagated id) tags the dispatch span
-        this request ends up riding."""
+        this request ends up riding.  ``deadline`` (a ``time.monotonic()``
+        timestamp from the propagated budget) lets the drain drop this
+        rider with :class:`DeadlineExpired` instead of dispatching work
+        the client already abandoned."""
         fut: Future = Future()
         with self._cv:
             if self._closed:
                 raise RuntimeError("CoalescingScorer is closed")
-            self._queue.append((name, X, fut, time.monotonic(), trace_id))
+            self._queue.append(
+                (name, X, fut, time.monotonic(), trace_id, deadline)
+            )
             self._cv.notify()
         return fut
 
@@ -529,11 +548,15 @@ class CoalescingScorer:
     # -- worker side ---------------------------------------------------------
     def _drain(
         self,
-    ) -> List[Tuple[str, np.ndarray, Future, float, Optional[str]]]:
+    ) -> List[Tuple[str, np.ndarray, Future, float, Optional[str],
+                    Optional[float]]]:
         """Continuous drain: block for work, take what's queued (up to the
         knee cap) NOW.  The only wait is the single-rider grace — one
         queued request with peers still in flight holds ``max_wait_s`` for
-        a second rider, because a batch of 1 cannot amortize anything."""
+        a second rider, because a batch of 1 cannot amortize anything.
+        A rider carrying a propagated deadline caps the grace at its own
+        remaining budget (deadline-aware admission: holding a request
+        past the point its client gives up turns the grace into a 504)."""
         with self._cv:
             while not self._queue and not self._closed:
                 self._cv.wait()
@@ -545,6 +568,9 @@ class CoalescingScorer:
                 and self.max_wait_s > 0
             ):
                 deadline = time.monotonic() + self.max_wait_s
+                rider_deadline = self._queue[0][5]
+                if rider_deadline is not None:
+                    deadline = min(deadline, rider_deadline)
                 while len(self._queue) == 1 and not self._closed:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
@@ -566,7 +592,26 @@ class CoalescingScorer:
                         return
                     continue
                 t_dispatch = time.monotonic()
-                waits = [t_dispatch - t_enq for _, _, _, t_enq, _ in batch]
+                # expired riders resolve with DeadlineExpired BEFORE the
+                # dispatch: their clients already gave up, and dropping
+                # them here frees the batch slot for live work
+                live = []
+                for item in batch:
+                    dl = item[5]
+                    if dl is not None and t_dispatch >= dl:
+                        _EXPIRED_TOTAL.inc()
+                        self._resolve(item[2], exc=DeadlineExpired(
+                            f"rider for {item[0]!r} expired "
+                            f"{t_dispatch - dl:.3f}s before dispatch"
+                        ))
+                    else:
+                        live.append(item)
+                batch = live
+                if not batch:
+                    continue
+                waits = [
+                    t_dispatch - t_enq for _, _, _, t_enq, _, _ in batch
+                ]
                 for w in waits:
                     _QUEUE_WAIT_SECONDS.observe(w)
                 _BATCH_SIZE.observe(len(batch))
@@ -575,7 +620,7 @@ class CoalescingScorer:
                 rounds: List[
                     Dict[str, Tuple[np.ndarray, Future, Optional[str]]]
                 ] = []
-                for name, X, fut, _, tid in batch:
+                for name, X, fut, _, tid, _ in batch:
                     for rnd in rounds:
                         if name not in rnd:
                             rnd[name] = (X, fut, tid)

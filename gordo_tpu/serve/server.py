@@ -31,7 +31,7 @@ import pandas as pd
 from aiohttp import web
 
 import gordo_tpu
-from gordo_tpu import artifacts, serializer, telemetry
+from gordo_tpu import artifacts, faults, serializer, telemetry
 from gordo_tpu.telemetry.fleet_health import drift_top_k
 from gordo_tpu.serve import codec
 from gordo_tpu.serve import coalesce as coalesce_mod
@@ -78,6 +78,11 @@ _RELOADS_TOTAL = telemetry.counter(
     "restack; full = complete scorer rebuild)",
     labels=("kind",),
 )
+_QUARANTINED_GAUGE = telemetry.gauge(
+    "gordo_machines_quarantined",
+    "Machines this replica refuses with 503 because their pack failed "
+    "validation (heals when a good generation flips)",
+)
 
 #: Prometheus exposition content type (text format 0.0.4)
 METRICS_CONTENT_TYPE = "text/plain"
@@ -123,6 +128,55 @@ async def telemetry_middleware(request: web.Request, handler):
             time.perf_counter() - t0, route, codec_label
         )
         _REQUESTS_TOTAL.inc(1.0, route, str(status))
+
+#: per-request absolute monotonic deadline (set by deadline_middleware
+#: from the propagated X-Gordo-Deadline-Ms budget; absent = no deadline)
+DEADLINE_KEY = "gordo-deadline"
+
+
+def _deadline_expired_response(detail: str) -> web.Response:
+    return web.json_response(
+        {"error": f"deadline expired: {detail}"}, status=504
+    )
+
+
+@web.middleware
+async def deadline_middleware(request: web.Request, handler):
+    """Deadline propagation ingress + the ``server.request`` fault seam.
+
+    The ``X-Gordo-Deadline-Ms`` header carries the client's REMAINING
+    budget in milliseconds (wall clocks don't cross machines — only
+    durations do); it converts here to an absolute ``time.monotonic()``
+    deadline stored on the request for the handlers and the coalescer.
+    A request arriving already expired is refused with 504 before any
+    body parse or dispatch — the client upstream has given up, so every
+    cycle spent on it is pure waste."""
+    if faults.enabled():
+        try:
+            faults.check("server.request", path=request.path)
+        except faults.InjectedFault as exc:
+            if exc.mode == "reset":
+                # drop the connection mid-request, as a crashing worker
+                # would — the client sees a reset, not a status line
+                if request.transport is not None:
+                    request.transport.close()
+                raise web.HTTPInternalServerError(text=str(exc))
+            status = 503 if exc.mode == "http_503" else 500
+            return web.json_response({"error": str(exc)}, status=status)
+    raw = request.headers.get(telemetry.DEADLINE_HEADER)
+    if raw is not None:
+        try:
+            ms = int(raw)
+        except ValueError:
+            ms = None
+        if ms is not None:
+            if ms <= 0:
+                return _deadline_expired_response(
+                    "budget exhausted on arrival"
+                )
+            request[DEADLINE_KEY] = time.monotonic() + ms / 1000.0
+    return await handler(request)
+
 
 COLLECTION_KEY: "web.AppKey[ModelCollection]" = web.AppKey(
     "collection", object
@@ -217,10 +271,33 @@ class ModelCollection:
         shard=None,
         fleet_machines: Optional[List[str]] = None,
         shard_owner: Optional[Dict[str, int]] = None,
+        quarantined: Optional[Dict[str, Dict[str, Any]]] = None,
     ):
         from gordo_tpu.serve import precision
 
         self.entries = entries
+        #: machines this replica owns but refuses to serve because their
+        #: pack (or their individual load) failed validation:
+        #: ``{name: {"error": str, "ts": epoch}}``.  The 503 surface,
+        #: the ``gordo_machines_quarantined`` gauge, and the
+        #: ``quarantined`` status in /fleet-health all read this; a
+        #: rescan rebuilds it from scratch, so a good generation flip
+        #: heals a machine the moment its pack validates again.
+        self.quarantined: Dict[str, Dict[str, Any]] = dict(quarantined or {})
+        #: most recent reload/quarantine failure, ``{"error", "ts"}`` —
+        #: surfaced by /healthz so an operator sees WHY a fleet shrank
+        #: without grepping logs
+        self.last_error: Optional[Dict[str, Any]] = None
+        if self.quarantined:
+            worst = sorted(self.quarantined)[0]
+            self.last_error = {
+                "error": (
+                    f"{len(self.quarantined)} machine(s) quarantined "
+                    f"(e.g. {worst}: "
+                    f"{self.quarantined[worst]['error']})"
+                ),
+                "ts": time.time(),
+            }
         self.project = project
         self.source_dir = source_dir
         #: this replica's ShardSpec in a fleet-sharded tier (None when the
@@ -300,10 +377,15 @@ class ModelCollection:
         """Load every artifact under ``path`` — a v2 pack index, v1
         per-machine dirs, a mixed output, or one machine's artifact dir.
 
-        Pack failures raise (:class:`gordo_tpu.artifacts.PackCorruptError`
-        — a truncated pack must kill startup loudly, not silently shrink
-        the fleet); a single broken v1 dir only loses that machine, as
-        before.
+        A failing pack quarantines ONLY its machines (they 503 with a
+        ``quarantined`` detail and heal when a good generation flips)
+        while the rest of the fleet loads and serves; it is never a
+        silent shrink — the quarantine set rides /healthz, the project
+        index, /fleet-health and the ``gordo_machines_quarantined``
+        gauge.  Only when NOTHING loads does startup still die loudly
+        (:class:`gordo_tpu.artifacts.PackCorruptError` — a server with
+        zero machines serves nobody).  A single broken v1 dir only loses
+        that machine, as before.
 
         ``shard`` (a :class:`gordo_tpu.serve.shard.ShardSpec`, default
         ``GORDO_SERVE_SHARD`` from the environment): load ONLY this
@@ -320,10 +402,18 @@ class ModelCollection:
         from gordo_tpu.serve import precision
         from gordo_tpu.serve.shard import ShardSpec, shard_map
 
-        store, refs = artifacts.discover(path)
+        store, refs = artifacts.discover(path, quarantine=True)
         if shard is None:
             shard = ShardSpec.from_env()
-        fleet_machines = sorted({r.name for r in refs})
+        quarantined_errors: Dict[str, str] = dict(
+            getattr(store, "quarantined_machines", None) or {}
+        )
+        # quarantined machines stay IN the fleet list: clients must keep
+        # routing them to their owner (which answers 503 with the why),
+        # and dropping them would shift the positional shard table
+        fleet_machines = sorted(
+            {r.name for r in refs} | set(quarantined_errors)
+        )
         shard_owner: Optional[Dict[str, int]] = None
         if shard is not None:
             shard_owner = shard_map(fleet_machines, shard.count)
@@ -331,7 +421,12 @@ class ModelCollection:
                 r for r in refs
                 if shard_owner.get(r.name) == shard.index
             ]
-            if not refs and fleet_machines:
+            # only this shard's quarantined machines are ours to report
+            quarantined_errors = {
+                n: e for n, e in quarantined_errors.items()
+                if shard_owner.get(n) == shard.index
+            }
+            if not refs and not quarantined_errors and fleet_machines:
                 raise FileNotFoundError(
                     f"Shard {shard} owns no machines of the "
                     f"{len(fleet_machines)}-machine fleet under {path!r} "
@@ -352,9 +447,18 @@ class ModelCollection:
         entries: Dict[str, ModelEntry] = {}
         for ref in refs:
             if ref.kind == "pack":
-                entries[ref.name] = ModelEntry.from_artifact(
-                    ref, serve_dtype=serve_dtype
-                )
+                try:
+                    entries[ref.name] = ModelEntry.from_artifact(
+                        ref, serve_dtype=serve_dtype
+                    )
+                except Exception as exc:
+                    # pack-slot load failure (corrupt segment, injected
+                    # read fault): quarantine just this machine — the
+                    # pack's healthy siblings keep serving
+                    logger.exception(
+                        "quarantining %s: load failed", ref.name
+                    )
+                    quarantined_errors[ref.name] = str(exc)
                 continue
             try:
                 entries[ref.name] = ModelEntry.from_artifact(
@@ -363,7 +467,17 @@ class ModelCollection:
             except Exception:
                 logger.exception("Failed to load artifact %s", ref.ref)
         if not entries:
+            if quarantined_errors:
+                detail = "; ".join(
+                    f"{n}: {e}" for n, e in
+                    sorted(quarantined_errors.items())[:3]
+                )
+                raise artifacts.PackCorruptError(
+                    f"every machine under {path!r} is quarantined "
+                    f"({detail})"
+                )
             raise FileNotFoundError(f"No model artifacts under {path!r}")
+        now = time.time()
         return cls(
             entries,
             project=project,
@@ -374,6 +488,10 @@ class ModelCollection:
             shard=shard,
             fleet_machines=fleet_machines,
             shard_owner=shard_owner,
+            quarantined={
+                n: {"error": e, "ts": now}
+                for n, e in quarantined_errors.items()
+            },
         )
 
     def get(self, name: str) -> Optional[ModelEntry]:
@@ -439,13 +557,27 @@ class ModelCollection:
         if self.source_dir is None or not os.path.isdir(self.source_dir):
             return {"added": [], "reloaded": [], "removed": []}
         try:
-            store, refs = artifacts.discover(self.source_dir)
-        except Exception:
+            store, refs = artifacts.discover(
+                self.source_dir, quarantine=True
+            )
+        except Exception as exc:
             # a mid-write index (builder racing the rescan) must not take
             # down the serving loop — keep the current view, retry later
             logger.exception("Artifact discovery failed during rescan")
+            self.last_error = {
+                "error": f"rescan discovery failed: {exc}",
+                "ts": time.time(),
+            }
             return {"added": [], "reloaded": [], "removed": []}
-        fleet_machines = sorted({r.name for r in refs})
+        # this scan's quarantine view, rebuilt from scratch every rescan:
+        # a machine whose new generation validates simply stops appearing
+        # here — that IS the heal
+        scan_quarantined: Dict[str, str] = dict(
+            getattr(store, "quarantined_machines", None) or {}
+        )
+        fleet_machines = sorted(
+            {r.name for r in refs} | set(scan_quarantined)
+        )
         shard_owner: Dict[str, int] = {}
         if self.shard is not None:
             # re-partition over the CURRENT fleet: machines built after
@@ -458,6 +590,10 @@ class ModelCollection:
                 r for r in refs
                 if shard_owner.get(r.name) == self.shard.index
             ]
+            scan_quarantined = {
+                n: e for n, e in scan_quarantined.items()
+                if shard_owner.get(n) == self.shard.index
+            }
         if (
             store is not None
             and self.pack_store is not None
@@ -527,13 +663,49 @@ class ModelCollection:
                             reloaded_dirs.append(ref.name)
                     else:
                         new_entries[ref.name] = current
-                except Exception:
+                except Exception as exc:
                     logger.exception(
                         "Failed to (re)load artifact %s", ref.ref
                     )
                     if current is not None:  # keep serving the old model
                         new_entries[ref.name] = current
+                    elif ref.kind == "pack":
+                        # nothing to keep serving: the machine joins the
+                        # quarantine set instead of silently vanishing
+                        scan_quarantined[ref.name] = str(exc)
             removed = sorted(set(self.entries) - set(new_entries))
+            # quarantine refresh + heal: the set is rebuilt from THIS
+            # scan, so a machine whose new generation validates drops out
+            # (heal) and a newly-corrupt one joins; a persisting error
+            # keeps its original timestamp
+            new_quarantined: Dict[str, Dict[str, Any]] = {}
+            for name, err in scan_quarantined.items():
+                prev = self.quarantined.get(name)
+                new_quarantined[name] = (
+                    prev if prev is not None and prev["error"] == err
+                    else {"error": err, "ts": time.time()}
+                )
+            healed = sorted(
+                n for n in self.quarantined if n not in new_quarantined
+            )
+            if healed:
+                logger.info(
+                    "quarantine healed for %s (generation %d)",
+                    healed, store_generation,
+                )
+            newly_quarantined = sorted(
+                set(new_quarantined) - set(self.quarantined)
+            )
+            if newly_quarantined:
+                worst = newly_quarantined[0]
+                self.last_error = {
+                    "error": (
+                        f"quarantined {newly_quarantined} "
+                        f"({worst}: {new_quarantined[worst]['error']})"
+                    ),
+                    "ts": time.time(),
+                }
+            self.quarantined = new_quarantined
             if added or reloaded or removed or flip:
                 logger.info(
                     "Collection rescan: +%s ~%s -%s (generation %d -> %d)",
@@ -792,6 +964,21 @@ def _entry_or_404(request: web.Request) -> ModelEntry:
     name = request.match_info["machine"]
     entry = collection.get(name)
     if entry is None:
+        info = collection.quarantined.get(name)
+        if info is not None:
+            # 503, not 404: the machine EXISTS and will heal when a good
+            # generation flips — clients should treat this as transient
+            raise web.HTTPServiceUnavailable(
+                text=json.dumps({
+                    "error": (
+                        f"Machine {name!r} is quarantined: "
+                        f"{info['error']}"
+                    ),
+                    "quarantined": True,
+                    "since": info["ts"],
+                }),
+                content_type="application/json",
+            )
         misroute = _misdirected(collection, name)
         if misroute is not None:
             # 421 Misdirected Request: the machine exists, this replica
@@ -902,6 +1089,11 @@ async def anomaly_prediction(request: web.Request) -> web.Response:
         X, index, y = await _read_and_parse_single(request, entry)
     except ValueError as exc:
         return web.json_response({"error": str(exc)}, status=400)
+    deadline = request.get(DEADLINE_KEY)
+    if deadline is not None and time.monotonic() >= deadline:
+        # the budget ran out while the body was read/parsed — refuse
+        # before dispatch rather than scoring into a dead socket
+        return _deadline_expired_response("before dispatch")
     loop = asyncio.get_running_loop()
     coalescer = request.app.get(COALESCER_KEY)
     score_span = telemetry.span(
@@ -925,6 +1117,7 @@ async def anomaly_prediction(request: web.Request) -> web.Response:
                                 entry.name,
                                 X,
                                 trace_id=telemetry.current_trace_id(),
+                                deadline=deadline,
                             )
                         )
                     else:  # too few riders: direct dispatch wins — bypass
@@ -939,6 +1132,10 @@ async def anomaly_prediction(request: web.Request) -> web.Response:
                 )
     except ValueError as exc:  # client-input problem (e.g. short rows)
         return web.json_response({"error": str(exc)}, status=400)
+    except coalesce_mod.DeadlineExpired as exc:
+        # the coalescer dropped this rider pre-dispatch: its propagated
+        # budget expired while queued
+        return _deadline_expired_response(str(exc))
     except Exception as exc:
         logger.exception("Anomaly scoring failed for %s", entry.name)
         return web.json_response({"error": str(exc)}, status=500)
@@ -984,6 +1181,15 @@ async def bulk_anomaly_prediction(request: web.Request) -> web.Response:
             entry = collection.get(name)
             try:
                 if entry is None:
+                    q = collection.quarantined.get(name)
+                    if q is not None:
+                        # in-slot, like every other per-machine bulk
+                        # error: one quarantined machine must never tear
+                        # the rest of the round's responses
+                        raise ValueError(
+                            f"Machine {name!r} is quarantined: "
+                            f"{q['error']}"
+                        )
                     # a foreign-shard machine reports its owner in-slot
                     # (scatter-gather clients route per shard and should
                     # never see this; a mis-split payload must say WHY)
@@ -1014,6 +1220,9 @@ async def bulk_anomaly_prediction(request: web.Request) -> web.Response:
              "data": machine_errors},
             status=400,
         )
+    deadline = request.get(DEADLINE_KEY)
+    if deadline is not None and time.monotonic() >= deadline:
+        return _deadline_expired_response("before bulk dispatch")
     try:
         # resolve the lazy scorer inside the executor too: first-call param
         # stacking for a large project must not stall the accept loop
@@ -1093,6 +1302,13 @@ async def healthz(request: web.Request) -> web.Response:
     }
     if collection is not None:
         doc["fleet-generation"] = collection.generation
+        if collection.quarantined:
+            doc["quarantined"] = sorted(collection.quarantined)
+        if collection.last_error is not None:
+            # the most recent reload/quarantine failure (string +
+            # timestamp): an operator probing a shrunken fleet sees WHY
+            # here instead of grepping logs
+            doc["last-error"] = dict(collection.last_error)
     if state == "ready" and fut is not None:
         # a FAILED warmup still goes ready (the pod can serve; programs
         # compile lazily) but says so, so the init-container gate can tell
@@ -1116,6 +1332,7 @@ async def metrics_endpoint(request: web.Request) -> web.Response:
     collection = request.app.get(COLLECTION_KEY)
     if collection is not None:
         _MACHINES_GAUGE.set(len(collection.entries))
+        _QUARANTINED_GAUGE.set(float(len(collection.quarantined)))
         _FLEET_GENERATION_GAUGE.set(float(collection.generation))
         if collection.shard is not None:
             _SHARD_INDEX_GAUGE.set(collection.shard.index)
@@ -1150,6 +1367,17 @@ async def fleet_health(request: web.Request) -> web.Response:
         machines=sorted(collection.entries), top=top
     )
     doc["project-name"] = collection.project
+    if collection.quarantined:
+        # quarantined machines carry a `quarantined` status in the doc:
+        # they have no live sketch (nothing scores them) but MUST NOT
+        # read as merely "no data" — the fleet view has to show them red
+        machines_doc = doc.setdefault("machines", {})
+        for name, info in sorted(collection.quarantined.items()):
+            slot = machines_doc.setdefault(name, {})
+            slot["status"] = "quarantined"
+            slot["quarantine-error"] = info["error"]
+            slot["quarantine-since"] = info["ts"]
+        doc["quarantined"] = sorted(collection.quarantined)
     if collection.shard is not None:
         doc["serve-shard"] = {
             "index": collection.shard.index,
@@ -1177,6 +1405,8 @@ async def project_index(request: web.Request) -> web.Response:
         # watchman republishes it per target (routing-topology surface)
         "fleet-generation": collection.generation,
     }
+    if collection.quarantined:
+        doc["quarantined"] = sorted(collection.quarantined)
     if collection.shard is not None:
         # the routing-topology surface: which shard this replica is, and
         # the FULL fleet list every client needs to compute the shard
@@ -1276,7 +1506,7 @@ def build_app(
     enable_persistent_compile_cache()
     app = web.Application(
         client_max_size=256 * 1024 * 1024,
-        middlewares=[telemetry_middleware],
+        middlewares=[telemetry_middleware, deadline_middleware],
     )
     app[COLLECTION_KEY] = collection
 
@@ -1562,6 +1792,21 @@ def run_server(
                 "device visibility if a slice was expected",
                 devices[0].platform,
             )
+    # crash-safe writer audit before loading: sweep orphaned tmp files a
+    # killed build left behind and re-publish a stale GENERATION sidecar;
+    # unrepairable findings (truncated packs) are logged here and then
+    # quarantined machine-by-machine by the collection load below
+    try:
+        report = artifacts.fsck(model_dir, repair=True)
+        if report.get("findings"):
+            logger.warning(
+                "artifact fsck: %d finding(s), %d repaired — %s",
+                len(report["findings"]),
+                len(report.get("repaired", [])),
+                report["findings"][:5],
+            )
+    except Exception:
+        logger.exception("artifact fsck failed (continuing to load)")
     collection = ModelCollection.from_directory(
         model_dir, project=project, serve_mesh=serve_mesh, shard=shard
     )

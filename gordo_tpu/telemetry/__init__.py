@@ -47,6 +47,7 @@ from gordo_tpu.telemetry.fleet_health import (  # noqa: F401
     write_rollup,
 )
 from gordo_tpu.telemetry.spans import (  # noqa: F401
+    DEADLINE_HEADER,
     TRACE_HEADER,
     current_trace_id,
     ensure_trace_id,
@@ -65,6 +66,7 @@ __all__ = [
     "REGISTRY",
     "MetricsRegistry",
     "SNAPSHOT_DIR",
+    "DEADLINE_HEADER",
     "ScoreSketch",
     "TRACE_HEADER",
     "add_instance_label",

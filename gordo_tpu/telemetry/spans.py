@@ -43,6 +43,13 @@ logger = logging.getLogger(__name__)
 #: request is traceable end-to-end regardless of the caller
 TRACE_HEADER = "X-Gordo-Trace-Id"
 
+#: deadline propagation: the REMAINING request budget in integer
+#: milliseconds, restamped by the client at each send.  The server
+#: middleware converts it back to an absolute monotonic deadline and the
+#: coalescer drops riders whose budget expired before dispatch — work
+#: that is already dead upstream never reaches the device.
+DEADLINE_HEADER = "X-Gordo-Deadline-Ms"
+
 ENV_SPAN_LOG = "GORDO_SPAN_LOG"
 ENV_SPAN_LOG_MAX_BYTES = "GORDO_SPAN_LOG_MAX_BYTES"
 

@@ -18,7 +18,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import aiohttp
 
-from gordo_tpu import telemetry
+from gordo_tpu import faults, telemetry
 
 API_PREFIX = "/gordo/v0"
 
@@ -159,6 +159,7 @@ async def discover_machines_ex(
     try:
         for base in base_urls:
             try:
+                faults.check("watchman.scrape", target=base)
                 async with session.get(
                     f"{base}{API_PREFIX}/{project}/",
                     timeout=aiohttp.ClientTimeout(total=timeout),
@@ -166,6 +167,8 @@ async def discover_machines_ex(
                     if resp.status != 200:
                         continue
                     body = await resp.json()
+            except faults.InjectedFault:
+                continue  # blackholed target: indistinguishable from down
             except (aiohttp.ClientError, asyncio.TimeoutError, ValueError):
                 continue
             n_responding += 1

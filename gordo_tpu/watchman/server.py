@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import time
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -51,6 +52,16 @@ _TARGET_GENERATION_GAUGE = telemetry.gauge(
     "across a sharded tier mean a rollout is mid-propagation",
     labels=("target",),
 )
+_TARGETS_DOWN_GAUGE = telemetry.gauge(
+    "gordo_watchman_targets_down",
+    "Target replicas currently marked down (failed "
+    "GORDO_WATCHMAN_EVICT_AFTER consecutive index scrapes)",
+)
+
+#: a target failing this many CONSECUTIVE index scrapes is marked
+#: ``down`` in the status doc's ``targets`` section — clients skip it
+#: during shard-table bootstrap and as a failover candidate
+ENV_EVICT_AFTER = "GORDO_WATCHMAN_EVICT_AFTER"
 
 
 class Watchman:
@@ -80,6 +91,17 @@ class Watchman:
         #: once its pod is gone, instead of being reported unhealthy forever)
         self.evict_after = evict_after
         self._discovery_misses: Dict[str, int] = {}
+        #: targets mark ``down`` after this many consecutive failed index
+        #: scrapes (env ``GORDO_WATCHMAN_EVICT_AFTER`` overrides; default
+        #: matches the machine-eviction threshold)
+        try:
+            self.target_evict_after = max(
+                1, int(os.environ.get(ENV_EVICT_AFTER, evict_after))
+            )
+        except ValueError:
+            self.target_evict_after = evict_after
+        self._target_failures: Dict[str, int] = {}
+        self._last_targets: List[str] = list(target_base_urls)
         self.target_base_urls = list(target_base_urls)
         self.poll_interval = poll_interval
         self.request_timeout = request_timeout
@@ -131,6 +153,7 @@ class Watchman:
     async def refresh(self) -> List[EndpointStatus]:
         t0 = time.monotonic()
         targets = await self._current_targets()
+        self._last_targets = targets
         if self.discover:
             formats: Dict[str, str] = {}
             topology: Dict[str, Dict[str, Any]] = {}
@@ -151,6 +174,31 @@ class Watchman:
                         _TARGET_GENERATION_GAUGE.set(
                             float(entry["fleet-generation"]), base
                         )
+            # per-target down-marking: ``topology`` gains an entry for
+            # every target whose index answered this cycle, so absence
+            # IS a failed scrape.  ``target_evict_after`` consecutive
+            # misses flip the target ``down`` in the status doc (clients
+            # then skip it when bootstrapping their shard table and when
+            # picking failover candidates); one successful scrape clears
+            # the counter.
+            responded = set(topology)
+            for base in targets:
+                if base in responded:
+                    was = self._target_failures.pop(base, 0)
+                    if was >= self.target_evict_after:
+                        logger.info(
+                            "Target %s recovered after %d failed scrapes",
+                            base, was,
+                        )
+                    continue
+                n_fail = self._target_failures.get(base, 0) + 1
+                self._target_failures[base] = n_fail
+                if n_fail == self.target_evict_after:
+                    logger.warning(
+                        "Marking target %s down: %d consecutive failed "
+                        "index scrapes", base, n_fail,
+                    )
+            _TARGETS_DOWN_GAUGE.set(float(len(self.targets_down)))
             for name in discovered:
                 if name not in self.machines:
                     self.machines.append(name)
@@ -193,6 +241,15 @@ class Watchman:
         _ENDPOINTS_GAUGE.set(n_healthy, "true")
         _ENDPOINTS_GAUGE.set(len(statuses) - n_healthy, "false")
         return statuses
+
+    @property
+    def targets_down(self) -> set:
+        """Target base urls currently past the consecutive-scrape-failure
+        threshold."""
+        return {
+            base for base, n in self._target_failures.items()
+            if n >= self.target_evict_after
+        }
 
     def notify_change(self) -> None:
         """Thread-safe nudge: refresh on the next loop tick instead of
@@ -273,6 +330,21 @@ class Watchman:
             "scrape-status": {
                 base: {"last-error": err}
                 for base, err in sorted(self.scrape_errors.items())
+            },
+            # per-target liveness: a target past the consecutive
+            # index-scrape-failure threshold is ``down`` — clients skip
+            # it when bootstrapping their shard table from serve-topology
+            # and when picking failover candidates
+            "targets": {
+                base: {
+                    "down": self._target_failures.get(base, 0)
+                    >= self.target_evict_after,
+                    "consecutive-scrape-failures":
+                        self._target_failures.get(base, 0),
+                }
+                for base in sorted(
+                    set(self._last_targets) | set(self._target_failures)
+                )
             },
             "endpoints": [
                 self.statuses[m].to_json()

@@ -313,6 +313,16 @@ def _serve_dtype_env(serve_dtype: Optional[str]) -> List[Dict[str, str]]:
     return [{"name": "GORDO_SERVE_DTYPE", "value": canonical(serve_dtype)}]
 
 
+def _evict_after_env() -> Dict[str, str]:
+    """``GORDO_WATCHMAN_EVICT_AFTER`` for the watchman pod: a target
+    replica failing this many consecutive index scrapes is marked
+    ``down`` in the status doc (clients then skip it when bootstrapping
+    their shard table and when choosing failover candidates).  Stamped
+    explicitly (3 is also the library default) so the manifest documents
+    the knob where operators tune it."""
+    return {"name": "GORDO_WATCHMAN_EVICT_AFTER", "value": "3"}
+
+
 def _reload_watch_env() -> Dict[str, str]:
     """``GORDO_RELOAD_WATCH_SECONDS`` for server pods: poll the artifact
     index's generation sidecar (one tiny file read off the models PVC)
@@ -639,6 +649,7 @@ def _watchman_deployment(
                                 ),
                                 "--port", str(DEFAULT_WATCHMAN_PORT),
                             ],
+                            "env": [_evict_after_env()],
                             "ports": [{"containerPort": DEFAULT_WATCHMAN_PORT}],
                         }
                     ],

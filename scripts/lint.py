@@ -115,6 +115,26 @@ SHARD_PATH_DIRS = (
     os.path.join("gordo_tpu", "workflow"),
 )
 
+#: degraded-mode contract on the serving/artifact planes: a swallowed
+#: exception (``except Exception: pass``) there turns a fault into a torn
+#: response or a silently-missing machine.  Every failure must either be
+#: quarantined (recorded with detail), converted to a typed per-machine
+#: error, or re-raised — never dropped.  ``# noqa`` opts a line out.
+SWALLOW_FORBIDDEN_DIRS = (
+    os.path.join("gordo_tpu", "serve"),
+    os.path.join("gordo_tpu", "artifacts"),
+)
+
+#: fault-injection overhead contract: ``GORDO_FAULTS`` unset must cost
+#: nothing on the latency-critical drive loops, so the injection seams
+#: (``faults.check`` / ``faults.plane`` / ``faults.enabled``) may not
+#: appear inside these function bodies at all — seams live at the I/O
+#: edges (open/read/write/request), never per-batch.
+FAULTS_FORBIDDEN_SCOPES = {
+    "fleet_build.py": {"_drive_pipeline"},
+    "coalesce.py": {"_run", "_drain"},
+}
+
 
 def _jit_allowed(path: str) -> bool:
     norm = os.path.normpath(path)
@@ -294,6 +314,71 @@ def _shard_findings(path: str, tree: ast.AST, noqa_lines: set) -> List[Finding]:
     return findings
 
 
+def _swallow_findings(
+    path: str, tree: ast.AST, noqa_lines: set
+) -> List[Finding]:
+    """Flag ``except Exception: pass`` (and the bare/``BaseException``
+    forms) inside the serve and artifact planes — see
+    ``SWALLOW_FORBIDDEN_DIRS``."""
+    norm = os.path.normpath(path)
+    parts = norm.split(os.sep)
+    if "tests" in parts or os.path.basename(norm).startswith("test_"):
+        return []
+    if not any(d in norm for d in SWALLOW_FORBIDDEN_DIRS):
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.body and not all(isinstance(s, ast.Pass) for s in node.body):
+            continue
+        broad = node.type is None or (
+            isinstance(node.type, ast.Name)
+            and node.type.id in ("Exception", "BaseException")
+        )
+        if broad and node.lineno not in noqa_lines:
+            findings.append(
+                (path, node.lineno,
+                 "swallowed exception (except Exception: pass) on the "
+                 "serve/artifact plane — quarantine it, convert it to a "
+                 "typed per-machine error, or re-raise")
+            )
+    return findings
+
+
+def _faults_findings(
+    path: str, tree: ast.AST, noqa_lines: set
+) -> List[Finding]:
+    """Flag fault-injection seam calls (``faults.check`` etc.) inside the
+    latency-critical scopes of ``FAULTS_FORBIDDEN_SCOPES`` — the chaos
+    plane's zero-overhead-when-unset guarantee holds because seams sit at
+    I/O edges, never in per-batch loop bodies."""
+    scopes = FAULTS_FORBIDDEN_SCOPES.get(os.path.basename(path))
+    if not scopes:
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name not in scopes:
+            continue
+        for sub in ast.walk(node):
+            if (
+                isinstance(sub, ast.Attribute)
+                and isinstance(sub.value, ast.Name)
+                and sub.value.id == "faults"
+                and sub.lineno not in noqa_lines
+            ):
+                findings.append(
+                    (path, sub.lineno,
+                     f"faults.{sub.attr} inside {node.name}() — injection "
+                     "seams are banned from hot loop bodies (the "
+                     "zero-overhead-when-unset contract); put the seam at "
+                     "the I/O edge instead")
+                )
+    return findings
+
+
 def iter_py_files(paths: List[str]) -> Iterator[str]:
     for path in paths:
         if os.path.isfile(path) and path.endswith(".py"):
@@ -462,6 +547,8 @@ def lint_file(path: str) -> List[Finding]:
                 findings.append((path, lineno, f"unused import: {name}"))
 
     findings.extend(_d2h_findings(path, tree, noqa_lines))
+    findings.extend(_faults_findings(path, tree, noqa_lines))
+    findings.extend(_swallow_findings(path, tree, noqa_lines))
     findings.extend(_host_math_findings(path, tree, noqa_lines))
     findings.extend(_shard_findings(path, tree, noqa_lines))
     findings.extend(_jit_findings(path, tree, noqa_lines))

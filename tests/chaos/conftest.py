@@ -1,0 +1,62 @@
+"""Shared chaos-suite fixtures: one real 2-machine project build whose
+machines land in SEPARATE packs (different tag counts → different
+serving-chain signatures), so corrupting one pack must quarantine
+exactly one machine."""
+
+import pytest
+
+from gordo_tpu import artifacts
+from gordo_tpu.builder import build_project
+from gordo_tpu.workflow import NormalizedConfig
+
+PROJECT_NAME = "chaosproj"
+
+_DATASET = {
+    "type": "RandomDataset",
+    "train_start_date": "2017-12-25T06:00:00Z",
+    "train_end_date": "2017-12-27T06:00:00Z",
+}
+
+PROJECT = {
+    "machines": [
+        {"name": "chaos-a",
+         "dataset": dict(_DATASET, tags=["cht-1", "cht-2", "cht-3"])},
+        # 4 tags → different model signature → its own pack
+        {"name": "chaos-b",
+         "dataset": dict(_DATASET,
+                         tags=["cht-4", "cht-5", "cht-6", "cht-7"])},
+    ],
+    "globals": {
+        "model": {
+            "gordo_tpu.anomaly.diff.DiffBasedAnomalyDetector": {
+                "base_estimator": {
+                    "gordo_tpu.pipeline.Pipeline": {
+                        "steps": [
+                            "gordo_tpu.ops.scalers.MinMaxScaler",
+                            {"gordo_tpu.models.estimator.AutoEncoder": {
+                                "kind": "feedforward_hourglass",
+                                "epochs": 2,
+                                "batch_size": 64,
+                            }},
+                        ]
+                    }
+                }
+            }
+        }
+    },
+}
+
+
+@pytest.fixture(scope="session")
+def chaos_model_dir(tmp_path_factory):
+    out = tmp_path_factory.mktemp("chaos-artifacts")
+    result = build_project(
+        NormalizedConfig(PROJECT, PROJECT_NAME).machines, str(out)
+    )
+    assert not result.failed
+    store = artifacts.open_store(str(out))
+    assert store is not None and len(store.packs) == 2, (
+        "chaos fixture needs the two machines in two distinct packs"
+    )
+    assert store.location("chaos-a")[0] != store.location("chaos-b")[0]
+    return str(out)

@@ -1,0 +1,347 @@
+"""Fleet-level chaos scenarios: seeded fault schedules driven through
+build → serve → reload → scatter-gather, asserting the degradation
+contract — no torn responses, quarantine bounded to the injected
+machines, byte-identical recovery, typed per-machine partial results
+instead of raised exceptions.
+
+Runs in the slow lane; CI replays it under a fixed 3-seed matrix
+(``GORDO_CHAOS_SEED`` selects one seed per job, locally all three run).
+"""
+
+import asyncio
+import json
+import os
+import shutil
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pandas as pd
+import pytest
+from aiohttp import web
+
+from gordo_tpu import artifacts, faults
+from gordo_tpu.client import Client
+from gordo_tpu.client.client import _FAILOVER_TOTAL
+from gordo_tpu.serve import ModelCollection, build_app
+from tests.chaos.conftest import PROJECT_NAME
+
+pytestmark = pytest.mark.slow
+
+SEEDS = (
+    [int(os.environ["GORDO_CHAOS_SEED"])]
+    if os.environ.get("GORDO_CHAOS_SEED")
+    else [7, 101, 9001]
+)
+
+START, END = "2017-12-27T06:00:00Z", "2017-12-27T12:00:00Z"
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_plane():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _serve_replicas(model_dirs, fn):
+    """Start one real aiohttp server per dir in ``model_dirs``, run
+    ``fn(base_urls, collections)`` in a worker thread (the sync Client
+    API), return its result."""
+
+    async def runner():
+        runners, bases, colls = [], [], []
+        for d in model_dirs:
+            coll = ModelCollection.from_directory(d, project=PROJECT_NAME)
+            app_runner = web.AppRunner(build_app(coll))
+            await app_runner.setup()
+            site = web.TCPSite(app_runner, "127.0.0.1", 0)
+            await site.start()
+            port = app_runner.addresses[0][1]
+            runners.append(app_runner)
+            bases.append(f"http://127.0.0.1:{port}")
+            colls.append(coll)
+        try:
+            return await asyncio.get_running_loop().run_in_executor(
+                None, fn, bases, colls
+            )
+        finally:
+            for app_runner in runners:
+                await app_runner.cleanup()
+
+    return asyncio.run(runner())
+
+
+def _get_json(url, headers=None):
+    req = urllib.request.Request(url, headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.load(resp)
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.load(exc)
+
+
+class TestOverheadWhenOff:
+    def test_disabled_seam_cost_is_negligible(self):
+        """The ≤2% overhead gate for ``GORDO_FAULTS`` unset.  A request
+        crosses a handful of seams and takes milliseconds; the disabled
+        seam is one global load + an ``is None`` test, so even a very
+        loose 5µs/call ceiling keeps seam cost under 2% of any request
+        (5 seams × 5µs = 25µs ≪ 2% of a ~5ms request).  The ceiling is
+        ~50× the measured cost, so runner jitter can't flake it, while a
+        regression that makes the off path do real work (parse a spec,
+        take a lock) still trips it."""
+        import time
+
+        assert not faults.enabled()
+        n = 200_000
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                faults.check("pack.read")
+            best = min(best, time.perf_counter() - t0)
+        assert best / n < 5e-6, f"disabled seam costs {best / n * 1e6:.2f}µs"
+
+
+class TestReplicaDeath:
+    def test_dead_replica_fails_over_and_completes(self, chaos_model_dir):
+        """Acceptance: a replica dying mid-bulk-scoring → client.predict
+        COMPLETES against the surviving replica and
+        gordo_client_failover_total counts the recovery."""
+
+        def run(bases, colls):
+            before = _FAILOVER_TOTAL.value("recovered")
+            # replica 0 is dead for every scatter sub-request aimed at it
+            with faults.injected(
+                f"replica.scatter=dead:1:match={bases[0]}"
+            ):
+                results = Client(
+                    PROJECT_NAME, base_url=bases[1],
+                    replica_urls=bases, use_bulk=True, batch_size=100,
+                ).predict(START, END)
+            return results, _FAILOVER_TOTAL.value("recovered") - before
+
+        results, recovered = _serve_replicas([chaos_model_dir] * 2, run)
+        assert len(results) == 2
+        for res in results:
+            assert res.ok, res.error_messages
+            assert len(res.predictions) > 0
+        assert recovered > 0, "failover must be visible in the counter"
+
+    def test_whole_fleet_dead_returns_typed_partials(self, chaos_model_dir):
+        """Every replica dead → predict still RETURNS, one typed error
+        result per machine — never a raised exception, never a torn
+        frame."""
+
+        def run(bases, colls):
+            before = _FAILOVER_TOTAL.value("exhausted")
+            with faults.injected("replica.scatter=dead:1:match=127.0.0.1"):
+                results = Client(
+                    PROJECT_NAME, base_url=bases[0],
+                    replica_urls=bases, use_bulk=True, batch_size=100,
+                ).predict(START, END)
+            return results, _FAILOVER_TOTAL.value("exhausted") - before
+
+        results, exhausted = _serve_replicas([chaos_model_dir] * 2, run)
+        assert sorted(r.name for r in results) == ["chaos-a", "chaos-b"]
+        for res in results:
+            assert not res.ok
+            assert res.predictions is None
+            assert res.error_messages
+        assert exhausted > 0
+
+
+class TestCorruptPackQuarantine:
+    def _corrupt_pack_of(self, work, machine):
+        store = artifacts.open_store(work)
+        pack_id, _ = store.location(machine)
+        path = os.path.join(
+            artifacts.packs_dir(work), store.packs[pack_id]["file"]
+        )
+        with open(path, "r+b") as fh:
+            fh.truncate(64)
+        return path
+
+    def test_quarantine_is_bounded_served_around_and_heals(
+        self, chaos_model_dir, tmp_path
+    ):
+        """Acceptance: one pack corrupted on disk → the server STARTS,
+        serves the unaffected machine byte-identically, reports exactly
+        the injected machine quarantined, and a good generation flip
+        heals it."""
+        work = str(tmp_path / "degraded")
+        shutil.copytree(chaos_model_dir, work)
+        broken_path = self._corrupt_pack_of(work, "chaos-b")
+        pristine_path = os.path.join(
+            artifacts.packs_dir(chaos_model_dir),
+            os.path.basename(broken_path),
+        )
+
+        # fsck sees the damage but never touches a referenced file
+        report = artifacts.fsck(work, repair=True)
+        assert not report["ok"]
+        assert any(f["kind"] == "pack" for f in report["findings"])
+
+        def run(bases, colls):
+            base_ok, base_deg = bases
+            out = {}
+            c_ok = Client(PROJECT_NAME, base_url=base_ok)
+            c_deg = Client(PROJECT_NAME, base_url=base_deg)
+
+            # 1) the unaffected machine serves byte-identically
+            r_ok = c_ok.predict(START, END, machine_names=["chaos-a"])[0]
+            r_deg = c_deg.predict(START, END, machine_names=["chaos-a"])[0]
+            assert r_ok.ok and r_deg.ok, (
+                r_ok.error_messages, r_deg.error_messages
+            )
+            pd.testing.assert_frame_equal(
+                r_ok.predictions, r_deg.predictions, check_exact=True
+            )
+
+            # 2) quarantine is bounded to exactly the injected machine
+            status, doc = _get_json(f"{base_deg}/healthz")
+            assert status == 200
+            out["quarantined"] = doc["quarantined"]
+            out["last_error"] = doc["last-error"]
+            status, body = _get_json(
+                f"{base_deg}/gordo/v0/{PROJECT_NAME}/chaos-b/metadata"
+            )
+            assert status == 503 and body["quarantined"]
+            assert "truncated" in body["error"]
+            status, body = _get_json(
+                f"{base_deg}/gordo/v0/{PROJECT_NAME}/"
+            )
+            assert body["quarantined"] == ["chaos-b"]
+            # served entries exclude the quarantined machine; it is
+            # reported, not silently dropped
+            assert body["machines"] == ["chaos-a"]
+
+            # 3) deadline middleware: an exhausted budget 504s on arrival
+            status, body = _get_json(
+                f"{base_deg}/gordo/v0/{PROJECT_NAME}/chaos-a/metadata",
+                headers={"X-Gordo-Deadline-Ms": "0"},
+            )
+            assert status == 504
+
+            # 4) heal: restore the good pack bytes and FORCE a
+            # generation flip (no build wrote pending rows, so a plain
+            # stamp is a no-op — this is the `gordo artifacts flip`
+            # path); the watch-triggered rescan clears the quarantine
+            shutil.copy2(pristine_path, broken_path)
+            assert artifacts.stamp_generation(work) == 1, "plain stamp is a no-op"
+            assert artifacts.stamp_generation(work, force=True) == 2
+            reloaded = colls[1].maybe_delta_reload()
+            assert "chaos-b" in (
+                reloaded["added"] + reloaded["reloaded"]
+            )
+            status, doc = _get_json(f"{base_deg}/healthz")
+            assert doc.get("quarantined", []) == []
+            status, _ = _get_json(
+                f"{base_deg}/gordo/v0/{PROJECT_NAME}/chaos-b/metadata"
+            )
+            assert status == 200
+            r_healed = c_deg.predict(
+                START, END, machine_names=["chaos-b"]
+            )[0]
+            r_base = c_ok.predict(
+                START, END, machine_names=["chaos-b"]
+            )[0]
+            assert r_healed.ok, r_healed.error_messages
+            pd.testing.assert_frame_equal(
+                r_healed.predictions, r_base.predictions, check_exact=True
+            )
+            return out
+
+        out = _serve_replicas([chaos_model_dir, work], run)
+        assert out["quarantined"] == ["chaos-b"]
+        assert out["last_error"] and "truncated" in out["last_error"]["error"]
+
+
+class TestSeededSchedules:
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_transport_chaos_never_tears_a_response(
+        self, chaos_model_dir, seed
+    ):
+        """Seeded connection resets on a quarter of client requests:
+        retries + failover absorb them, every returned frame is whole."""
+
+        def run(bases, colls):
+            with faults.injected(f"seed={seed};http.request=reset:0.25"):
+                results = Client(
+                    PROJECT_NAME, base_url=bases[0],
+                    replica_urls=bases, use_bulk=True,
+                    batch_size=120, n_retries=6,
+                ).predict(START, END)
+            return results
+
+        results = _serve_replicas([chaos_model_dir] * 2, run)
+        assert len(results) == 2
+        for res in results:
+            assert res.ok, res.error_messages
+            total = res.predictions[("total-anomaly-score", "")].to_numpy()
+            assert np.isfinite(total).all(), "no torn/partial frame"
+
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_artifact_schedule_quarantine_and_recovery(self, tmp_path, seed):
+        """Seeded write/open faults through repeated build rounds: the
+        store never tears (every indexed machine is either loadable or
+        quarantined with a cause), the same seed replays the same
+        schedule, and clearing the faults recovers byte-identically."""
+
+        def sequence(directory):
+            rng = np.random.default_rng(0)
+            written, failed = {}, []
+            spec = (
+                f"seed={seed};artifact.write=enospc:0.35;"
+                "pack.open=eio:0.35"
+            )
+            with faults.injected(spec):
+                for rnd in range(3):
+                    for i in range(3):
+                        name = f"s{rnd}-{i}"
+                        model = {
+                            "w": rng.standard_normal((4, 2)).astype(
+                                np.float32
+                            )
+                        }
+                        try:
+                            artifacts.write_pack(
+                                str(directory), [name], [model]
+                            )
+                            written[name] = model
+                        except (OSError, artifacts.PackError):
+                            failed.append(name)
+                store = artifacts.open_store(
+                    str(directory), quarantine=True
+                )
+                q_errors = dict(store.quarantined_machines)
+                healthy = store.names()
+            return written, failed, q_errors, healthy
+
+        d1, d2 = tmp_path / "run1", tmp_path / "run2"
+        d1.mkdir(), d2.mkdir()
+        written, failed, q_errors, healthy = sequence(d1)
+        assert written, "some writes must survive a 0.35 fault rate"
+
+        # no torn store: every indexed machine is healthy XOR quarantined
+        assert sorted(set(healthy) | set(q_errors)) == sorted(written)
+        assert not set(healthy) & set(q_errors)
+        for name, err in q_errors.items():
+            assert "injected" in err.lower(), err
+
+        # determinism: the same seed replays the same schedule
+        w2, f2, q2, h2 = sequence(d2)
+        assert (sorted(w2), f2, sorted(q2), h2) == (
+            sorted(written), failed, sorted(q_errors), healthy
+        )
+
+        # recovery: faults off → fsck sweeps the write debris, the store
+        # opens strict, and every surviving machine loads byte-identical
+        report = artifacts.fsck(str(d1), repair=True)
+        assert report["ok"], report["findings"]
+        store = artifacts.open_store(str(d1))
+        assert store.names() == sorted(written)
+        for name, model in written.items():
+            loaded = store.load_model(name)
+            assert np.array_equal(loaded["w"], model["w"])

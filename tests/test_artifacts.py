@@ -187,6 +187,25 @@ class TestGenerations:
         assert artifacts.stamp_generation(str(tmp_path)) == 1
         assert os.path.exists(sidecar)
 
+    def test_forced_stamp_flips_with_nothing_pending(self, tmp_path):
+        """The operator heal path: pack bytes restored out-of-band leave
+        no pending rows, so only a forced flip (`gordo artifacts flip`)
+        can make serving replicas re-validate and drop a quarantine."""
+        names, _, _ = _write(tmp_path)
+        assert artifacts.stamp_generation(str(tmp_path)) == 1
+        # nothing pending: plain stamp stays put, force republishes all
+        assert artifacts.stamp_generation(str(tmp_path)) == 1
+        assert artifacts.stamp_generation(str(tmp_path), force=True) == 2
+        store = artifacts.open_store(str(tmp_path))
+        assert store.generation == 2
+        assert all(int(store.machines[n]["gen"]) == 2 for n in names)
+        # every pack is revalidated downstream: the generation-gated
+        # rescan reloads iff entry.gen < row.gen <= published
+        assert "2" in store.generations
+
+    def test_forced_stamp_on_empty_store_is_still_a_noop(self, tmp_path):
+        assert artifacts.stamp_generation(str(tmp_path), force=True) == 0
+
     def test_gc_refuses_keep_below_one(self, tmp_path):
         _write(tmp_path)
         with pytest.raises(ValueError, match="live generation"):
@@ -324,6 +343,156 @@ class TestCorruptionIsLoud:
             fh.truncate(64)
         with pytest.raises(artifacts.PackCorruptError):
             ModelCollection.from_directory(str(tmp_path))
+
+    def test_truncated_meta_json_raises_pack_corrupt(self, tmp_path):
+        """A torn ``<pack>.meta.json`` (crash mid-write of a pre-replace
+        world, or disk damage) must surface as PackCorruptError at the
+        metadata read, never as a silent empty-metadata default."""
+        _, _, pack_id = _write(tmp_path)
+        store = artifacts.open_store(str(tmp_path))
+        meta = os.path.join(
+            artifacts.packs_dir(str(tmp_path)),
+            store.packs[pack_id]["meta_file"],
+        )
+        with open(meta, "w") as fh:
+            fh.write('{"definition": "model: y')  # torn mid-document
+        store = artifacts.open_store(str(tmp_path))  # tensors are fine
+        with pytest.raises(
+            artifacts.PackCorruptError, match="metadata unreadable"
+        ):
+            store.load_metadata("m-0")
+
+    def test_skeleton_extent_past_eof_fails_open(self, tmp_path):
+        """index.json addressing a skeleton segment past the pack's EOF
+        is the same torn-index corruption as a bad tensor offset."""
+        _, _, pack_id = _write(tmp_path)
+        index = os.path.join(
+            artifacts.packs_dir(str(tmp_path)), "index.json"
+        )
+        doc = json.load(open(index))
+        doc["packs"][pack_id]["skeletons"][0] = [10 ** 9, 64]
+        json.dump(doc, open(index, "w"))
+        with pytest.raises(artifacts.PackCorruptError, match="truncated"):
+            artifacts.open_store(str(tmp_path))
+
+
+class TestCorruptionQuarantine:
+    """The serving-side counterpart of TestCorruptionIsLoud: with
+    ``quarantine=True`` a corrupt pack takes down only ITS machines —
+    the rest of the store loads and serves."""
+
+    def _two_packs_one_truncated(self, tmp_path):
+        names_a, _, _ = _write(tmp_path, n=2, prefix="a")
+        names_b, _, pack_b = _write(tmp_path, n=2, prefix="b")
+        store = artifacts.open_store(str(tmp_path))
+        path = os.path.join(
+            artifacts.packs_dir(str(tmp_path)), store.packs[pack_b]["file"]
+        )
+        with open(path, "r+b") as fh:
+            fh.truncate(64)
+        return names_a, names_b, pack_b
+
+    def test_quarantine_bounds_to_the_corrupt_pack(self, tmp_path):
+        names_a, names_b, pack_b = self._two_packs_one_truncated(tmp_path)
+        # strict mode (registry/CLI) stays loud
+        with pytest.raises(artifacts.PackCorruptError, match="truncated"):
+            artifacts.open_store(str(tmp_path))
+        store = artifacts.open_store(str(tmp_path), quarantine=True)
+        assert store.names() == sorted(names_a)
+        assert sorted(store.quarantined_machines) == sorted(names_b)
+        assert set(store.quarantined_packs) == {pack_b}
+        # healthy machines load; quarantined ones raise with the cause
+        assert store.load_model("a-0")["note"] == "machine 0"
+        with pytest.raises(artifacts.PackCorruptError, match="quarantined"):
+            store.load_model("b-0")
+
+    def test_discover_excludes_quarantined_machines(self, tmp_path):
+        names_a, names_b, _ = self._two_packs_one_truncated(tmp_path)
+        store, refs = artifacts.discover(str(tmp_path), quarantine=True)
+        assert sorted(r.name for r in refs) == sorted(names_a)
+        assert sorted(store.quarantined_machines) == sorted(names_b)
+
+    def test_collection_serves_around_quarantine(self, tmp_path):
+        """The acceptance scenario's load half: one pack corrupted on
+        disk -> the collection still builds, serves the unaffected
+        machines, and reports exactly the injected machines."""
+        from gordo_tpu.serve.server import ModelCollection
+
+        names_a, names_b, _ = self._two_packs_one_truncated(tmp_path)
+        coll = ModelCollection.from_directory(str(tmp_path))
+        assert sorted(coll.entries) == sorted(names_a)
+        assert sorted(coll.quarantined) == sorted(names_b)
+        for name in names_b:
+            assert "truncated" in coll.quarantined[name]["error"]
+        # quarantined machines STAY in the fleet list: the positional
+        # shard table must not shift underneath routing clients
+        assert coll.fleet_machines == sorted(names_a + names_b)
+        assert coll.last_error is not None
+
+    def test_heal_on_rescan_when_pack_is_rewritten(self, tmp_path):
+        """Delta-reload healing: a good generation flip over the broken
+        machines clears their quarantine on the next rescan."""
+        from gordo_tpu.serve.server import ModelCollection
+
+        names_a, names_b, _ = self._two_packs_one_truncated(tmp_path)
+        coll = ModelCollection.from_directory(str(tmp_path))
+        assert sorted(coll.quarantined) == sorted(names_b)
+        # a fresh build of the same machines writes a healthy pack and
+        # repoints their index rows
+        artifacts.write_pack(
+            str(tmp_path), names_b, _models(2, np.random.default_rng(5)),
+        )
+        summary = coll.rescan()
+        assert coll.quarantined == {}
+        assert sorted(coll.entries) == sorted(names_a + names_b)
+        assert sorted(summary["added"]) == sorted(names_b)
+
+
+class TestFsck:
+    def test_clean_store_is_ok(self, tmp_path):
+        _write(tmp_path)
+        report = artifacts.fsck(str(tmp_path))
+        assert report["ok"] and report["findings"] == []
+        assert report["packs_checked"] == 1 and report["machine_rows"] == 3
+
+    def test_truncated_pack_is_a_finding_not_a_repair(self, tmp_path):
+        _, _, pack_id = _write(tmp_path)
+        store = artifacts.open_store(str(tmp_path))
+        path = os.path.join(
+            artifacts.packs_dir(str(tmp_path)), store.packs[pack_id]["file"]
+        )
+        with open(path, "r+b") as fh:
+            fh.truncate(64)
+        report = artifacts.fsck(str(tmp_path), repair=True)
+        assert not report["ok"]
+        assert any(f["kind"] == "pack" for f in report["findings"])
+        assert os.path.exists(path), "fsck never deletes referenced files"
+
+    def test_orphan_tmp_swept_on_repair(self, tmp_path):
+        _write(tmp_path)
+        pdir = artifacts.packs_dir(str(tmp_path))
+        orphan = os.path.join(pdir, f"deadbeef.pack.tmp.{os.getpid()}")
+        with open(orphan, "wb") as fh:
+            fh.write(b"half-written")
+        report = artifacts.fsck(str(tmp_path))
+        assert not report["ok"]  # report-only: finding stands
+        assert os.path.exists(orphan)
+        report = artifacts.fsck(str(tmp_path), repair=True)
+        assert report["ok"] and report["repaired"]
+        assert not os.path.exists(orphan)
+
+    def test_stale_generation_sidecar_repaired(self, tmp_path):
+        _write(tmp_path)
+        artifacts.stamp_generation(str(tmp_path))
+        pdir = artifacts.packs_dir(str(tmp_path))
+        sidecar = os.path.join(pdir, artifacts.GENERATION_FILE)
+        with open(sidecar, "w") as fh:
+            fh.write("0")  # crash left the sidecar a generation behind
+        report = artifacts.fsck(str(tmp_path), repair=True)
+        assert report["ok"]
+        assert any(f["kind"] == "sidecar" for f in report["findings"])
+        with open(sidecar) as fh:
+            assert int(fh.read().strip()) == report["generation"]
 
 
 class TestRefsAndRegistry:

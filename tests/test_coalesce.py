@@ -363,3 +363,31 @@ def test_bypass_counting_and_stats_shape():
     finally:
         co.close()
     assert stats(None) == {"enabled": False}
+
+
+def test_expired_rider_dropped_before_dispatch():
+    """Deadline propagation's coalescer leg: a rider whose propagated
+    deadline passed while queued resolves with DeadlineExpired BEFORE
+    dispatch (no device work for abandoned requests); a live rider in
+    the same queue still scores."""
+    import pytest
+
+    from gordo_tpu.serve.coalesce import DeadlineExpired
+
+    fleet = FakeFleet(["m-0"])
+    co = _mk(fleet)
+    try:
+        dead = co.submit(
+            "m-0", np.ones((2, 2), np.float32),
+            deadline=time.monotonic() - 0.01,
+        )
+        with pytest.raises(DeadlineExpired):
+            dead.result(timeout=5)
+        live = co.submit(
+            "m-0", np.ones((2, 2), np.float32),
+            deadline=time.monotonic() + 30.0,
+        )
+        out = live.result(timeout=5)
+        np.testing.assert_allclose(out["model-output"], 2.0)
+    finally:
+        co.close()

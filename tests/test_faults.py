@@ -1,0 +1,167 @@
+"""Unit tests for the fault-injection plane (``gordo_tpu.faults``): spec
+grammar, seeded determinism, firing controls (rate/times/after/match),
+mode translation at the client I/O seam, and the off-by-default
+zero-overhead contract.  The fleet-level chaos scenarios live in
+``tests/chaos/`` (slow lane)."""
+
+import errno
+import time
+
+import pytest
+
+from gordo_tpu import faults
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_plane():
+    """Tests must start and end with no installed plane."""
+    faults.clear()
+    yield
+    faults.clear()
+
+
+class TestSpecGrammar:
+    def test_full_spec_parses(self):
+        plane = faults.parse_spec(
+            "seed=7;pack.open=eio:0.5;"
+            "http.request=latency:1:ms=40,times=2,after=1,match=replica-3"
+        )
+        assert plane.seed == 7
+        (rule,) = plane.rules["http.request"]
+        assert rule.mode == "latency" and rule.rate == 1.0
+        assert rule.ms == 40.0 and rule.times == 2 and rule.after == 1
+        assert rule.match == "replica-3"
+        (eio,) = plane.rules["pack.open"]
+        assert eio.rate == 0.5
+
+    @pytest.mark.parametrize("bad", [
+        "pack.open",                 # no mode
+        "pack.open=",                # empty mode
+        "seed=x",                    # non-integer seed
+        "pack.open=eio:nope",        # non-float rate
+        "pack.open=eio:1.5",         # rate out of [0,1]
+        "pack.open=eio:1:frob=3",    # unknown param
+        "pack.open=eio:1:ms",        # param without value
+    ])
+    def test_bad_specs_raise(self, bad):
+        with pytest.raises(faults.FaultSpecError):
+            faults.parse_spec(bad)
+
+    def test_empty_clauses_ignored(self):
+        plane = faults.parse_spec(";;seed=1;;")
+        assert plane.seed == 1 and plane.rules == {}
+
+
+class TestFiring:
+    def test_off_is_a_noop(self):
+        assert not faults.enabled()
+        faults.check("pack.open", pack="p")  # no plane: returns silently
+
+    def test_modes_translate(self):
+        with faults.injected("pack.open=eio"):
+            with pytest.raises(OSError) as exc:
+                faults.check("pack.open")
+            assert exc.value.errno == errno.EIO
+        with faults.injected("artifact.write=enospc"):
+            with pytest.raises(OSError) as exc:
+                faults.check("artifact.write")
+            assert exc.value.errno == errno.ENOSPC
+        with faults.injected("pack.open=corrupt"):
+            with pytest.raises(faults.InjectedFault) as exc:
+                faults.check("pack.open", pack="p1")
+            assert exc.value.mode == "corrupt" and "p1" in exc.value.detail
+
+    def test_latency_delays_instead_of_raising(self):
+        with faults.injected("http.request=latency:1:ms=30"):
+            t0 = time.monotonic()
+            faults.check("http.request")
+            assert time.monotonic() - t0 >= 0.025
+
+    def test_times_after_and_match(self):
+        with faults.injected(
+            "replica.scatter=dead:1:after=1,times=1,match=bad-host"
+        ):
+            # wrong context: the rule never even counts a call
+            faults.check("replica.scatter", replica="http://good-host")
+            # first matching call is skipped by after=1
+            faults.check("replica.scatter", replica="http://bad-host")
+            with pytest.raises(faults.InjectedFault):
+                faults.check("replica.scatter", replica="http://bad-host")
+            # times=1 exhausted
+            faults.check("replica.scatter", replica="http://bad-host")
+
+    def test_rate_zero_never_fires(self):
+        with faults.injected("pack.open=eio:0"):
+            for _ in range(50):
+                faults.check("pack.open")
+
+    def test_seeded_schedule_is_deterministic(self):
+        def schedule(seed):
+            fired = []
+            with faults.injected(f"seed={seed};pack.open=eio:0.5"):
+                for i in range(64):
+                    try:
+                        faults.check("pack.open", i=i)
+                        fired.append(0)
+                    except OSError:
+                        fired.append(1)
+            return fired
+
+        a, b = schedule(7), schedule(7)
+        assert a == b, "same seed, same call sequence, same faults"
+        assert 0 < sum(a) < 64, "rate 0.5 fires some but not all"
+        assert schedule(8) != a, "a different seed reshuffles the schedule"
+
+    def test_stats_count_calls_and_fires(self):
+        with faults.injected("seed=3;pack.read=corrupt:1:times=2") as plane:
+            for _ in range(5):
+                try:
+                    faults.check("pack.read")
+                except faults.InjectedFault:
+                    pass
+            assert plane.stats() == {
+                "pack.read:corrupt": {"calls": 5, "fired": 2}
+            }
+
+    def test_injected_restores_previous_plane(self):
+        outer = faults.configure("pack.open=eio")
+        with faults.injected("pack.read=corrupt"):
+            assert faults.plane() is not outer
+        assert faults.plane() is outer
+
+    def test_env_configures(self, monkeypatch):
+        monkeypatch.setenv(faults.ENV_FAULTS, "seed=2;pack.open=eio")
+        plane = faults.configure()
+        assert plane is not None and plane.seed == 2
+        monkeypatch.delenv(faults.ENV_FAULTS)
+        assert faults.configure() is None
+
+
+class TestClientSeam:
+    """The HTTP client seam translates InjectedFault into the transport
+    errors the retry loop already classifies."""
+
+    def test_blackhole_is_a_timeout(self):
+        import asyncio
+
+        from gordo_tpu.client.io import _check_http_fault
+
+        with faults.injected("http.request=blackhole"):
+            with pytest.raises(asyncio.TimeoutError):
+                _check_http_fault("POST", "http://x/anomaly")
+
+    def test_reset_is_a_connection_error(self):
+        import aiohttp
+
+        from gordo_tpu.client.io import _check_http_fault
+
+        with faults.injected("http.request=reset"):
+            with pytest.raises(aiohttp.ClientConnectionError):
+                _check_http_fault("GET", "http://x/")
+
+    def test_http_500_is_a_bad_response(self):
+        from gordo_tpu.client.io import BadGordoResponse, _check_http_fault
+
+        with faults.injected("http.request=http_500"):
+            with pytest.raises(BadGordoResponse):
+                _check_http_fault("GET", "http://x/")

@@ -345,7 +345,7 @@ class TestClientHonorsRetryAfter:
         assert body == {"ok": True}
         assert sleeps == [0.0]  # the server said "now"; not 0.5s
 
-    def test_no_header_keeps_exponential_schedule(self):
+    def test_no_header_keeps_jittered_exponential_schedule(self):
         calls = []
 
         async def handler(request):
@@ -356,7 +356,12 @@ class TestClientHonorsRetryAfter:
 
         body, sleeps = self._run(handler, retries=3, backoff=0.01)
         assert body == {"ok": True}
-        assert sleeps == [pytest.approx(0.01), pytest.approx(0.02)]
+        # full jitter: every delay is uniform over [0, backoff * 2^attempt]
+        # (a deterministic schedule synchronizes a replica's whole client
+        # population into retry waves; docs/operations.md)
+        assert len(sleeps) == 2
+        assert 0.0 <= sleeps[0] <= 0.01
+        assert 0.0 <= sleeps[1] <= 0.02
 
 
 # ---------------------------------------------------------------------------
