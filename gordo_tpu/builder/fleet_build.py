@@ -26,8 +26,10 @@ the high-water mark so tests can hold the bound.
 
 from __future__ import annotations
 
+import dataclasses
 import hashlib
 import logging
+import math
 import os
 import shutil
 import threading
@@ -95,6 +97,94 @@ _PIPE_CHUNKS_TOTAL = telemetry.counter(
     "Fleet chunks driven to completion, by execution path",
     labels=("path",),  # pipelined | serial
 )
+
+
+# -- incremental refresh knobs (docs/configuration.md) ----------------------
+#: fraction of the configured epochs a warm-start rebuild trains for —
+#: the previous generation's weights are most of the way there already
+ENV_REFRESH_EPOCH_FRACTION = "GORDO_REFRESH_EPOCH_FRACTION"
+DEFAULT_REFRESH_EPOCH_FRACTION = 0.25
+#: parity gate: the warm rebuild's final training loss must stay within
+#: this factor of the previous artifact's recorded final loss, or the
+#: machine rebuilds cold (full epochs, fresh init) with the reason attested
+#: in its metadata
+ENV_REFRESH_PARITY_FACTOR = "GORDO_REFRESH_PARITY_FACTOR"
+DEFAULT_REFRESH_PARITY_FACTOR = 1.5
+
+
+def _refresh_epoch_fraction() -> float:
+    try:
+        frac = float(os.environ.get(
+            ENV_REFRESH_EPOCH_FRACTION, DEFAULT_REFRESH_EPOCH_FRACTION
+        ))
+    except ValueError:
+        return DEFAULT_REFRESH_EPOCH_FRACTION
+    return min(max(frac, 0.0), 1.0)
+
+
+def _refresh_parity_factor() -> float:
+    try:
+        return float(os.environ.get(
+            ENV_REFRESH_PARITY_FACTOR, DEFAULT_REFRESH_PARITY_FACTOR
+        ))
+    except ValueError:
+        return DEFAULT_REFRESH_PARITY_FACTOR
+
+
+def _warm_epochs(cfg) -> int:
+    """Reduced-epoch budget for a warm-start fit (never below 1)."""
+    return max(1, math.ceil(cfg.epochs * _refresh_epoch_fraction()))
+
+
+def _detector_estimator(detector):
+    """The trained JAX estimator inside a detector/pipeline artifact."""
+    from gordo_tpu.pipeline import Pipeline
+
+    base = getattr(detector, "base_estimator", detector)
+    return base._final if isinstance(base, Pipeline) else base
+
+
+def _resolve_warm_params(
+    output_dir: str, names: Sequence[str]
+) -> Dict[str, Tuple[Any, Optional[float]]]:
+    """Previous-generation warm-start material via zero-copy
+    :class:`~gordo_tpu.artifacts.PackStore` reads:
+    ``{name: (params pytree, previous final training loss)}``.
+
+    Machines the pack index doesn't know (first build, v1-only artifact)
+    are simply absent — the caller rebuilds them cold and attests why.
+    The arrays stay memory-mapped until the fleet program stacks them, so
+    resolving a subset never reads the rest of the fleet's bytes."""
+    try:
+        store = artifacts.open_store(output_dir)
+    except Exception:
+        logger.exception(
+            "warm-start: pack store open failed under %s", output_dir
+        )
+        return {}
+    if store is None:
+        return {}
+    resolved: Dict[str, Tuple[Any, Optional[float]]] = {}
+    for name in names:
+        if name not in store:
+            continue
+        try:
+            est = _detector_estimator(store.load_model(name))
+            params = getattr(est, "params_", None)
+            if params is None:
+                continue
+            hist = getattr(est, "history_", None)
+            prev_loss = (
+                float(np.asarray(hist).ravel()[-1])
+                if hist is not None and np.size(hist) else None
+            )
+        except Exception:
+            logger.exception(
+                "warm-start: could not resolve previous params for %s", name
+            )
+            continue
+        resolved[name] = (params, prev_loss)
+    return resolved
 
 
 def _pipeline_enabled(pipeline: Optional[bool]) -> bool:
@@ -277,6 +367,15 @@ class ProjectBuildResult:
         #: artifact format this build wrote ("v1" per-machine dirs, "v2"
         #: memory-mapped bucket packs — see gordo_tpu/artifacts/)
         self.artifact_format: str = "v1"
+        #: machines rebuilt from the previous generation's params under
+        #: the parity gate (warm_start=True builds only)
+        self.warm_started: List[str] = []
+        #: machines a warm_start build rebuilt COLD, with the attested
+        #: reason (no previous params / parity gate / single path / ...)
+        self.warm_fallbacks: Dict[str, str] = {}
+        #: the published artifact generation after this build's stamp
+        #: (v2 only; None for v1 builds)
+        self.generation: Optional[int] = None
 
     def summary(self) -> Dict[str, Any]:
         out = {
@@ -290,6 +389,11 @@ class ProjectBuildResult:
             "pipelined": self.pipelined,
             "artifact_format": self.artifact_format,
         }
+        if self.warm_started or self.warm_fallbacks:
+            out["warm_started"] = len(self.warm_started)
+            out["warm_fallbacks"] = dict(self.warm_fallbacks)
+        if self.generation is not None:
+            out["generation"] = self.generation
         if self.auto_pad:
             out["auto_pad_lengths"] = self.auto_pad
         if self.shard:
@@ -372,8 +476,24 @@ def build_project(
     shard: Optional[Any] = None,
     pipeline: Optional[bool] = None,
     artifact_format: Optional[str] = None,
+    warm_start: bool = False,
 ) -> ProjectBuildResult:
     """Build every machine; fleet-bucket the homogeneous ones.
+
+    ``warm_start=True`` is the incremental-refresh mode (v2 only —
+    requires an existing pack index): pass the SUBSET of machines to
+    rebuild, and each one's previous-generation params resolve via
+    zero-copy :class:`~gordo_tpu.artifacts.PackStore` reads to seed a
+    reduced-epoch warm fit (``GORDO_REFRESH_EPOCH_FRACTION`` of the
+    configured epochs).  A per-machine parity gate — the warm final
+    training loss must stay within ``GORDO_REFRESH_PARITY_FACTOR`` of
+    the previous artifact's — demotes failing machines to a full cold
+    rebuild, attested in ``result.warm_fallbacks`` and the machine's
+    metadata.  Rebuilt machines already in the index publish through
+    ``artifacts.delta_write`` (in-place slot rewrites + one atomic
+    index swap that stamps its own generation), so live servers
+    delta-reload exactly the touched packs; the config-hash cache is
+    bypassed (the configs haven't changed — the data has).
 
     ``artifact_format``: ``"v1"`` writes the historical one-directory-
     per-machine layout; ``"v2"`` writes one memory-mapped parameter pack
@@ -472,6 +592,28 @@ def build_project(
     artifact_fmt = artifacts.resolve_format(artifact_format)
     result.artifact_format = artifact_fmt
     tracker = _LoadTracker()
+    warm_resolved: Dict[str, Tuple[Any, Optional[float]]] = {}
+    #: per-machine warm-start attestation, stamped into artifact metadata
+    warm_info_by_name: Dict[str, Dict[str, Any]] = {}
+    if warm_start:
+        if artifact_fmt != "v2":
+            raise ValueError(
+                "warm_start=True needs the v2 pack layout (previous "
+                "params resolve through the pack index) — rebuild with "
+                "artifact_format='v2' or drop warm_start"
+            )
+        # a drifted machine's CONFIG is unchanged — its data drifted — so
+        # the config-hash cache would skip the very rebuild we were asked
+        # for; warm builds always retrain
+        replace_cache = True
+        warm_resolved = _resolve_warm_params(
+            output_dir, [m.name for m in machines]
+        )
+        if not warm_resolved:
+            logger.warning(
+                "warm_start=True but no previous params resolved under "
+                "%s — every machine rebuilds cold", output_dir,
+            )
     # the auto-pad decision runs over the FULL machine list, before any
     # shard filtering: every process of a multi-host build (and a later
     # single-host re-run of the same config) must reach the same ragged
@@ -677,6 +819,92 @@ def build_project(
             }
         )
 
+    def _note_fallback(name: str, reason: str) -> None:
+        """A warm_start machine rebuilding cold: attest why (result +
+        metadata) — the bench parity gate accepts an attested fallback."""
+        result.warm_fallbacks[name] = reason
+        warm_info_by_name[name] = {"warm": False, "fallback": reason}
+        logger.warning("warm-start fallback for %s: %s", name, reason)
+
+    def _train_chunk(spec_obj, cv, ok_chunk, loaded, warm_list=None):
+        builder = FleetDiffBuilder(
+            spec_obj, cv=cv, mesh=mesh, pad_lengths=pad_lengths
+        )
+        with profiling.trace(f"fleet_bucket/{len(ok_chunk)}"):
+            return builder.build(
+                [loaded[m.name][0] for m in ok_chunk],
+                [loaded[m.name][1] for m in ok_chunk],
+                warm_params=warm_list,
+            )
+
+    def _build_chunk_warm(spec, cv, ok_chunk, loaded):
+        """One chunk in warm_start mode: machines with resolved previous
+        params run the warm program under a reduced-epoch config, the
+        parity gate demotes stragglers, and everything else (plus gate
+        failures) rebuilds cold — all within the chunk, so the caller
+        still sees detectors in ``ok_chunk`` order."""
+        warm_ms = [m for m in ok_chunk if m.name in warm_resolved]
+        cold_names = set()
+        for m in ok_chunk:
+            if m.name not in warm_resolved:
+                _note_fallback(m.name, "no-previous-params")
+                cold_names.add(m.name)
+        dets: Dict[str, Any] = {}
+        if warm_ms:
+            parity_factor = _refresh_parity_factor()
+            warm_cfg = dataclasses.replace(
+                spec.train_cfg, epochs=_warm_epochs(spec.train_cfg)
+            )
+            warm_spec = dataclasses.replace(spec, train_cfg=warm_cfg)
+            try:
+                warm_dets = _train_chunk(
+                    warm_spec, cv, warm_ms, loaded,
+                    warm_list=[warm_resolved[m.name][0] for m in warm_ms],
+                )
+            except Exception:
+                logger.exception(
+                    "warm-start chunk build failed; rebuilding %d "
+                    "machine(s) cold", len(warm_ms),
+                )
+                for m in warm_ms:
+                    _note_fallback(m.name, "warm-build-failed")
+                    cold_names.add(m.name)
+                warm_ms, warm_dets = [], []
+            for m, det in zip(warm_ms, warm_dets):
+                prev_loss = warm_resolved[m.name][1]
+                hist = np.asarray(
+                    getattr(_detector_estimator(det), "history_", ())
+                ).ravel()
+                warm_loss = float(hist[-1]) if hist.size else float("nan")
+                passed = np.isfinite(warm_loss) and (
+                    prev_loss is None
+                    or warm_loss
+                    <= parity_factor * max(prev_loss, 1e-12) + 1e-12
+                )
+                if passed:
+                    dets[m.name] = det
+                    result.warm_started.append(m.name)
+                    warm_info_by_name[m.name] = {
+                        "warm": True,
+                        "epochs": int(warm_cfg.epochs),
+                        "final_loss": warm_loss,
+                        "previous_final_loss": prev_loss,
+                    }
+                else:
+                    _note_fallback(
+                        m.name,
+                        f"parity: warm final loss {warm_loss:.6g} vs "
+                        f"previous {prev_loss} "
+                        f"(factor {parity_factor:g})",
+                    )
+                    cold_names.add(m.name)
+        cold_ms = [m for m in ok_chunk if m.name in cold_names]
+        if cold_ms:
+            for m, det in zip(cold_ms, _train_chunk(spec, cv, cold_ms,
+                                                    loaded)):
+                dets[m.name] = det
+        return [dets[m.name] for m in ok_chunk]
+
     def _run_bucket(key: Tuple, chunk: List[Machine], loaded: Dict[str, Tuple]):
         """Width-validate + train one chunk on device.  Returns
         ``(ok_chunk, detectors, fleet_seconds)`` or None when every
@@ -707,14 +935,10 @@ def build_project(
         cv = ok_chunk[0].evaluation.get("cv")
         t0 = time.time()
         try:
-            builder = FleetDiffBuilder(
-                spec, cv=cv, mesh=mesh, pad_lengths=pad_lengths
-            )
-            with profiling.trace(f"fleet_bucket/{len(ok_chunk)}"):
-                detectors = builder.build(
-                    [loaded[m.name][0] for m in ok_chunk],
-                    [loaded[m.name][1] for m in ok_chunk],
-                )
+            if warm_start:
+                detectors = _build_chunk_warm(spec, cv, ok_chunk, loaded)
+            else:
+                detectors = _train_chunk(spec, cv, ok_chunk, loaded)
         except Exception:
             logger.exception("Fleet bucket failed; falling back to singles")
             for m in ok_chunk:
@@ -746,7 +970,7 @@ def build_project(
             _record_manifest(key, ok_chunk)
             _PIPE_CHUNKS_TOTAL.inc(1.0, "serial")
             if artifact_fmt == "v2":
-                _write_chunk_pack(
+                _write_chunk(
                     *_chunk_payload(ok_chunk, detectors, fleet_seconds, loaded)
                 )
                 continue
@@ -871,10 +1095,81 @@ def build_project(
                 align_lengths=align_lengths, pad_lengths=pad_lengths,
                 cache_key=machine_keys[m.name],
                 baseline=baselines.get(m.name),
+                warm_info=warm_info_by_name.get(m.name),
             ))
             _free(loaded, [m.name])
         names = [m.name for m in ok_chunk]
         return names, list(detectors), metadatas, per_machine, chunk_definition
+
+    def _record_packed(names, per_machine) -> None:
+        """Bookkeeping shared by the pack and delta publish paths."""
+        for name in names:
+            result.artifacts[name] = artifacts.machine_ref(output_dir, name)
+            result.fleet_built.append(name)
+            _BUILD_MACHINES_TOTAL.inc(1.0, "fleet")
+            _BUILD_MACHINE_SECONDS.observe(per_machine, "fleet")
+            _register(
+                artifacts.machine_ref(output_dir, name),
+                model_register_dir, machine_keys.get(name),
+            )
+            _done(name)
+
+    def _write_chunk_delta(names, detectors, metadatas, per_machine,
+                           definition: Optional[str] = None) -> None:
+        """Incremental publish (warm_start builds): machines the pack
+        index already knows rewrite their slots in place via
+        ``delta_write`` — whose single atomic index swap stamps its own
+        generation, so live servers delta-reload exactly the touched
+        packs — and machines the index doesn't know yet land as a fresh
+        pack row published by the build's final stamp.  A structural
+        mismatch (leaf signature changed since the previous generation)
+        demotes the whole chunk to a fresh pack; any other write failure
+        fails THESE machines loudly and leaves the store on its previous
+        healthy generation — no partial-delta limbo, the next refresh
+        cycle retries."""
+        store = artifacts.open_store(output_dir)
+        known = set(store.names()) if store is not None else set()
+        delta_names = [n for n in names if n in known]
+        fresh_names = [n for n in names if n not in known]
+        by_name = dict(zip(names, detectors))
+        meta_by_name = dict(zip(names, metadatas))
+        try:
+            if delta_names:
+                try:
+                    artifacts.delta_write(
+                        output_dir,
+                        {n: by_name[n] for n in delta_names},
+                        metadatas={n: meta_by_name[n] for n in delta_names},
+                    )
+                except artifacts.PackError:
+                    # structural change since the previous generation —
+                    # a delta can't express it; write a fresh pack row
+                    logger.warning(
+                        "delta publish: leaf signature changed for chunk "
+                        "%s...; writing a fresh pack instead", names[:3],
+                    )
+                    fresh_names = list(names)
+                    delta_names = []
+            if fresh_names:
+                artifacts.write_pack(
+                    output_dir, fresh_names,
+                    [by_name[n] for n in fresh_names],
+                    [meta_by_name[n] for n in fresh_names],
+                    definition=definition,
+                    cache_keys={
+                        n: machine_keys[n]
+                        for n in fresh_names if n in machine_keys
+                    },
+                )
+        except Exception as exc:
+            logger.exception(
+                "Incremental publish failed for chunk %s...", names[:3],
+            )
+            for name in names:
+                result.failed[name] = f"write: {exc}"
+                _BUILD_MACHINES_TOTAL.inc(1.0, "failed")
+            return
+        _record_packed(names, per_machine)
 
     def _write_chunk_pack(names, detectors, metadatas, per_machine,
                           definition: Optional[str] = None) -> None:
@@ -897,21 +1192,17 @@ def build_project(
             for name, det, metadata in zip(names, detectors, metadatas):
                 _write_one(name, det, metadata, per_machine, definition)
             return
-        for name in names:
-            result.artifacts[name] = artifacts.machine_ref(output_dir, name)
-            result.fleet_built.append(name)
-            _BUILD_MACHINES_TOTAL.inc(1.0, "fleet")
-            _BUILD_MACHINE_SECONDS.observe(per_machine, "fleet")
-            _register(
-                artifacts.machine_ref(output_dir, name),
-                model_register_dir, machine_keys.get(name),
-            )
-            _done(name)
+        _record_packed(names, per_machine)
+
+    # warm_start publishes incrementally (delta_write for known machines)
+    # so live servers reload ONLY the touched packs; full builds write
+    # whole chunk packs as always
+    _write_chunk = _write_chunk_delta if warm_start else _write_chunk_pack
 
     with ThreadPoolExecutor(max_workers=data_workers) as pool:
         if use_pipeline:
             writer = _ArtifactWriter(
-                _write_chunk_pack if artifact_fmt == "v2" else _write_one
+                _write_chunk if artifact_fmt == "v2" else _write_one
             )
             try:
                 _drive_pipeline(pool, writer)
@@ -941,6 +1232,9 @@ def build_project(
         # form; a prior run's single artifact may already satisfy it
         if m.name in demoted and _lookup(machine_keys[m.name], m):
             continue
+        if warm_start and m.name not in result.warm_fallbacks:
+            # single-path builds have no fleet program to warm-start
+            _note_fallback(m.name, "single-path")
         t_single = time.time()
         try:
             model, metadata = build_model(
@@ -976,6 +1270,7 @@ def build_project(
         # No-op (returns the current id) when the run was fully cached.
         try:
             generation = artifacts.stamp_generation(output_dir)
+            result.generation = generation
             if generation:
                 logger.info(
                     "published artifact generation %d", generation
@@ -1065,6 +1360,7 @@ def _machine_metadata(
     pad_lengths: Optional[int] = None,
     cache_key: Optional[str] = None,
     baseline: Optional[Dict[str, Any]] = None,
+    warm_info: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Assemble one machine's artifact metadata — everything except the
     disk writes, so the pipelined path can free the training arrays at
@@ -1095,6 +1391,11 @@ def _machine_metadata(
         # do NOT get the stamp — their artifacts are full-parity builds.
         metadata["model"]["pad_lengths"] = int(pad_lengths)
         metadata["model"]["rows_trained"] = int(X.shape[0])
+    if warm_info is not None:
+        # incremental-refresh attestation: either the warm-start lineage
+        # (epochs trained, previous/final loss) or the cold-fallback
+        # reason — auditable per machine, per generation
+        metadata["model"]["warm_start"] = dict(warm_info)
     # the artifact stamps its own cache identity so a later lookup can
     # detect that this dir was overwritten by a different build
     if cache_key is not None:

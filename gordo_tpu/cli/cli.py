@@ -952,13 +952,12 @@ def fleet_health_cmd(url, rollup_dir, project, top, full):
     if bool(url) == bool(rollup_dir):
         raise click.UsageError("provide exactly one of --url or --dir")
     if rollup_dir:
-        docs = telemetry.load_rollups(rollup_dir)
-        if not docs:
+        doc = telemetry.read_rollups(rollup_dir, top=top)
+        if doc is None:
             raise click.ClickException(
                 f"no fleet-health rollups under {rollup_dir!r} "
                 f"(is the server writing them? GORDO_HEALTH_ROLLUP_SECONDS)"
             )
-        doc = telemetry.merge_health_docs(docs, top=top)
     else:
         import urllib.error
         import urllib.request
@@ -996,6 +995,107 @@ def fleet_health_cmd(url, rollup_dir, project, top, full):
         "top-drift": doc.get("top-drift", []),
     }
     click.echo(json.dumps(summary, indent=1, sort_keys=True))
+
+
+# ---------------------------------------------------------------------------
+# refresh (drift-driven incremental rebuilds)
+# ---------------------------------------------------------------------------
+
+@gordo.command("refresh")
+@click.option("--machine-config", required=True, envvar="MACHINE_CONFIG",
+              help="Project YAML (text or file) with machines/globals — "
+                   "the machines this refresh deployment may rebuild.")
+@click.option("--project-name", envvar="PROJECT_NAME", default="project")
+@click.option("--output-dir", envvar="OUTPUT_DIR", default="./models")
+@click.option("--model-register-dir", envvar="MODEL_REGISTER_DIR",
+              default=None)
+@click.option("--health-url", default=None,
+              help="HTTP health surface (server or watchman base URL). "
+                   "Default: the rollup JSONL files under --output-dir "
+                   "(.gordo-fleet-health/) — no HTTP needed.")
+@click.option("--server-url", default=None,
+              help="Server base URL to confirm the rebuilt generation "
+                   "went live on (client wait_for_generation handshake). "
+                   "Default: publish without confirmation.")
+@click.option("--once", is_flag=True,
+              help="Run exactly one poll→select→rebuild cycle and exit "
+                   "(the CronJob face; hysteresis streaks persist under "
+                   "<output-dir>/.gordo-refresh/state.json).")
+@click.option("--interval", default=None, type=click.FloatRange(min=0),
+              help="Seconds between cycles in the continuous loop "
+                   "[default: GORDO_REFRESH_INTERVAL or 300].")
+@click.option("--hysteresis", default=None, type=click.IntRange(min=1),
+              help="Consecutive drifting observations before a machine "
+                   "is rebuilt [default: GORDO_REFRESH_HYSTERESIS or 2].")
+@click.option("--cooldown-seconds", default=None,
+              type=click.FloatRange(min=0),
+              help="Per-machine seconds between rebuilds "
+                   "[default: GORDO_REFRESH_COOLDOWN_SECONDS or 900].")
+@click.option("--wait-timeout", default=120.0, show_default=True,
+              type=click.FloatRange(min=1),
+              help="Seconds to wait for the generation flip to be "
+                   "confirmed live (--server-url).")
+def refresh_cmd(machine_config, project_name, output_dir,
+                model_register_dir, health_url, server_url, once, interval,
+                hysteresis, cooldown_seconds, wait_timeout):
+    """Rebuild ONLY the drifting machines, warm-started from the live
+    generation — O(drifted) instead of O(fleet).
+
+    Polls fleet health (rollup files or --health-url), selects machines
+    observed ``status=drifting`` on K consecutive polls and outside
+    their cooldown, warm-starts a subset rebuild from the previous
+    generation's params (per-machine cold fallback under the loss-parity
+    gate), and publishes through the artifact plane's delta path so live
+    servers hot-reload exactly the touched packs.  One summary JSON line
+    per cycle on stdout.
+    """
+    from gordo_tpu.refresh import RefreshConfig, refresh_once
+    from gordo_tpu.workflow.config import NormalizedConfig, load_machine_config
+
+    config = NormalizedConfig(load_machine_config(machine_config), project_name)
+    cfg = RefreshConfig(
+        machines=config.machines,
+        output_dir=output_dir,
+        model_register_dir=model_register_dir,
+        project=project_name,
+        health_url=health_url,
+        server_url=server_url,
+        hysteresis=hysteresis,
+        cooldown_seconds=cooldown_seconds,
+        wait_timeout=wait_timeout,
+    )
+    if once:
+        summary = refresh_once(cfg)
+        click.echo(json.dumps(summary, sort_keys=True))
+        if summary.get("outcome") == "failed":
+            sys.exit(1)
+        return
+
+    import time
+
+    from gordo_tpu.refresh.loop import (
+        DEFAULT_INTERVAL,
+        ENV_INTERVAL,
+        DriftSelector,
+        state_path,
+    )
+
+    if interval is None:
+        try:
+            interval = float(os.environ.get(ENV_INTERVAL, "")
+                             or DEFAULT_INTERVAL)
+        except ValueError:
+            interval = DEFAULT_INTERVAL
+    # one selector for the whole loop: streaks span cycles in-process
+    # (run_refresh does the same; inlined here for the per-cycle echo)
+    selector = DriftSelector.load(
+        state_path(output_dir), hysteresis=hysteresis,
+        cooldown_seconds=cooldown_seconds,
+    )
+    while True:
+        summary = refresh_once(cfg, selector=selector)
+        click.echo(json.dumps(summary, sort_keys=True))
+        time.sleep(interval)
 
 
 # ---------------------------------------------------------------------------
@@ -1050,11 +1150,18 @@ def workflow_group():
 @click.option("--hpa-max-replicas", default=4, show_default=True,
               type=click.IntRange(min=1),
               help="maxReplicas of each shard's HPA (--serve-shards).")
+@click.option("--refresh-cron", default=None,
+              help="5-field cron schedule: additionally emit a CronJob "
+                   "running 'gordo refresh --once' against the same "
+                   "models PVC + project config as the builder — the "
+                   "drift-driven incremental rebuild loop. Refused when "
+                   "the builder has no models volume to warm-start "
+                   "from, or when the schedule is malformed.")
 @click.option("--output-file", type=click.File("w"), default="-")
 def workflow_generate(machine_config, project_name, image, server_replicas,
                       server_args, fmt, multihost, scrape_annotations,
                       serve_dtype, serve_shards, hpa_max_replicas,
-                      output_file):
+                      refresh_cron, output_file):
     """Render the kubernetes manifests + fleet build plan (reference:
     the Argo workflow template render)."""
     from gordo_tpu.workflow import (
@@ -1079,6 +1186,7 @@ def workflow_generate(machine_config, project_name, image, server_replicas,
             serve_dtype=serve_dtype,
             serve_shards=serve_shards,
             hpa_max_replicas=hpa_max_replicas,
+            refresh_cron=refresh_cron,
         )
     except ValueError as exc:
         raise click.ClickException(str(exc))

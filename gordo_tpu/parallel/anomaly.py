@@ -257,6 +257,42 @@ def _model_axis_pad(m: int, mesh) -> int:
     return m_pad
 
 
+def _stack_warm_params(params_list: Sequence[Any], m_pad: int):
+    """Stack per-machine param pytrees into the fleet layout: leading
+    machine axis, padded to ``m_pad`` by repeating the last machine (the
+    padded lanes are dummies whose results ``_assemble`` discards).
+
+    A length-group shares one module, so every tree must agree in
+    structure and leaf shapes; a mismatch (a stale artifact predating a
+    model-config change, say) raises ``ValueError`` so the caller can
+    fall back to a cold build instead of feeding XLA garbage."""
+    treedef0 = None
+    leaves0: List[Any] = []
+    flats: List[List[np.ndarray]] = []
+    for i, params in enumerate(params_list):
+        leaves, treedef = jax.tree.flatten(params)
+        leaves = [np.asarray(leaf) for leaf in leaves]
+        if treedef0 is None:
+            treedef0, leaves0 = treedef, leaves
+        elif treedef != treedef0 or any(
+            a.shape != b.shape or a.dtype != b.dtype
+            for a, b in zip(leaves, leaves0)
+        ):
+            raise ValueError(
+                f"warm-start params for machine {i} break the group's "
+                "shared leaf signature — the previous artifact predates "
+                "a model-config change; rebuild cold"
+            )
+        flats.append(leaves)
+    stacked = [
+        fleet_mod._pad_models(
+            np.stack([flat[j] for flat in flats]), m_pad
+        )
+        for j in range(len(leaves0))
+    ]
+    return jax.tree.unflatten(treedef0, stacked)
+
+
 # ---------------------------------------------------------------------------
 # The fleet builder
 # ---------------------------------------------------------------------------
@@ -291,16 +327,28 @@ class FleetDiffBuilder:
         self,
         Xs: Sequence[np.ndarray],
         ys: Optional[Sequence[np.ndarray]] = None,
+        warm_params: Optional[Sequence[Any]] = None,
     ) -> List[DiffBasedAnomalyDetector]:
         """Build detectors for ``Xs`` in input order.
 
         Machines are grouped by row count; each length-group runs the exact
         fold-materializing program, so every machine's result matches the
         single-machine path (not just the bucket-max ones).
+
+        ``warm_params`` (one param pytree per machine, aligned with ``Xs``)
+        switches every group onto the warm program variant: fits resume
+        from the given weights instead of ``fleet_init`` — the incremental
+        refresh path.  Callers pair it with a reduced-epoch
+        :class:`~gordo_tpu.train.fit.TrainConfig` in the spec.
         """
         if ys is not None and len(ys) != len(Xs):
             raise ValueError(
                 f"Got {len(Xs)} input series but {len(ys)} target series"
+            )
+        if warm_params is not None and len(warm_params) != len(Xs):
+            raise ValueError(
+                f"Got {len(Xs)} input series but {len(warm_params)} "
+                "warm-start param trees"
             )
         Xs = [np.asarray(x, np.float32) for x in Xs]
         if ys is not None:
@@ -312,7 +360,7 @@ class FleetDiffBuilder:
                     )
 
         if self.pad_lengths:
-            return self._build_padded(Xs, ys)
+            return self._build_padded(Xs, ys, warm_params)
 
         n_lengths = len({int(x.shape[0]) for x in Xs})
         if n_lengths > 1 and n_lengths > len(Xs) // 2:
@@ -328,11 +376,13 @@ class FleetDiffBuilder:
             )
 
         detectors: List[Optional[DiffBasedAnomalyDetector]] = [None] * len(Xs)
-        self._build_exact_length_groups(Xs, ys, range(len(Xs)), detectors)
+        self._build_exact_length_groups(
+            Xs, ys, range(len(Xs)), detectors, warm_params
+        )
         return detectors  # type: ignore[return-value]
 
     def _build_exact_length_groups(
-        self, Xs, ys, idxs, detectors: List
+        self, Xs, ys, idxs, detectors: List, warm_params=None
     ) -> None:
         """Group ``idxs`` by row count and run the exact program per
         length-group, scattering results into ``detectors``."""
@@ -348,13 +398,21 @@ class FleetDiffBuilder:
                     [np.asarray(ys[i], np.float32) for i in group]
                 )
             )
-            for i, det in zip(group, self._build_group(X_g, y_g)):
+            warm_g = (
+                None
+                if warm_params is None
+                else [warm_params[i] for i in group]
+            )
+            for i, det in zip(
+                group, self._build_group(X_g, y_g, warm=warm_g)
+            ):
                 detectors[i] = det
 
     def _build_padded(
         self,
         Xs: Sequence[np.ndarray],
         ys: Optional[Sequence[np.ndarray]],
+        warm_params: Optional[Sequence[Any]] = None,
     ) -> List[DiffBasedAnomalyDetector]:
         """Pad-up mode: group by row count rounded UP to ``pad_lengths``,
         NaN-pad each machine's rows to the group length (NaN rows fall out
@@ -416,7 +474,9 @@ class FleetDiffBuilder:
                     continue
                 groups[n_pad] = idxs
 
-        self._build_exact_length_groups(Xs, ys, exact_fallback, detectors)
+        self._build_exact_length_groups(
+            Xs, ys, exact_fallback, detectors, warm_params
+        )
 
         for n_pad, idxs in groups.items():
             m = len(idxs)
@@ -434,7 +494,14 @@ class FleetDiffBuilder:
                 y[j, :L] = Xs[i] if ys is None else np.asarray(
                     ys[i], np.float32
                 )
-            for i, det in zip(idxs, self._build_group(X, y, lens=lens)):
+            warm_g = (
+                None
+                if warm_params is None
+                else [warm_params[i] for i in idxs]
+            )
+            for i, det in zip(
+                idxs, self._build_group(X, y, lens=lens, warm=warm_g)
+            ):
                 # distinguishes genuinely pad-built artifacts from the
                 # exact-fallback ones above (fleet_build stamps metadata
                 # from this marker, not from the request flag)
@@ -443,10 +510,15 @@ class FleetDiffBuilder:
         return detectors  # type: ignore[return-value]
 
     def _build_group(
-        self, X: np.ndarray, y: np.ndarray, lens: Optional[np.ndarray] = None
+        self,
+        X: np.ndarray,
+        y: np.ndarray,
+        lens: Optional[np.ndarray] = None,
+        warm: Optional[Sequence[Any]] = None,
     ) -> List[DiffBasedAnomalyDetector]:
         """One length-homogeneous group as a single exact device program
-        (``lens`` given: the masked pad-up program instead)."""
+        (``lens`` given: the masked pad-up program instead; ``warm`` given:
+        the warm program resuming from the stacked previous params)."""
         spec = self.spec
         est_proto = spec.estimator_proto
         offset = int(est_proto.offset)
@@ -499,6 +571,9 @@ class FleetDiffBuilder:
             window_mode, lookback = "none", 1
 
         seeds = np.full((m_pad,), spec.seed, dtype=np.uint32)
+        params0 = (
+            _stack_warm_params(warm, m_pad) if warm is not None else None
+        )
         if lens is None:
             program = _exact_fleet_program(
                 module,
@@ -510,8 +585,11 @@ class FleetDiffBuilder:
                 spec.train_cfg,
                 folds,
                 self.mesh,
+                warm=params0 is not None,
             )
-            out = program(jnp.asarray(X), jnp.asarray(y), jnp.asarray(seeds))
+            args = (jnp.asarray(X), jnp.asarray(y), jnp.asarray(seeds))
+            out = program(*args, params0) if params0 is not None \
+                else program(*args)
         else:
             program = _padded_fleet_program(
                 module,
@@ -523,11 +601,14 @@ class FleetDiffBuilder:
                 spec.train_cfg,
                 folds,
                 self.mesh,
+                warm=params0 is not None,
             )
-            out = program(
+            args = (
                 jnp.asarray(X), jnp.asarray(y), jnp.asarray(lens),
                 jnp.asarray(seeds),
             )
+            out = program(*args, params0) if params0 is not None \
+                else program(*args)
         out = to_host(out)
         fleet_seconds = time.time() - t0
 
@@ -626,9 +707,10 @@ def _exact_fleet_program(
     cfg: TrainConfig,
     folds: Tuple[Tuple[Tuple[int, ...], Tuple[int, ...]], ...],
     mesh,
+    warm: bool = False,
 ):
     """Return the jitted exact program ``(X, y, seeds) -> out`` for one
-    length-group.
+    length-group (``warm=True``: ``(X, y, seeds, params0) -> out``).
 
     Single-machine parity by construction: each CV fold (and the final fit)
     materializes exactly the rows ``train.cv.cross_validate`` would hand the
@@ -636,6 +718,14 @@ def _exact_fleet_program(
     pad to the fold's OWN ``steps x bs`` geometry, fit with the same derived
     RNG keys.  No weight-mask approximations; the only difference from M
     separate single fits is the vmap over machines.
+
+    The warm variant is the incremental-refresh entry point: ``params0``
+    arrives as a TRACED stacked pytree (the previous generation's weights,
+    leading axis = padded machine count) instead of being derived from the
+    init keys, so every fold and the final fit resume from the served
+    model.  Machine-count/length geometry still keys the compile cache the
+    same way — warm and cold programs cache independently (``warm`` is part
+    of the key) but share XLA lowerings across refresh cycles.
     """
     # Fold indices are digested (they can be tens of thousands of ints —
     # storing them verbatim in every cache key would bloat the cache and
@@ -651,6 +741,7 @@ def _exact_fleet_program(
         cfg,
         folds_digest,
         mesh,
+        bool(warm),
     )
 
     from gordo_tpu.ops import metrics as jmetrics
@@ -689,7 +780,7 @@ def _exact_fleet_program(
 
     vapply = jax.vmap(lambda p, x: module.apply({"params": p}, x))
 
-    def program(X, y, seeds):
+    def body(X, y, seeds, warm_params0):
         # X: (M, N, F) raw rows, y: (M, N, Fout) raw targets, seeds: (M,)
         init_keys, fit_keys = fleet_mod.fleet_keys(seeds)
 
@@ -702,7 +793,12 @@ def _exact_fleet_program(
         # Final fit's scaler chain + windows (also provides the init shape).
         full_stats, Xt_full = scale_chain(X)
         inputs_full, targets_full = windowize(Xt_full, y)
-        params0 = fleet_mod.fleet_init(module, init_keys, inputs_full[0, :1])
+        if warm_params0 is None:
+            params0 = fleet_mod.fleet_init(
+                module, init_keys, inputs_full[0, :1]
+            )
+        else:
+            params0 = warm_params0
 
         per_step_stats: List[List[Any]] = [[] for _ in scaler_opts]
         feat_maxes, total_maxes = [], []
@@ -773,11 +869,20 @@ def _exact_fleet_program(
             out = jax.lax.with_sharding_constraint(out, model_sharding(mesh))
         return out
 
+    if warm:
+        def program(X, y, seeds, params0):
+            return body(X, y, seeds, params0)
+        name = "fleet.exact_warm"
+    else:
+        def program(X, y, seeds):
+            return body(X, y, seeds, None)
+        name = "fleet.exact"
+
     # closure construction above is cheap; on a cache hit the factory is
     # never called and the PREVIOUSLY jitted closure (whose trace/compile
     # caches are warm) is returned
     return compile_plane.cached_closure(
-        key, lambda: compile_plane.jit(program, name="fleet.exact")
+        key, lambda: compile_plane.jit(program, name=name)
     )
 
 
@@ -791,9 +896,11 @@ def _padded_fleet_program(
     cfg: TrainConfig,
     folds: Tuple[Tuple[Tuple[int, ...], Tuple[int, ...]], ...],
     mesh,
+    warm: bool = False,
 ):
     """The pad-up program ``(X, y, lens, seeds) -> out`` — ragged fleets
-    without data loss.
+    without data loss (``warm=True`` appends a traced ``params0`` stacked
+    pytree, exactly as in :func:`_exact_fleet_program`).
 
     ``X``/``y`` arrive NaN-padded past each machine's true row count
     (``lens``).  Row padding is handled by masking, never by dropping:
@@ -829,6 +936,7 @@ def _padded_fleet_program(
         cfg,
         folds_digest,
         mesh,
+        bool(warm),
     )
 
     from gordo_tpu.ops.metrics import WEIGHTED_METRICS
@@ -870,7 +978,7 @@ def _padded_fleet_program(
     vapply = jax.vmap(lambda p, x: module.apply({"params": p}, x))
     masked_smoothed_max = _masked_smoothed_max
 
-    def program(X, y, lens, seeds):
+    def body(X, y, lens, seeds, warm_params0):
         # X: (M, N, F) NaN-padded, y: (M, N, Fout) NaN-padded, lens: (M,)
         init_keys, fit_keys = fleet_mod.fleet_keys(seeds)
         n = X.shape[1]
@@ -890,7 +998,12 @@ def _padded_fleet_program(
         )
         inputs_full, targets_full = windowize(Xt_full, yz)
         wv_full = valid[:, offset:] if offset else valid
-        params0 = fleet_mod.fleet_init(module, init_keys, inputs_full[0, :1])
+        if warm_params0 is None:
+            params0 = fleet_mod.fleet_init(
+                module, init_keys, inputs_full[0, :1]
+            )
+        else:
+            params0 = warm_params0
 
         per_step_stats: List[List[Any]] = [[] for _ in scaler_opts]
         feat_maxes, feat_has = [], []
@@ -977,6 +1090,15 @@ def _padded_fleet_program(
             out = jax.lax.with_sharding_constraint(out, model_sharding(mesh))
         return out
 
+    if warm:
+        def program(X, y, lens, seeds, params0):
+            return body(X, y, lens, seeds, params0)
+        name = "fleet.padded_warm"
+    else:
+        def program(X, y, lens, seeds):
+            return body(X, y, lens, seeds, None)
+        name = "fleet.padded"
+
     return compile_plane.cached_closure(
-        key, lambda: compile_plane.jit(program, name="fleet.padded")
+        key, lambda: compile_plane.jit(program, name=name)
     )

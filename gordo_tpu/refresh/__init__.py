@@ -1,0 +1,30 @@
+"""Drift-driven incremental refresh: the consumer that turns full-fleet
+batch rebuilds into targeted, O(drifted) warm-start refreshes.
+
+The loop closes the continuous cycle the serving and builder planes
+already expose ends of: scoring feeds fleet-health sketches, drift
+selects machines, the builder warm-starts exactly those from the
+previous generation's params, ``delta_write`` flips the generation, and
+live servers delta-reload the touched packs — no restart anywhere.
+
+Boundary contract (enforced by ``scripts/lint.py``): this plane talks to
+serving ONLY over its file and HTTP interfaces — fleet-health rollup
+files / watchman ``/fleet-health``, and the client's generation
+handshake.  Never server internals.
+"""
+
+from gordo_tpu.refresh.loop import (  # noqa: F401
+    DriftSelector,
+    RefreshConfig,
+    read_health,
+    refresh_once,
+    run_refresh,
+)
+
+__all__ = [
+    "DriftSelector",
+    "RefreshConfig",
+    "read_health",
+    "refresh_once",
+    "run_refresh",
+]

@@ -43,6 +43,7 @@ from gordo_tpu.telemetry.fleet_health import (  # noqa: F401
     load_rollups,
     merge_health_docs,
     normalize_health_doc,
+    read_rollups,
     sketch_from_scores,
     write_rollup,
 )
@@ -85,6 +86,7 @@ __all__ = [
     "merge_snapshots",
     "new_trace_id",
     "normalize_health_doc",
+    "read_rollups",
     "render",
     "render_snapshot",
     "set_enabled",

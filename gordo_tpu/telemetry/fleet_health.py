@@ -781,3 +781,23 @@ def load_rollups(directory: str) -> List[Dict[str, Any]]:
         if latest is not None:
             docs.append(latest)
     return docs
+
+
+def read_rollups(
+    directory: str,
+    top: Optional[int] = None,
+    threshold: Optional[float] = None,
+) -> Optional[Dict[str, Any]]:
+    """ONE merged fleet-health doc from the shard-keyed rollup JSONL
+    files under ``directory`` (an artifact dir, or its
+    ``.gordo-fleet-health/`` directly), or None when no rollups exist.
+
+    The shared file-interface reader: the refresh loop, ``gordo
+    fleet-health --dir``, and tests all consume rollups through this —
+    none of them needs private knowledge of the file layout, the
+    torn-tail skip, or the shard merge algebra
+    (:func:`load_rollups` + :func:`merge_health_docs`)."""
+    docs = load_rollups(directory)
+    if not docs:
+        return None
+    return merge_health_docs(docs, top=top, threshold=threshold)

@@ -404,6 +404,100 @@ def _builder_job(
     }
 
 
+def _validate_cron_schedule(schedule: str) -> str:
+    """Reject obviously-malformed CronJob schedules at manifest
+    GENERATION (the same fail-early posture as ``_serve_dtype_env``):
+    kubernetes cron is five whitespace-separated fields."""
+    fields = str(schedule).split()
+    if len(fields) != 5:
+        raise ValueError(
+            f"--refresh-cron schedule {schedule!r} is not a 5-field cron "
+            f"expression (minute hour day-of-month month day-of-week), "
+            f"got {len(fields)} field(s)"
+        )
+    allowed = set("0123456789*/,-")
+    for field in fields:
+        if not field or not set(field) <= allowed:
+            raise ValueError(
+                f"--refresh-cron schedule {schedule!r}: field {field!r} "
+                f"contains characters outside [0-9*/,-]"
+            )
+    return " ".join(fields)
+
+
+def _refresh_cronjob(
+    project: str,
+    image: str,
+    schedule: str,
+    builder_job: Dict[str, Any],
+) -> Dict:
+    """A ``batch/v1`` CronJob running ``gordo refresh --once`` on
+    ``schedule`` — the drift-driven incremental rebuild face of the
+    builder (docs/operations.md "Incremental refresh").
+
+    The pod template mirrors the builder Job's volumes and env (models
+    PVC, project-config ConfigMap, shared compile cache, GORDO_* wiring)
+    so the refresh cycle sees exactly the artifacts and config the full
+    build produced — refused when the builder template carries no models
+    volume, because a refresh with nowhere to read the previous
+    generation from (or publish the next one to) can only rebuild cold
+    into the void."""
+    import copy
+
+    schedule = _validate_cron_schedule(schedule)
+    builder_spec = builder_job["spec"]["template"]["spec"]
+    volume_names = {v.get("name") for v in builder_spec.get("volumes", [])}
+    if "models" not in volume_names:
+        raise ValueError(
+            "--refresh-cron requires the builder template to mount a "
+            "'models' volume (the artifact dir the refresh warm-starts "
+            "from and publishes to); this builder configuration has "
+            f"volumes {sorted(volume_names)}"
+        )
+    pod_spec = copy.deepcopy(builder_spec)
+    container = pod_spec["containers"][0]
+    container["name"] = "model-refresh"
+    container["command"] = ["gordo", "refresh"]
+    container["args"] = [
+        "--machine-config", "/config/project.yaml",
+        "--output-dir", "/models",
+        "--model-register-dir", "/models/.register",
+        "--once",
+    ]
+    # health comes off the rollup files under /models (no HTTP from the
+    # cron pod); selection knobs documented where operators tune them
+    container.setdefault("env", []).extend([
+        {"name": "GORDO_REFRESH_HYSTERESIS", "value": "2"},
+        {"name": "GORDO_REFRESH_COOLDOWN_SECONDS", "value": "900"},
+    ])
+    return {
+        "apiVersion": "batch/v1",
+        "kind": "CronJob",
+        "metadata": {
+            "name": f"gordo-refresh-{project}",
+            "labels": _labels(project, "model-refresh"),
+        },
+        "spec": {
+            "schedule": schedule,
+            # a slow warm rebuild must not pile up concurrent cycles
+            # racing the artifact index; the selector state file makes
+            # skipped runs harmless (streaks persist)
+            "concurrencyPolicy": "Forbid",
+            "jobTemplate": {
+                "spec": {
+                    "backoffLimit": 2,  # idempotent: delta publish retries
+                    "template": {
+                        "metadata": {
+                            "labels": _labels(project, "model-refresh")
+                        },
+                        "spec": pod_spec,
+                    },
+                },
+            },
+        },
+    }
+
+
 def _server_deployment(
     project: str,
     image: str,
@@ -671,6 +765,7 @@ def generate_workflow(
     serve_dtype: Optional[str] = None,
     serve_shards: Optional[int] = None,
     hpa_max_replicas: int = 4,
+    refresh_cron: Optional[str] = None,
 ) -> List[Dict[str, Any]]:
     """Project config → list of k8s manifest dicts (+ the build plan as a
     ConfigMap so the cluster state carries the bucketing decision).
@@ -706,6 +801,13 @@ def generate_workflow(
     "Sharded serving tier"), and the watchman polling every shard
     service.  Refused when N exceeds the machine count, mirroring the
     ``--multihost`` rule: machines are the atoms of the partition.
+
+    ``refresh_cron`` (a 5-field cron schedule): additionally emit a
+    CronJob running ``gordo refresh --once`` against the same models
+    PVC and project config as the builder — the drift-driven
+    incremental rebuild loop (docs/operations.md "Incremental
+    refresh").  Refused when the builder template has no models volume
+    to warm-start from, or when the schedule is malformed.
     """
     project = config.project_name
     machines = [m.name for m in config.machines]
@@ -750,6 +852,16 @@ def generate_workflow(
                 project, image, tpu_resources, serve_dtype=serve_dtype
             )
         ]
+    if refresh_cron is not None:
+        # mirror the single-pod builder template even under --multihost:
+        # the refresh subset is small by construction, so one process is
+        # the right shape regardless of how the FULL build fans out
+        template = _builder_job(
+            project, image, tpu_resources, serve_dtype=serve_dtype
+        )
+        builder_docs.append(
+            _refresh_cronjob(project, image, refresh_cron, template)
+        )
     sharded = serve_shards is not None and serve_shards > 1
     if sharded:
         from gordo_tpu.serve.shard import ShardSpec, shard_map

@@ -135,6 +135,18 @@ FAULTS_FORBIDDEN_SCOPES = {
     "coalesce.py": {"_run", "_drain"},
 }
 
+#: refresh-plane boundary contract: gordo_tpu/refresh/ talks to serving
+#: ONLY over its file and HTTP interfaces (fleet-health rollup files /
+#: the /fleet-health endpoint, the client's generation handshake) —
+#: importing server or watchman internals would couple the rebuild loop
+#: to in-process scorer state and quietly break the "any health surface,
+#: any server" deployment shape.
+REFRESH_DIR = os.path.join("gordo_tpu", "refresh")
+REFRESH_FORBIDDEN_IMPORT_PREFIXES = (
+    "gordo_tpu.serve",
+    "gordo_tpu.watchman",
+)
+
 
 def _jit_allowed(path: str) -> bool:
     norm = os.path.normpath(path)
@@ -165,6 +177,50 @@ def _jit_findings(path: str, tree: ast.AST, noqa_lines: set) -> List[Finding]:
                  "bare jax.jit outside gordo_tpu/compile/ — register the "
                  "program with the compile plane (compile.program for the "
                  "AOT serving path, compile.jit as a passthrough)")
+            )
+    return findings
+
+
+def _refresh_import_findings(
+    path: str, tree: ast.AST, noqa_lines: set
+) -> List[Finding]:
+    """Flag server/watchman-internal imports inside gordo_tpu/refresh/:
+    the refresh loop's plane boundary is files and HTTP only (rollup
+    files, /fleet-health, the client generation handshake)."""
+    norm = os.path.normpath(path)
+    if REFRESH_DIR not in norm:
+        return []
+    findings: List[Finding] = []
+
+    def _bad(module: str) -> bool:
+        return any(
+            module == p or module.startswith(p + ".")
+            for p in REFRESH_FORBIDDEN_IMPORT_PREFIXES
+        )
+
+    for node in ast.walk(tree):
+        bad = None
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if _bad(alias.name):
+                    bad = alias.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            if _bad(node.module):
+                bad = node.module
+            elif node.module == "gordo_tpu":
+                hits = [
+                    a.name for a in node.names
+                    if a.name in ("serve", "watchman")
+                ]
+                if hits:
+                    bad = f"gordo_tpu.{hits[0]}"
+        if bad and getattr(node, "lineno", 0) not in noqa_lines:
+            findings.append(
+                (path, node.lineno,
+                 f"import of {bad} inside gordo_tpu/refresh/ — the "
+                 "refresh plane talks to serving ONLY over its file and "
+                 "HTTP interfaces (telemetry.read_rollups, /fleet-health, "
+                 "client.wait_for_generation), never server internals")
             )
     return findings
 
@@ -554,6 +610,7 @@ def lint_file(path: str) -> List[Finding]:
     findings.extend(_jit_findings(path, tree, noqa_lines))
     findings.extend(_artifact_path_findings(path, tree, noqa_lines))
     findings.extend(_artifacts_pack_findings(path, tree, noqa_lines))
+    findings.extend(_refresh_import_findings(path, tree, noqa_lines))
 
     for node in ast.walk(tree):
         if isinstance(node, ast.Call):

@@ -345,3 +345,71 @@ class TestSeededSchedules:
         for name, model in written.items():
             loaded = store.load_model(name)
             assert np.array_equal(loaded["w"], model["w"])
+
+
+class TestRefreshChaos:
+    """ISSUE 13 chaos case: an artifact-write fault injected mid-refresh
+    must leave the store untorn — every indexed machine healthy XOR
+    quarantined, the live generation untouched (servers keep serving the
+    previous artifacts) — and the NEXT cycle, faults cleared, completes
+    the rebuild and flips the generation."""
+
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_write_fault_mid_refresh_keeps_store_untorn(
+        self, chaos_model_dir, tmp_path, seed
+    ):
+        from gordo_tpu.refresh import RefreshConfig, refresh_once
+        from gordo_tpu.telemetry import fleet_health as fh
+        from gordo_tpu.workflow import NormalizedConfig
+        from tests.chaos.conftest import PROJECT
+
+        work = str(tmp_path / "models")
+        shutil.copytree(chaos_model_dir, work)
+        machines = NormalizedConfig(PROJECT, PROJECT_NAME).machines
+        gen0 = artifacts.read_generation(work)
+        assert gen0 >= 1
+
+        # a rollup doc with real sketches: chaos-a drifting, chaos-b ok
+        rng = np.random.default_rng(seed)
+        base = fh.sketch_from_scores(
+            rng.lognormal(0, 1, 4000), ts=0.0
+        ).to_doc()
+        fh.write_rollup(work, {
+            "gordo-fleet-health": 1,
+            "machines": {
+                "chaos-a": {"baseline": base, "live": fh.sketch_from_scores(
+                    rng.lognormal(3, 1, 2000), ts=0.0).to_doc()},
+                "chaos-b": {"baseline": base, "live": fh.sketch_from_scores(
+                    rng.lognormal(0, 1, 2000), ts=0.0).to_doc()},
+            },
+        })
+        cfg = RefreshConfig(
+            machines=machines, output_dir=work,
+            hysteresis=1, cooldown_seconds=0,
+        )
+
+        # cycle 1: every artifact write fails mid-refresh
+        with faults.injected(f"seed={seed};artifact.write=enospc:1.0"):
+            broken = refresh_once(cfg)
+        assert broken["outcome"] == "failed"
+        assert "chaos-a" in broken["failed"]
+
+        # the store never tore: generation untouched, every indexed
+        # machine healthy XOR quarantined, survivors loadable
+        assert artifacts.read_generation(work) == gen0
+        store = artifacts.open_store(work, quarantine=True)
+        healthy = set(store.names())
+        quarantined = set(store.quarantined_machines)
+        assert healthy | quarantined == {"chaos-a", "chaos-b"}
+        assert not healthy & quarantined
+        for name in healthy:
+            assert store.load_model(name) is not None
+
+        # cycle 2, faults cleared: the drifted machine rebuilds and the
+        # generation flips — the failed cycle cost nothing but time
+        recovered = refresh_once(cfg)
+        assert recovered["outcome"] == "rebuilt", recovered
+        assert recovered["rebuilt"] == ["chaos-a"]
+        assert artifacts.read_generation(work) == gen0 + 1
+        store = artifacts.open_store(work)
+        assert sorted(store.names()) == ["chaos-a", "chaos-b"]

@@ -14,12 +14,17 @@ def test_default_runs_every_stage_in_priority_order():
     assert bench.parse_stages([]) == [
         "build", "build_pipeline", "artifact_io", "hot_reload", "serving",
         "serving_precision", "serving_sharded", "serving_openloop",
-        "telemetry_overhead", "health_overhead", "cold_start", "lstm",
+        "telemetry_overhead", "health_overhead", "cold_start", "refresh",
+        "lstm",
     ]
 
 
 def test_cold_start_stage_selectable():
     assert bench.parse_stages(["--stage", "cold_start"]) == ["cold_start"]
+
+
+def test_refresh_stage_selectable():
+    assert bench.parse_stages(["--stage", "refresh"]) == ["refresh"]
 
 
 def test_artifact_io_stage_selectable():
