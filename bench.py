@@ -2201,6 +2201,374 @@ def bench_refresh(out: dict) -> None:
             shutil.rmtree(s, ignore_errors=True)
 
 
+# ---------------------------------------------------------------------------
+# backfill bench
+# ---------------------------------------------------------------------------
+
+def _backfill_fleet_dir(model, metadata, names: "list[str]") -> str:
+    """A v2 pack dir replicating one built machine across ``names`` in
+    512-machine packs (the artifact-plane layout the 10k time-to-ready
+    bench uses)."""
+    from gordo_tpu import artifacts
+
+    art_dir = tempfile.mkdtemp(prefix="gordo-bench-backfill-")
+    for start in range(0, len(names), 512):
+        part = names[start: start + 512]
+        metas = []
+        for name in part:
+            md = dict(metadata)
+            md["name"] = name
+            metas.append(md)
+        artifacts.write_pack(art_dir, part, [model] * len(part), metas)
+    return art_dir
+
+
+def bench_backfill(out: dict) -> None:
+    """ISSUE 14 acceptance: the backfill plane's archive path vs the only
+    alternative the reference had — replaying history through the HTTP
+    serving tier.
+
+    Protocol (docs/perf.md "Backfill"):
+
+    - one trained machine replicated across N names (512 and 10k), v2
+      packs, identical tag lists — so the provider cost collapses to one
+      fetch on BOTH paths and the comparison is codec/transport, not
+      data generation;
+    - archive path: a warmup ``run_backfill`` over one preceding chunk
+      (stacked-program compiles land in the in-process jit registry),
+      then a measured run over the full range.  The reported rate is the
+      summary's END-TO-END number — artifact loads, provider fetch,
+      chunk slicing, dispatch, assemble, mmap write and fsync all
+      inside the clock;
+    - HTTP comparators against a REAL ``run-server`` subprocess over
+      the same artifact dir, same windows, production bulk msgpack
+      wire, bodies sized by the client's own ``bulk_rows_budget`` (the
+      payload contract any replay client must respect).  Two numbers,
+      reported separately:
+
+      * ``http_wire``: raw bulk posts with responses decoded and
+        DISCARDED, a few in flight so the server never starves — the
+        transport-only saturation floor no real replay can beat;
+      * ``http_replay``: the actual ``Client`` (``use_bulk=True``)
+        replaying the range and materializing per-machine score frames
+        — the pre-backfill way to score history over HTTP (forwarding/
+        persistence left OFF, which favors HTTP: the archive's clock
+        includes writing scores to disk).
+
+      Server startup, model loading, and warmup rounds are excluded
+      from the HTTP clocks (the archive number includes its own);
+    - attestation: device transfers per chunk from the run summary
+      (one stacked host->device staging per bucket program per chunk;
+      the replicated fleet is structurally ONE bucket, so the gate is
+      exactly 1.0);
+    - gate: archive-path samples/s >= 3x the ``Client`` HTTP replay at
+      512 machines on CPU.  The wire floor is recorded alongside so
+      the transport-vs-materialization split stays visible.
+
+    Honesty note: with one visible core the replay's client-side codec
+    timeshares with the server (in production the client is another
+    host), but the dominant replay costs — server unpackb/packb, the
+    budget-bounded body sizes, per-request round trips — are inherent
+    to the HTTP plane; ``cpu_cores`` is recorded alongside.
+    """
+    import asyncio
+    import socket
+    import urllib.request
+
+    import aiohttp
+    import pandas as pd
+
+    from gordo_tpu.batch import BackfillConfig, chunk_windows, run_backfill
+    from gordo_tpu.client.io import bulk_rows_budget
+    from gordo_tpu.dataset import dataset_from_metadata
+    from gordo_tpu.serve import codec
+
+    n_small = int(os.environ.get("BENCH_BACKFILL_MACHINES", "512"))
+    small_rows = int(os.environ.get("BENCH_BACKFILL_CHUNK_ROWS", "2048"))
+    small_chunks = int(os.environ.get("BENCH_BACKFILL_CHUNKS", "8"))
+    n_large = int(os.environ.get("BENCH_BACKFILL_LARGE_MACHINES", "10000"))
+    large_rows = int(os.environ.get("BENCH_BACKFILL_LARGE_CHUNK_ROWS", "256"))
+    large_chunks = int(os.environ.get("BENCH_BACKFILL_LARGE_CHUNKS", "2"))
+    concurrency = int(os.environ.get("BENCH_BACKFILL_HTTP_CONCURRENCY", "3"))
+    out["cpu_cores"] = os.cpu_count()
+
+    model, metadata = _build_serving_model()
+    resolution = (metadata.get("dataset") or {}).get("resolution", "10min")
+    step = pd.tseries.frequencies.to_offset(resolution)
+
+    procs: "list[subprocess.Popen]" = []
+    logs: "list[str]" = []
+
+    def free_port() -> int:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    def spawn(port: int, art_dir: str) -> subprocess.Popen:
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.pop("GORDO_SERVE_SHARD", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        log_path = os.path.join(art_dir, f"server-{port}.log")
+        logs.append(log_path)
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "gordo_tpu.cli.cli", "run-server",
+                "--model-dir", art_dir, "--project", "bench",
+                "--host", "127.0.0.1", "--port", str(port),
+                "--rescan-interval", "0",
+            ],
+            env=env,
+            stdout=open(log_path, "w"), stderr=subprocess.STDOUT,
+        )
+        procs.append(proc)
+        return proc
+
+    def wait_ready(port: int, timeout_s: float) -> None:
+        deadline = time.monotonic() + timeout_s
+        url = f"http://127.0.0.1:{port}/healthz"
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(url, timeout=2) as resp:
+                    if resp.status == 200:
+                        return
+            except Exception:
+                time.sleep(0.25)
+        raise RuntimeError(f"backfill server on :{port} never became ready")
+
+    def stop(to_stop: "list[subprocess.Popen]") -> None:
+        for proc in to_stop:
+            proc.terminate()
+        for proc in to_stop:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    headers = {
+        "Content-Type": codec.MSGPACK_CONTENT_TYPE,
+        "Accept": codec.MSGPACK_CONTENT_TYPE,
+    }
+
+    def archive_run(art_dir: str, start, end, rows: int) -> dict:
+        """Warmup run over the chunk preceding ``start`` (same stacked
+        geometry -> compiles land), then the measured end-to-end run."""
+        warm_dir = tempfile.mkdtemp(prefix="gordo-bench-bf-warm-")
+        meas_dir = tempfile.mkdtemp(prefix="gordo-bench-bf-arch-")
+        try:
+            run_backfill(BackfillConfig(
+                model_dir=art_dir, start=str(start - step * rows),
+                end=str(start), archive_dir=warm_dir, project="bench",
+                chunk_rows=rows,
+            ))
+            return run_backfill(BackfillConfig(
+                model_dir=art_dir, start=str(start), end=str(end),
+                archive_dir=meas_dir, project="bench", chunk_rows=rows,
+            ))
+        finally:
+            shutil.rmtree(warm_dir, ignore_errors=True)
+            shutil.rmtree(meas_dir, ignore_errors=True)
+
+    def http_wire_floor(
+        port: int, names: "list[str]", start, end, rows: int
+    ) -> dict:
+        """The same windows through a real server's bulk msgpack route,
+        bodies sized by the client's samples budget, responses decoded
+        and DISCARDED — the transport-only floor no real replay client
+        can beat (a replay has to materialize and keep its scores)."""
+        dataset = dataset_from_metadata(
+            metadata["dataset"], str(start), str(end)
+        )
+        X, _ = dataset.get_data()
+        budget_rows = bulk_rows_budget(len(names) * X.shape[1], rows)
+        slabs: "list[np.ndarray]" = []
+        for t0, t1 in chunk_windows(start, end, resolution, rows):
+            lo, hi = X.index.searchsorted(t0), X.index.searchsorted(t1)
+            arr = X.iloc[lo:hi].to_numpy(np.float32)
+            for r0 in range(0, len(arr), budget_rows):
+                if len(arr[r0: r0 + budget_rows]):
+                    slabs.append(arr[r0: r0 + budget_rows])
+
+        url = (
+            f"http://127.0.0.1:{port}"
+            "/gordo/v0/bench/_bulk/anomaly/prediction"
+        )
+
+        async def drive() -> "tuple[int, float]":
+            samples = 0
+            timeout = aiohttp.ClientTimeout(total=900)
+            sem = asyncio.Semaphore(concurrency)
+            async with aiohttp.ClientSession(timeout=timeout) as session:
+
+                async def post(slab: np.ndarray, measured: bool) -> None:
+                    nonlocal samples
+                    # packb under the semaphore: at most ``concurrency``
+                    # bodies alive, encode overlapped with server work
+                    async with sem:
+                        body = codec.packb({"X": {n: slab for n in names}})
+                        async with session.post(
+                            url, data=body, headers=headers
+                        ) as resp:
+                            raw = await resp.read()
+                    if resp.status != 200:
+                        raise RuntimeError(
+                            f"bulk replay -> {resp.status}: {raw[:160]!r}"
+                        )
+                    data = codec.unpackb(raw)["data"]
+                    if measured:
+                        for res in data.values():
+                            samples += int(
+                                np.asarray(res["tag-anomaly-scores"]).size
+                            )
+
+                # warmup: head + tail slab shapes land the server compiles
+                await asyncio.gather(
+                    post(slabs[0], False), post(slabs[-1], False)
+                )
+                t0 = time.perf_counter()
+                await asyncio.gather(*(post(s, True) for s in slabs))
+                return samples, time.perf_counter() - t0
+
+        samples, dt = asyncio.run(drive())
+        return {
+            "samples": samples,
+            "seconds": dt,
+            "samples_per_sec": samples / dt if dt > 0 else 0.0,
+            "rows_per_request": budget_rows,
+            "n_requests": len(slabs),
+        }
+
+    def client_replay(port: int, start, end, rows: int) -> dict:
+        """THE pre-backfill alternative: the real ``Client`` replaying the
+        range over the bulk msgpack wire and materializing per-machine
+        score frames — what scoring history over HTTP actually costs.
+        Prediction forwarding/persistence is left OFF (favors HTTP: the
+        archive path's clock includes writing its scores to disk)."""
+        from gordo_tpu.client import Client
+
+        client = Client(
+            "bench", port=port, use_bulk=True, batch_size=rows,
+        )
+        t0 = time.perf_counter()
+        results = client.predict(str(start), str(end))
+        dt = time.perf_counter() - t0
+        samples = 0
+        for res in results:
+            if not res.ok:
+                raise RuntimeError(
+                    f"client replay failed for {res.name}: "
+                    f"{res.error_messages}"
+                )
+            frame = res.predictions
+            n_tag_cols = sum(
+                1 for c in frame.columns if c[0] == "tag-anomaly-scores"
+            )
+            samples += len(frame) * n_tag_cols
+        return {
+            "samples": samples,
+            "seconds": dt,
+            "samples_per_sec": samples / dt if dt > 0 else 0.0,
+            "machines": len(results),
+        }
+
+    def scenario(
+        n: int, rows: int, chunks: int, ready_timeout_s: float,
+        with_client_replay: bool,
+    ) -> "float | None":
+        names = [f"bf-{i:05d}" for i in range(n)]
+        art_dir = _backfill_fleet_dir(model, metadata, names)
+        server = None
+        try:
+            start = pd.Timestamp("2024-01-01T00:00:00Z")
+            end = start + step * (rows * chunks)
+            summary = archive_run(art_dir, start, end, rows)
+            key = f"backfill_{n}"
+            archive_sps = summary["samples-per-second"]
+            out[f"{key}_samples_per_sec"] = round(archive_sps)
+            out[f"{key}_samples"] = summary["samples"]
+            out[f"{key}_seconds"] = summary["seconds"]
+            out[f"{key}_chunks"] = summary["chunks-ok"]
+            out[f"{key}_chunk_rows"] = rows
+            per_chunk = (
+                summary["device-transfers"] / max(1, summary["chunks-ok"])
+            )
+            out[f"{key}_device_transfers_per_chunk"] = round(per_chunk, 3)
+            out[f"{key}_one_transfer_per_chunk_ok"] = per_chunk == 1.0
+            log(f"backfill archive @{n}: {archive_sps:,.0f} samples/s "
+                f"({summary['samples']:,} samples / {summary['seconds']}s, "
+                f"{per_chunk:.1f} transfers/chunk)")
+
+            port = free_port()
+            server = spawn(port, art_dir)
+            wait_ready(port, ready_timeout_s)
+
+            wire = http_wire_floor(port, names, start, end, rows)
+            out[f"{key}_http_wire_samples_per_sec"] = round(
+                wire["samples_per_sec"]
+            )
+            out[f"{key}_http_rows_per_request"] = wire["rows_per_request"]
+            out[f"{key}_http_requests"] = wire["n_requests"]
+            out[f"{key}_vs_http_wire_speedup"] = round(
+                archive_sps / wire["samples_per_sec"], 3
+            )
+            log(f"backfill http wire floor @{n}: "
+                f"{wire['samples_per_sec']:,.0f} samples/s "
+                f"({wire['n_requests']} requests of "
+                f"{wire['rows_per_request']} rows) -> archive "
+                f"{archive_sps / wire['samples_per_sec']:.2f}x")
+
+            if not with_client_replay:
+                return None
+            replay = client_replay(port, start, end, rows)
+            out[f"{key}_http_replay_samples_per_sec"] = round(
+                replay["samples_per_sec"]
+            )
+            out[f"{key}_http_replay_samples"] = replay["samples"]
+            out[f"{key}_http_replay_seconds"] = round(replay["seconds"], 3)
+            speedup = archive_sps / replay["samples_per_sec"]
+            out[f"{key}_vs_http_replay_speedup"] = round(speedup, 3)
+            log(f"backfill client replay @{n}: "
+                f"{replay['samples_per_sec']:,.0f} samples/s "
+                f"({replay['samples']:,} samples / {replay['seconds']:.1f}s)"
+                f" -> archive {speedup:.2f}x")
+            return speedup
+        finally:
+            if server is not None:
+                stop([server])
+            shutil.rmtree(art_dir, ignore_errors=True)
+
+    try:
+        speedup = scenario(
+            n_small, small_rows, small_chunks, 180.0,
+            with_client_replay=True,
+        )
+        # the acceptance gate: archive path >= 3x replaying the same
+        # range through the HTTP tier at 512 machines on CPU
+        out["backfill_ge_3x_http_ok"] = speedup >= 3.0
+        log(f"backfill gate @{n_small}: {speedup:.2f}x >= 3x -> "
+            f"{'PASS' if speedup >= 3.0 else 'FAIL'}")
+        if n_large:
+            # client-side frame materialization at 10k machines x tiny
+            # budget bodies takes tens of minutes — the wire floor is
+            # the recorded comparator at fleet scale
+            scenario(
+                n_large, large_rows, large_chunks, 420.0,
+                with_client_replay=False,
+            )
+    except Exception:
+        for log_path in logs:
+            try:
+                with open(log_path) as fh:
+                    tail = fh.read()[-2000:]
+                if tail:
+                    log(f"--- {log_path} tail ---\n{tail}")
+            except OSError:
+                pass
+        raise
+    finally:
+        stop(procs)
+
+
 def init_devices(attempts: int = 5, backoff_s: float = 2.0):
     """Initialize the jax backend with bounded retry.
 
@@ -2324,7 +2692,7 @@ def run_stage_bounded(
 STAGES = ("build", "build_pipeline", "artifact_io", "hot_reload",
           "serving", "serving_precision", "serving_sharded",
           "serving_openloop", "telemetry_overhead", "health_overhead",
-          "cold_start", "refresh", "lstm")
+          "cold_start", "refresh", "backfill", "lstm")
 
 
 def parse_cli(argv: "list[str]") -> "tuple[list[str], int | None]":
@@ -2480,6 +2848,10 @@ def main(argv: "list[str] | None" = None) -> None:
         ),
         "refresh": (
             lambda: bench_refresh(out),
+            lambda: min(remaining() * 0.8, 900),
+        ),
+        "backfill": (
+            lambda: bench_backfill(out),
             lambda: min(remaining() * 0.8, 900),
         ),
         "lstm": (

@@ -1,0 +1,35 @@
+"""Backfill plane: offline fleet-scale historical scoring.
+
+A device-saturating bulk path over the server's exact fused programs —
+no HTTP anywhere in this package (lint-gated): models from the artifact
+plane, data from dataset providers, scores into the columnar
+``.gordo-scores/`` archive.  See ``docs/batch.md``.
+"""
+
+from gordo_tpu.batch.archive import (  # noqa: F401
+    ARCHIVE_DIR,
+    ArchiveError,
+    ArchivePlanError,
+    ScoreArchive,
+    archive_root,
+)
+from gordo_tpu.batch.runner import (  # noqa: F401
+    BackfillConfig,
+    BackfillError,
+    chunk_windows,
+    resolve_shard,
+    run_backfill,
+)
+
+__all__ = [
+    "ARCHIVE_DIR",
+    "ArchiveError",
+    "ArchivePlanError",
+    "ScoreArchive",
+    "archive_root",
+    "BackfillConfig",
+    "BackfillError",
+    "chunk_windows",
+    "resolve_shard",
+    "run_backfill",
+]

@@ -1,0 +1,461 @@
+"""Columnar per-machine score archive — the backfill plane's output.
+
+Reference status: absent upstream — the reference stack had no offline
+scoring product at all; every score it ever produced was an HTTP
+response body that evaporated with the connection.  The archive is what
+makes backfill a *workload* instead of a loop over requests: months of
+per-machine anomaly scores land as mmap-able columnar segments that
+``client.score_history`` and
+``telemetry.fleet_health.baselines_from_archive`` read without a server.
+
+Format (under ``<root>/.gordo-scores/``), borrowing the artifact plane's
+pack durability idioms (magic + version header, page alignment, tmp +
+``os.replace`` + dir fsync, one flock-serialized JSON index):
+
+- ``index.json`` — the archive plan (project, period, resolution,
+  chunking geometry, machine roster) plus one completion record per
+  written ``(chunk, shard)``.  Rewritten atomically under ``.lock``, so
+  shards of one backfill job share it safely.
+- ``chunk-<c>-s<s>.seg`` — one segment per (time-chunk, shard):
+  ``GSA1`` magic, u32 header length, a JSON header mapping machine →
+  column table, zero padding to a 4096 boundary, then the raw column
+  payloads (64-byte aligned) for every machine the shard scored in that
+  window.  Columns per machine: ``index-ns`` (int64 UTC nanoseconds of
+  each scored row), ``total-anomaly-score`` (float32 ``[rows]``) and
+  ``tag-anomaly-scores`` (float32 ``[rows, n_tags]``).
+
+Resumability contract: a chunk either has a completion record (its
+segment is fully durable — the record is written only after the segment
+fsyncs) or it does not exist.  A re-run lists the records, skips what is
+done, and recomputes the rest; the deterministic chunk plan makes the
+result byte-identical to an uninterrupted run (pinned by test).
+
+This module is host-side I/O only: no jax, no HTTP (the batch-plane
+lint gate bans server/client imports from the whole package).
+"""
+
+from __future__ import annotations
+
+import fcntl
+import json
+import os
+import struct
+import time
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from gordo_tpu.utils.disk_registry import fsync_dir
+
+#: archive directory under the output root (sits next to the artifact
+#: plane's sidecars: ``.gordo-telemetry``, ``.gordo-fleet-health``, ...)
+ARCHIVE_DIR = ".gordo-scores"
+
+INDEX_FILE = "index.json"
+LOCK_FILE = ".lock"
+
+SEGMENT_MAGIC = b"GSA1"
+SEGMENT_VERSION = 1
+ARCHIVE_VERSION = 1
+
+#: page size segments align their payload base to (mmap granularity)
+PAGE = 4096
+#: per-column alignment inside the payload (cacheline-friendly slices)
+ALIGN = 64
+
+#: the three columns every machine entry carries, in layout order
+COLUMNS = ("index-ns", "total-anomaly-score", "tag-anomaly-scores")
+
+
+class ArchiveError(RuntimeError):
+    """Corrupt or unreadable archive state."""
+
+
+class ArchivePlanError(ValueError):
+    """A resume attempted with a plan incompatible with the existing
+    archive (different period / resolution / chunk geometry): scoring
+    into it would silently mix windows, so it is refused."""
+
+
+def archive_root(root: str) -> str:
+    return os.path.join(root, ARCHIVE_DIR)
+
+
+def _segment_name(chunk: int, shard: int) -> str:
+    return f"chunk-{chunk:05d}-s{shard:02d}.seg"
+
+
+def _chunk_key(chunk: int, shard: int) -> str:
+    return f"{chunk}/{shard}"
+
+
+# ---------------------------------------------------------------------------
+# index read/modify/write (flock-serialized, like the artifact pack index)
+# ---------------------------------------------------------------------------
+
+def _read_index(directory: str) -> Optional[Dict[str, Any]]:
+    path = os.path.join(directory, INDEX_FILE)
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+    except FileNotFoundError:
+        return None
+    except (OSError, ValueError) as exc:
+        raise ArchiveError(f"unreadable score-archive index {path}: {exc}")
+    if doc.get("version") != ARCHIVE_VERSION:
+        raise ArchiveError(
+            f"score-archive index {path} has version {doc.get('version')!r};"
+            f" this reader speaks version {ARCHIVE_VERSION}"
+        )
+    return doc
+
+
+def _locked_index_update(
+    directory: str, mutate: Callable[[Dict[str, Any]], None]
+) -> Dict[str, Any]:
+    """Read-modify-write ``index.json`` under an exclusive flock, swapping
+    the new index in atomically (tmp + rename + dir fsync) — concurrent
+    shards of one backfill job write disjoint completion records into
+    ONE shared index."""
+    os.makedirs(directory, exist_ok=True)
+    with open(os.path.join(directory, LOCK_FILE), "a+") as lock:
+        fcntl.flock(lock.fileno(), fcntl.LOCK_EX)
+        doc = _read_index(directory) or {
+            "version": ARCHIVE_VERSION,
+            "machines": [],
+            "chunks": {},
+        }
+        mutate(doc)
+        path = os.path.join(directory, INDEX_FILE)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump(doc, fh, indent=1, sort_keys=True)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+        fsync_dir(directory)
+        return doc
+
+
+# ---------------------------------------------------------------------------
+# segment encode/decode
+# ---------------------------------------------------------------------------
+
+def _encode_segment(
+    chunk: int,
+    shard: int,
+    per_machine: Dict[str, Dict[str, Any]],
+) -> Tuple[bytes, Dict[str, Any]]:
+    """Serialize one chunk's machine columns: returns ``(bytes, header)``.
+
+    ``per_machine[name]`` carries the three COLUMNS arrays plus ``tags``
+    (the column names of the tag-anomaly matrix, for self-describing
+    reads)."""
+    header: Dict[str, Any] = {
+        "gordo-score-segment": SEGMENT_VERSION,
+        "chunk": int(chunk),
+        "shard": int(shard),
+        "machines": {},
+    }
+    layout: List[Tuple[int, np.ndarray]] = []
+    pos = 0
+    for name in sorted(per_machine):
+        rec = per_machine[name]
+        entry: Dict[str, Any] = {
+            "tags": list(rec.get("tags") or ()),
+            "columns": {},
+        }
+        for col in COLUMNS:
+            arr = np.ascontiguousarray(rec[col])
+            pos = (pos + ALIGN - 1) // ALIGN * ALIGN
+            entry["columns"][col] = {
+                "offset": pos,
+                "dtype": str(arr.dtype),
+                "shape": list(arr.shape),
+            }
+            layout.append((pos, arr))
+            pos += arr.nbytes
+        entry["rows"] = int(np.asarray(rec["index-ns"]).shape[0])
+        header["machines"][name] = entry
+
+    head = json.dumps(header, sort_keys=True).encode()
+    prefix = SEGMENT_MAGIC + struct.pack("<I", len(head)) + head
+    payload_base = (len(prefix) + PAGE - 1) // PAGE * PAGE
+    buf = bytearray(payload_base + pos)
+    buf[: len(prefix)] = prefix
+    for off, arr in layout:
+        raw = arr.tobytes()
+        buf[payload_base + off: payload_base + off + len(raw)] = raw
+    return bytes(buf), header
+
+
+def _read_segment_header(path: str) -> Tuple[Dict[str, Any], int]:
+    """``(header, payload_base)`` of a segment file."""
+    with open(path, "rb") as fh:
+        magic = fh.read(4)
+        if magic != SEGMENT_MAGIC:
+            raise ArchiveError(f"{path}: bad segment magic {magic!r}")
+        (hlen,) = struct.unpack("<I", fh.read(4))
+        header = json.loads(fh.read(hlen).decode())
+    if header.get("gordo-score-segment") != SEGMENT_VERSION:
+        raise ArchiveError(
+            f"{path}: segment version {header.get('gordo-score-segment')!r}"
+            f" != {SEGMENT_VERSION}"
+        )
+    payload_base = (8 + hlen + PAGE - 1) // PAGE * PAGE
+    return header, payload_base
+
+
+def _mmap_column(path: str, payload_base: int, col: Dict[str, Any]):
+    return np.memmap(
+        path,
+        dtype=np.dtype(col["dtype"]),
+        mode="r",
+        offset=payload_base + int(col["offset"]),
+        shape=tuple(col["shape"]),
+    )
+
+
+# ---------------------------------------------------------------------------
+# the archive object
+# ---------------------------------------------------------------------------
+
+class ScoreArchive:
+    """One backfill's score archive under ``<root>/.gordo-scores/``."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self.directory = archive_root(root)
+
+    # -- plan / creation -----------------------------------------------------
+
+    @classmethod
+    def create(
+        cls,
+        root: str,
+        *,
+        project: str,
+        start: str,
+        end: str,
+        resolution: str,
+        chunk_rows: int,
+        n_chunks: int,
+        dtype: str,
+        machines: Iterable[str],
+        shard: Tuple[int, int] = (0, 1),
+    ) -> "ScoreArchive":
+        """Create (or compatibly resume) the archive plan.
+
+        Idempotent under the index flock: the first caller writes the
+        plan, later callers (re-runs, sibling shards) verify theirs
+        matches and merge their machine roster in.  A mismatched plan
+        raises :class:`ArchivePlanError` — never silently mixes runs."""
+        arch = cls(root)
+        plan = {
+            "project": project,
+            "start": str(start),
+            "end": str(end),
+            "resolution": str(resolution),
+            "chunk-rows": int(chunk_rows),
+            "n-chunks": int(n_chunks),
+            "dtype": str(dtype),
+        }
+
+        def mutate(doc: Dict[str, Any]) -> None:
+            existing = doc.get("plan")
+            if existing is not None and existing != plan:
+                diff = {
+                    k: (existing.get(k), plan[k])
+                    for k in plan
+                    if existing.get(k) != plan[k]
+                }
+                raise ArchivePlanError(
+                    f"score archive at {arch.directory} was written with a "
+                    f"different plan; differing fields (have, want): {diff}."
+                    " Point --archive-dir somewhere fresh or delete the old"
+                    " archive."
+                )
+            doc["plan"] = plan
+            doc["machines"] = sorted(
+                set(doc.get("machines") or ()) | set(machines)
+            )
+            shards = doc.setdefault("shards", {})
+            shards[str(shard[0])] = {"of": int(shard[1])}
+
+        _locked_index_update(arch.directory, mutate)
+        return arch
+
+    def index(self) -> Optional[Dict[str, Any]]:
+        return _read_index(self.directory)
+
+    def plan(self) -> Optional[Dict[str, Any]]:
+        doc = self.index()
+        return doc.get("plan") if doc else None
+
+    def machines(self) -> List[str]:
+        doc = self.index()
+        return list(doc.get("machines") or ()) if doc else []
+
+    # -- completion records --------------------------------------------------
+
+    def chunk_records(self) -> Dict[str, Dict[str, Any]]:
+        doc = self.index()
+        return dict(doc.get("chunks") or {}) if doc else {}
+
+    def completed_chunks(self, shard: int = 0) -> set:
+        """Chunk indices this shard has durable completion records for."""
+        done = set()
+        for key, rec in self.chunk_records().items():
+            c, s = key.split("/")
+            if int(s) == int(shard):
+                done.add(int(c))
+        return done
+
+    # -- writing -------------------------------------------------------------
+
+    def write_chunk(
+        self,
+        chunk: int,
+        per_machine: Dict[str, Dict[str, Any]],
+        shard: int = 0,
+        meta: Optional[Dict[str, Any]] = None,
+    ) -> Optional[str]:
+        """Durably write one chunk's columns, then its completion record.
+
+        Ordering is the resumability contract: segment bytes fsync
+        BEFORE the record lands in the index, so a record's existence
+        proves its segment is whole.  An empty chunk (no machine had
+        rows in the window) records completion with no segment."""
+        os.makedirs(self.directory, exist_ok=True)
+        fname: Optional[str] = None
+        rows = 0
+        if per_machine:
+            fname = _segment_name(chunk, shard)
+            blob, header = _encode_segment(chunk, shard, per_machine)
+            rows = sum(
+                e["rows"] for e in header["machines"].values()
+            )
+            path = os.path.join(self.directory, fname)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as fh:
+                fh.write(blob)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, path)
+            fsync_dir(self.directory)
+
+        record = {
+            "segment": fname,
+            "machines": len(per_machine),
+            "rows": int(rows),
+            "written-at": time.time(),
+        }
+        if meta:
+            record.update(meta)
+
+        def mutate(doc: Dict[str, Any]) -> None:
+            doc.setdefault("chunks", {})[_chunk_key(chunk, shard)] = record
+
+        _locked_index_update(self.directory, mutate)
+        return fname
+
+    # -- reading -------------------------------------------------------------
+
+    def _completed_segments(self) -> List[Tuple[int, int, str]]:
+        """``(chunk, shard, path)`` of every recorded segment, in chunk
+        order (shard as tiebreak) — concatenation order for reads."""
+        out = []
+        for key, rec in self.chunk_records().items():
+            if not rec.get("segment"):
+                continue
+            c, s = key.split("/")
+            out.append(
+                (int(c), int(s), os.path.join(self.directory, rec["segment"]))
+            )
+        return sorted(out)
+
+    def read_machine(
+        self,
+        name: str,
+        start: Optional[Any] = None,
+        end: Optional[Any] = None,
+    ) -> Optional[Dict[str, Any]]:
+        """One machine's scored history across every completed chunk.
+
+        Returns ``{"index-ns", "total-anomaly-score",
+        "tag-anomaly-scores", "tags"}`` with rows concatenated in time
+        order, optionally clipped to ``[start, end)`` (anything
+        ``pd.Timestamp`` accepts), or None when the archive holds no
+        rows for the machine."""
+        idx_parts: List[np.ndarray] = []
+        tot_parts: List[np.ndarray] = []
+        tag_parts: List[np.ndarray] = []
+        tags: List[str] = []
+        for _c, _s, path in self._completed_segments():
+            try:
+                header, base = _read_segment_header(path)
+            except FileNotFoundError:
+                raise ArchiveError(
+                    f"{path}: completion record exists but segment is "
+                    "missing — archive is torn; delete and re-run"
+                )
+            entry = header["machines"].get(name)
+            if entry is None:
+                continue
+            cols = entry["columns"]
+            idx_parts.append(
+                np.asarray(_mmap_column(path, base, cols["index-ns"]))
+            )
+            tot_parts.append(
+                np.asarray(
+                    _mmap_column(path, base, cols["total-anomaly-score"])
+                )
+            )
+            tag_parts.append(
+                np.asarray(
+                    _mmap_column(path, base, cols["tag-anomaly-scores"])
+                )
+            )
+            tags = tags or list(entry.get("tags") or ())
+        if not idx_parts:
+            return None
+        index_ns = np.concatenate(idx_parts)
+        total = np.concatenate(tot_parts)
+        tag_scores = np.concatenate(tag_parts)
+        if start is not None or end is not None:
+            import pandas as pd
+
+            lo = (
+                -np.inf if start is None
+                else pd.Timestamp(start).tz_localize("UTC").value
+                if pd.Timestamp(start).tzinfo is None
+                else pd.Timestamp(start).value
+            )
+            hi = (
+                np.inf if end is None
+                else pd.Timestamp(end).tz_localize("UTC").value
+                if pd.Timestamp(end).tzinfo is None
+                else pd.Timestamp(end).value
+            )
+            keep = (index_ns >= lo) & (index_ns < hi)
+            index_ns, total, tag_scores = (
+                index_ns[keep], total[keep], tag_scores[keep]
+            )
+        return {
+            "index-ns": index_ns,
+            "total-anomaly-score": total,
+            "tag-anomaly-scores": tag_scores,
+            "tags": tags,
+        }
+
+    def summary(self) -> Dict[str, Any]:
+        doc = self.index() or {}
+        chunks = doc.get("chunks") or {}
+        return {
+            "directory": self.directory,
+            "plan": doc.get("plan"),
+            "machines": len(doc.get("machines") or ()),
+            "chunks-completed": len(chunks),
+            "rows": sum(int(r.get("rows", 0)) for r in chunks.values()),
+            "segments": sum(1 for r in chunks.values() if r.get("segment")),
+        }

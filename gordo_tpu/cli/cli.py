@@ -1099,6 +1099,88 @@ def refresh_cmd(machine_config, project_name, output_dir,
 
 
 # ---------------------------------------------------------------------------
+# backfill (offline historical scoring → columnar archive)
+# ---------------------------------------------------------------------------
+
+@gordo.command("backfill")
+@click.option("--model-dir", envvar="MODEL_LOCATION", default="./models",
+              show_default=True,
+              help="Artifact directory holding the fleet's built models "
+                   "(the same directory run-server scans).")
+@click.option("--archive-dir", envvar="GORDO_BACKFILL_ARCHIVE_DIR",
+              default=None,
+              help="Archive destination root (scores land under "
+                   "<archive-dir>/.gordo-scores/) [default: --model-dir].")
+@click.option("--project-name", envvar="PROJECT_NAME", default="project")
+@click.option("--start", required=True,
+              help="Inclusive start of the historical range (ISO-8601; "
+                   "tz-naive is taken as UTC).")
+@click.option("--end", required=True,
+              help="Exclusive end of the historical range (ISO-8601).")
+@click.option("--machines", default=None,
+              help="Comma-separated machine subset [default: every "
+                   "machine discovered under --model-dir].")
+@click.option("--shard", default=None, envvar="GORDO_BACKFILL_SHARD",
+              help="'i/N' — score only this shard's deterministic "
+                   "partition of the fleet (same partitioner the serving "
+                   "tier shards with). Indexed Jobs wire the pair "
+                   "GORDO_BACKFILL_SHARD_INDEX/GORDO_BACKFILL_NUM_SHARDS "
+                   "instead.")
+@click.option("--chunk-rows", default=None, type=click.IntRange(min=1),
+              envvar="GORDO_BACKFILL_CHUNK_ROWS",
+              help="Rows per staged chunk (the unit of resumability and "
+                   "of host→device transfer) [default: "
+                   "GORDO_BACKFILL_CHUNK_ROWS or 2048].")
+@click.option("--max-chunks", default=None, type=click.IntRange(min=1),
+              help="Stop after N chunks this invocation (checkpoint-and-"
+                   "yield for preemptible capacity; exits resumable).")
+def backfill_cmd(model_dir, archive_dir, project_name, start, end,
+                 machines, shard, chunk_rows, max_chunks):
+    """Score a historical time range for the whole fleet offline.
+
+    Loads every model from --model-dir (no server, no HTTP), fetches
+    each machine's sensor frame from its dataset provider, stages
+    fixed-row chunks through the compile plane's fused fleet programs
+    at the configured serving dtype, and appends columnar segments to
+    the ``.gordo-scores/`` archive.  Completed chunks are durable: a
+    killed run re-invoked with the same range resumes from its
+    completion records and converges on a byte-identical archive.
+    Exits 75 (EX_TEMPFAIL) when progress was archived but the range is
+    not finished — supervisors should simply re-run.
+    """
+    from gordo_tpu.batch import BackfillConfig, BackfillError, run_backfill
+    from gordo_tpu.distributed.partition import EXIT_SHARD_RESUMABLE
+
+    machine_list = None
+    if machines:
+        machine_list = [m.strip() for m in machines.split(",") if m.strip()]
+    cfg = BackfillConfig(
+        model_dir=model_dir,
+        start=start,
+        end=end,
+        archive_dir=archive_dir,
+        project=project_name,
+        machines=machine_list,
+        shard=shard,
+        chunk_rows=chunk_rows,
+        max_chunks=max_chunks,
+    )
+    try:
+        summary = run_backfill(cfg)
+    except BackfillError as exc:
+        # completed chunks are already fsync'd behind their completion
+        # records — a re-run resumes, so this is EX_TEMPFAIL, not a crash
+        logger.error("backfill interrupted (resumable): %s", exc)
+        _RESUMABLE_EXITS_TOTAL.inc(1.0, "backfill")
+        sys.exit(EXIT_SHARD_RESUMABLE)
+    click.echo(json.dumps(summary, sort_keys=True))
+    if summary.get("remaining", 0) > 0:
+        # --max-chunks checkpoint-and-yield: archived progress, more to do
+        _RESUMABLE_EXITS_TOTAL.inc(1.0, "backfill")
+        sys.exit(EXIT_SHARD_RESUMABLE)
+
+
+# ---------------------------------------------------------------------------
 # workflow
 # ---------------------------------------------------------------------------
 
@@ -1157,11 +1239,24 @@ def workflow_group():
                    "drift-driven incremental rebuild loop. Refused when "
                    "the builder has no models volume to warm-start "
                    "from, or when the schedule is malformed.")
+@click.option("--backfill", nargs=2, default=None, metavar="START END",
+              help="Additionally emit an Indexed Job running 'gordo "
+                   "backfill' over this half-open [START, END) range "
+                   "against the builder's models PVC — offline fleet "
+                   "scoring into the .gordo-scores/ archive. Refused "
+                   "when the range is malformed or the builder has no "
+                   "models volume.")
+@click.option("--backfill-shards", default=1, show_default=True,
+              type=click.IntRange(min=1),
+              help="Fan the backfill Job out across N Indexed pods "
+                   "(GORDO_BACKFILL_SHARD_INDEX/NUM_SHARDS env wiring; "
+                   "deterministic machine partition). Refused when N "
+                   "exceeds the machine count.")
 @click.option("--output-file", type=click.File("w"), default="-")
 def workflow_generate(machine_config, project_name, image, server_replicas,
                       server_args, fmt, multihost, scrape_annotations,
                       serve_dtype, serve_shards, hpa_max_replicas,
-                      refresh_cron, output_file):
+                      refresh_cron, backfill, backfill_shards, output_file):
     """Render the kubernetes manifests + fleet build plan (reference:
     the Argo workflow template render)."""
     from gordo_tpu.workflow import (
@@ -1187,6 +1282,8 @@ def workflow_generate(machine_config, project_name, image, server_replicas,
             serve_shards=serve_shards,
             hpa_max_replicas=hpa_max_replicas,
             refresh_cron=refresh_cron,
+            backfill=tuple(backfill) if backfill else None,
+            backfill_shards=backfill_shards,
         )
     except ValueError as exc:
         raise click.ClickException(str(exc))

@@ -29,12 +29,13 @@ from gordo_tpu import faults, telemetry
 from gordo_tpu.client.forwarders import PredictionForwarder
 from gordo_tpu.client.io import (
     HttpUnprocessableEntity,
+    bulk_rows_budget,
     get_json,
     post_json,
     post_msgpack,
 )
 from gordo_tpu.dataset.data_provider.base import GordoBaseDataProvider
-from gordo_tpu.dataset.datasets import TimeSeriesDataset
+from gordo_tpu.dataset.datasets import dataset_from_metadata
 
 logger = logging.getLogger(__name__)
 
@@ -670,8 +671,15 @@ class Client:
             else:
                 data[res[0]], metas[res[0]] = res[2], res[1]
 
+        # a bulk round spans every machine, so its payload is rows x
+        # SUM(machine columns): over a long time range the row slice
+        # shrinks to the max-samples budget (keeps codec memory bounded
+        # — GORDO_CLIENT_MAX_BULK_SAMPLES), never beyond batch_size
+        rows_per_round = bulk_rows_budget(
+            sum(X.shape[1] for X in data.values()), self.batch_size
+        )
         n_chunks = {
-            name: -(-len(X) // self.batch_size) for name, X in data.items()
+            name: -(-len(X) // rows_per_round) for name, X in data.items()
         }
         frames: Dict[str, List[pd.DataFrame]] = {name: [] for name in data}
 
@@ -681,7 +689,7 @@ class Client:
             chunk_index: Dict[str, pd.Index] = {}
             for name, X in data.items():
                 if idx < n_chunks[name]:
-                    chunk = X.iloc[idx * self.batch_size : (idx + 1) * self.batch_size]
+                    chunk = X.iloc[idx * rows_per_round : (idx + 1) * rows_per_round]
                     arr = chunk.to_numpy(np.float32)
                     payload_X[name] = arr if self.use_msgpack else arr.tolist()
                     chunk_index[name] = chunk.index
@@ -900,30 +908,58 @@ class Client:
     def _fetch_data(
         self, dataset_meta: Dict[str, Any], start: Any, end: Any
     ) -> pd.DataFrame:
-        tag_list = [
-            t["name"] if isinstance(t, dict) else str(t)
-            for t in dataset_meta.get("tag_list", [])
-        ]
-        if not tag_list:
-            raise ValueError("Machine metadata has no dataset.tag_list")
-        provider = self.data_provider
-        if provider is None:
-            dp_cfg = dataset_meta.get("data_provider")
-            if not dp_cfg:
-                raise ValueError(
-                    "No data_provider in machine metadata and none supplied "
-                    "to Client(data_provider=...)"
-                )
-            provider = GordoBaseDataProvider.from_dict(dict(dp_cfg))
-        dataset = TimeSeriesDataset(
-            train_start_date=start,
-            train_end_date=end,
-            tag_list=tag_list,
-            resolution=dataset_meta.get("resolution", "10min"),
-            data_provider=provider,
+        dataset = dataset_from_metadata(
+            dataset_meta, start, end, data_provider=self.data_provider
         )
         X, _ = dataset.get_data()
         return X
+
+    # -- archived history (the backfill plane's read side) -------------------
+    def score_history(
+        self,
+        machines: Optional[Sequence[str]] = None,
+        *,
+        archive_dir: str,
+        start: Any = None,
+        end: Any = None,
+    ) -> Dict[str, pd.DataFrame]:
+        """Archived backfill scores as one frame per machine — months of
+        history without a single server round-trip.
+
+        Reads the columnar ``.gordo-scores/`` archive a ``gordo
+        backfill`` run wrote under ``archive_dir`` (a shared volume, an
+        artifact dir checkout, ...).  Each frame carries a UTC
+        ``DatetimeIndex`` of the scored rows, a ``total-anomaly-score``
+        column, and one ``tag-anomaly-score-<tag>`` column per tag —
+        the archive analogue of a bulk anomaly response.  Machines with
+        no archived rows (or outside ``machines``) are omitted.
+        ``start``/``end`` clip to ``[start, end)``."""
+        from gordo_tpu.batch.archive import ScoreArchive
+
+        arch = ScoreArchive(archive_dir)
+        names = list(machines) if machines else arch.machines()
+        out: Dict[str, pd.DataFrame] = {}
+        for name in names:
+            rec = arch.read_machine(name, start=start, end=end)
+            if rec is None or rec["total-anomaly-score"].size == 0:
+                continue
+            index = pd.DatetimeIndex(
+                np.asarray(rec["index-ns"]).view("datetime64[ns]"),
+                name="time",
+            ).tz_localize("UTC")
+            tags = list(rec["tags"]) or [
+                str(i) for i in range(rec["tag-anomaly-scores"].shape[1])
+            ]
+            frame = pd.DataFrame(
+                rec["tag-anomaly-scores"],
+                index=index,
+                columns=[f"tag-anomaly-score-{t}" for t in tags],
+            )
+            frame.insert(
+                0, "total-anomaly-score", rec["total-anomaly-score"]
+            )
+            out[name] = frame
+        return out
 
     # -- plumbing ------------------------------------------------------------
     async def _with_session(self, fn, *args):

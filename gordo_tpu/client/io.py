@@ -9,6 +9,7 @@ wrappers (``fetch_json``/``post_json``) raising ``HttpUnprocessableEntity``
 from __future__ import annotations
 
 import asyncio
+import os
 import random
 import time
 from typing import Any, Dict, Optional
@@ -16,6 +17,37 @@ from typing import Any, Dict, Optional
 import aiohttp
 
 from gordo_tpu import faults, telemetry
+
+#: max samples (rows x total machine-columns) one bulk round may carry.
+#: A bulk round's payload spans EVERY machine — ``batch_size`` alone
+#: bounds only the row axis, so a long-time-range request against a 10k-
+#: machine fleet used to pack ``batch_size x machines x tags`` floats
+#: into ONE body (gigabytes through the codec; the backfill archive's
+#: device-limited chunks made the contrast visible).  Rounds now shrink
+#: their row slice so no payload exceeds this budget.
+ENV_MAX_BULK_SAMPLES = "GORDO_CLIENT_MAX_BULK_SAMPLES"
+DEFAULT_MAX_BULK_SAMPLES = 2_000_000
+
+
+def max_bulk_samples() -> int:
+    try:
+        value = int(
+            os.environ.get(ENV_MAX_BULK_SAMPLES, "")
+            or DEFAULT_MAX_BULK_SAMPLES
+        )
+    except ValueError:
+        return DEFAULT_MAX_BULK_SAMPLES
+    return value if value > 0 else DEFAULT_MAX_BULK_SAMPLES
+
+
+def bulk_rows_budget(total_columns: int, batch_size: int) -> int:
+    """Rows one bulk round may carry across ``total_columns`` summed
+    machine-columns without exceeding :func:`max_bulk_samples` — never
+    more than ``batch_size`` (the row-axis contract stands), never less
+    than 1 (progress is always possible)."""
+    if total_columns <= 0:
+        return max(1, int(batch_size))
+    return max(1, min(int(batch_size), max_bulk_samples() // total_columns))
 
 
 class HttpUnprocessableEntity(Exception):

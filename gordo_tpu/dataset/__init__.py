@@ -1,3 +1,7 @@
 from gordo_tpu.dataset.base import GordoBaseDataset  # noqa: F401
-from gordo_tpu.dataset.datasets import RandomDataset, TimeSeriesDataset  # noqa: F401
+from gordo_tpu.dataset.datasets import (  # noqa: F401
+    RandomDataset,
+    TimeSeriesDataset,
+    dataset_from_metadata,
+)
 from gordo_tpu.dataset.sensor_tag import SensorTag, normalize_sensor_tags  # noqa: F401

@@ -392,6 +392,43 @@ class TimeSeriesDataset(GordoBaseDataset):
         return dict(self._metadata)
 
 
+def dataset_from_metadata(
+    dataset_meta: Dict[str, Any],
+    start: Any,
+    end: Any,
+    data_provider: Optional[GordoBaseDataProvider] = None,
+) -> TimeSeriesDataset:
+    """A scoring-period :class:`TimeSeriesDataset` reconstructed from a
+    build's dataset metadata (``metadata["dataset"]`` as the builder
+    records it: ``tag_list``, ``resolution``, ``data_provider``).
+
+    The shared refetch recipe: the HTTP client re-pulls raw data for a
+    prediction period with it, and the backfill runner drives historical
+    windows through the exact same assembly — one definition of "the
+    data a machine scores over", not two."""
+    tag_list = [
+        t["name"] if isinstance(t, dict) else str(t)
+        for t in dataset_meta.get("tag_list", [])
+    ]
+    if not tag_list:
+        raise ValueError("Dataset metadata has no tag_list")
+    provider = data_provider
+    if provider is None:
+        dp_cfg = dataset_meta.get("data_provider")
+        if not dp_cfg:
+            raise ValueError(
+                "No data_provider in dataset metadata and none supplied"
+            )
+        provider = GordoBaseDataProvider.from_dict(dict(dp_cfg))
+    return TimeSeriesDataset(
+        train_start_date=start,
+        train_end_date=end,
+        tag_list=tag_list,
+        resolution=dataset_meta.get("resolution", "10min"),
+        data_provider=provider,
+    )
+
+
 class RandomDataset(TimeSeriesDataset):
     """TimeSeriesDataset preconfigured with the RandomDataProvider
     (reference: ``datasets.RandomDataset``)."""

@@ -798,6 +798,15 @@ class FleetDispatch:
         #: (host outputs, bucket, [(name, slot, stack_pos, n_valid), ...])
         self._pending: List[Tuple[Dict[str, np.ndarray], Any, List[Tuple]]] = []
 
+    @property
+    def n_device_dispatches(self) -> int:
+        """Stacked device dispatches gathered into this result (one per
+        bucket program actually run — each staged exactly one host→device
+        input transfer).  Read it BEFORE :meth:`assemble` drains the
+        pending list; the backfill plane's per-chunk device-transfer
+        attestation consumes it."""
+        return len(self._pending)
+
     def assemble(self) -> Dict[str, Dict[str, np.ndarray]]:
         """Slice each machine's rows out of the stacked host outputs and
         attach its thresholds; idempotent-safe (pending entries drain)."""

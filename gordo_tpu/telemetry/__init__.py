@@ -39,6 +39,7 @@ from gordo_tpu.telemetry.fleet_health import (  # noqa: F401
     FLEET_HEALTH,
     FleetHealth,
     ScoreSketch,
+    baselines_from_archive,
     drift_score,
     load_rollups,
     merge_health_docs,
@@ -86,6 +87,7 @@ __all__ = [
     "merge_snapshots",
     "new_trace_id",
     "normalize_health_doc",
+    "baselines_from_archive",
     "read_rollups",
     "render",
     "render_snapshot",

@@ -801,3 +801,39 @@ def read_rollups(
     if not docs:
         return None
     return merge_health_docs(docs, top=top, threshold=threshold)
+
+
+def baselines_from_archive(
+    directory: str,
+    machines: Optional[Sequence[str]] = None,
+    apply: bool = False,
+) -> Dict[str, Dict[str, Any]]:
+    """Per-machine baseline sketch docs regenerated from a backfill
+    score archive (``<directory>/.gordo-scores/``) — REAL served-history
+    distributions instead of training residuals.
+
+    A baseline built from months of archived scores is the distribution
+    the machine actually lives at, so drift measured against it flags
+    behavior changes rather than train/serve skew.  Returns
+    ``{machine: sketch doc}`` (machines with no archived rows are
+    omitted); ``apply=True`` additionally installs each doc as the live
+    process's baseline (:meth:`FleetHealth.set_baseline`), the hook a
+    server rescan or refresh loop calls after a backfill lands.
+
+    The batch plane import is deferred: telemetry must stay importable
+    without the backfill plane's jax surface."""
+    from gordo_tpu.batch.archive import ScoreArchive
+
+    arch = ScoreArchive(directory)
+    docs: Dict[str, Dict[str, Any]] = {}
+    for name in machines if machines is not None else arch.machines():
+        rec = arch.read_machine(name)
+        if rec is None:
+            continue
+        scores = rec["total-anomaly-score"]
+        if scores.size == 0:
+            continue
+        docs[name] = sketch_from_scores(scores).to_doc()
+        if apply:
+            FLEET_HEALTH.set_baseline(name, docs[name])
+    return docs

@@ -28,7 +28,7 @@ no string templating to escape-bug.
 from __future__ import annotations
 
 import json
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import yaml
 
@@ -498,6 +498,93 @@ def _refresh_cronjob(
     }
 
 
+def _backfill_job(
+    project: str,
+    image: str,
+    start: str,
+    end: str,
+    shards: int,
+    builder_job: Dict[str, Any],
+) -> Dict:
+    """An Indexed ``batch/v1`` Job running ``gordo backfill`` over
+    ``[start, end)`` — the offline backfill plane fanned out across
+    ``shards`` pods (docs/batch.md "Sharded backfill").
+
+    The pod template mirrors the builder Job's volumes and env (models
+    PVC, project-config ConfigMap, shared compile cache, GORDO_* wiring)
+    so each shard scores with exactly the artifacts the build produced
+    and archives next to them.  Shard identity rides the same
+    ``JOB_COMPLETION_INDEX`` dependent-env wiring as the multihost
+    builder: ``GORDO_BACKFILL_SHARD_INDEX`` is the pod's completion
+    index and ``GORDO_BACKFILL_NUM_SHARDS`` the fan-out, which
+    ``batch.runner.resolve_shard`` consumes with no extra flags.
+    Refused when the builder template carries no models volume — a
+    backfill with no artifacts to load can only score the void."""
+    import copy
+
+    import pandas as pd
+
+    try:
+        ts_start = pd.Timestamp(start)
+        ts_end = pd.Timestamp(end)
+    except (TypeError, ValueError) as exc:
+        raise ValueError(
+            f"--backfill range ({start!r}, {end!r}) does not parse as "
+            f"timestamps: {exc}"
+        )
+    if ts_start.tz_localize(None) >= ts_end.tz_localize(None):
+        raise ValueError(
+            f"--backfill start {start!r} must precede end {end!r} "
+            f"(the range is half-open [start, end))"
+        )
+    builder_spec = builder_job["spec"]["template"]["spec"]
+    volume_names = {v.get("name") for v in builder_spec.get("volumes", [])}
+    if "models" not in volume_names:
+        raise ValueError(
+            "--backfill requires the builder template to mount a "
+            "'models' volume (the artifact dir the backfill loads models "
+            "from and archives scores under); this builder configuration "
+            f"has volumes {sorted(volume_names)}"
+        )
+    pod_spec = copy.deepcopy(builder_spec)
+    container = pod_spec["containers"][0]
+    container["name"] = "backfill"
+    container["command"] = ["gordo", "backfill"]
+    container["args"] = [
+        "--model-dir", "/models",
+        "--start", str(start),
+        "--end", str(end),
+    ]
+    container.setdefault("env", []).extend([
+        # JOB_COMPLETION_INDEX is injected by kubernetes for Indexed
+        # Jobs; the pair below is the env spelling of --shard i/N
+        {"name": "GORDO_BACKFILL_SHARD_INDEX",
+         "value": "$(JOB_COMPLETION_INDEX)"},
+        {"name": "GORDO_BACKFILL_NUM_SHARDS", "value": str(shards)},
+    ])
+    return {
+        "apiVersion": "batch/v1",
+        "kind": "Job",
+        "metadata": {
+            "name": f"gordo-backfill-{project}",
+            "labels": _labels(project, "backfill"),
+        },
+        "spec": {
+            "completions": shards,
+            "parallelism": shards,
+            "completionMode": "Indexed",
+            # exit 75 (EX_TEMPFAIL) = archived progress, not finished;
+            # the retry resumes from completion records into byte-
+            # identical segments, so a generous backoffLimit is cheap
+            "backoffLimit": 6,
+            "template": {
+                "metadata": {"labels": _labels(project, "backfill")},
+                "spec": pod_spec,
+            },
+        },
+    }
+
+
 def _server_deployment(
     project: str,
     image: str,
@@ -766,6 +853,8 @@ def generate_workflow(
     serve_shards: Optional[int] = None,
     hpa_max_replicas: int = 4,
     refresh_cron: Optional[str] = None,
+    backfill: Optional[Tuple[str, str]] = None,
+    backfill_shards: int = 1,
 ) -> List[Dict[str, Any]]:
     """Project config → list of k8s manifest dicts (+ the build plan as a
     ConfigMap so the cluster state carries the bucketing decision).
@@ -808,6 +897,15 @@ def generate_workflow(
     incremental rebuild loop (docs/operations.md "Incremental
     refresh").  Refused when the builder template has no models volume
     to warm-start from, or when the schedule is malformed.
+
+    ``backfill`` (a ``(start, end)`` timestamp pair): additionally emit
+    an Indexed Job running ``gordo backfill`` over the half-open range
+    against the same models PVC as the builder, fanned out across
+    ``backfill_shards`` pods via the ``GORDO_BACKFILL_SHARD_INDEX`` /
+    ``GORDO_BACKFILL_NUM_SHARDS`` env pair (docs/batch.md).  Refused
+    when the range is malformed, when the builder has no models volume,
+    or when ``backfill_shards`` exceeds the machine count — machines
+    are the atoms of the backfill partition.
     """
     project = config.project_name
     machines = [m.name for m in config.machines]
@@ -861,6 +959,30 @@ def generate_workflow(
         )
         builder_docs.append(
             _refresh_cronjob(project, image, refresh_cron, template)
+        )
+    if backfill is not None:
+        start, end = backfill
+        if backfill_shards < 1:
+            raise ValueError(
+                f"backfill_shards must be >= 1, got {backfill_shards}"
+            )
+        if backfill_shards > len(machines):
+            raise ValueError(
+                f"--backfill-shards {backfill_shards} exceeds the "
+                f"project's machine count ({len(machines)}): machines are "
+                f"the atoms of the backfill partition, so extra pods "
+                f"would own empty shards. Use --backfill-shards <= "
+                f"{len(machines)}."
+            )
+        # same single-pod template shape as the refresh CronJob: each
+        # backfill shard is one process staging its own fleet subset
+        template = _builder_job(
+            project, image, tpu_resources, serve_dtype=serve_dtype
+        )
+        builder_docs.append(
+            _backfill_job(
+                project, image, start, end, backfill_shards, template
+            )
         )
     sharded = serve_shards is not None and serve_shards > 1
     if sharded:

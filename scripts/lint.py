@@ -147,6 +147,25 @@ REFRESH_FORBIDDEN_IMPORT_PREFIXES = (
     "gordo_tpu.watchman",
 )
 
+#: backfill-plane boundary contract: gordo_tpu/batch/ is the OFFLINE
+#: path — models from the artifact plane, data from dataset providers,
+#: scores into the archive.  It reuses the serving stack's scorer and
+#: compile plane (gordo_tpu.serve.fleet_scorer / precision are fine),
+#: but the HTTP tier must never leak in: no serve.server, no client, no
+#: watchman, no HTTP library.  A backfill that talks HTTP has silently
+#: become a load generator against production replicas.
+BATCH_DIR = os.path.join("gordo_tpu", "batch")
+BATCH_FORBIDDEN_IMPORT_PREFIXES = (
+    "gordo_tpu.serve.server",
+    "gordo_tpu.client",
+    "gordo_tpu.watchman",
+    "aiohttp",
+    "requests",
+    "httpx",
+    "urllib",
+    "http",
+)
+
 
 def _jit_allowed(path: str) -> bool:
     norm = os.path.normpath(path)
@@ -221,6 +240,56 @@ def _refresh_import_findings(
                  "refresh plane talks to serving ONLY over its file and "
                  "HTTP interfaces (telemetry.read_rollups, /fleet-health, "
                  "client.wait_for_generation), never server internals")
+            )
+    return findings
+
+
+def _batch_import_findings(
+    path: str, tree: ast.AST, noqa_lines: set
+) -> List[Finding]:
+    """Flag HTTP-tier imports inside gordo_tpu/batch/: the backfill
+    plane scores offline through the artifact/dataset/compile planes —
+    serve.server, the client, watchman, and HTTP libraries are all on
+    the wrong side of its boundary."""
+    norm = os.path.normpath(path)
+    if BATCH_DIR not in norm:
+        return []
+    findings: List[Finding] = []
+
+    def _bad(module: str) -> bool:
+        return any(
+            module == p or module.startswith(p + ".")
+            for p in BATCH_FORBIDDEN_IMPORT_PREFIXES
+        )
+
+    for node in ast.walk(tree):
+        bad = None
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if _bad(alias.name):
+                    bad = alias.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            if _bad(node.module):
+                bad = node.module
+            elif node.module == "gordo_tpu.serve":
+                hits = [a.name for a in node.names if a.name == "server"]
+                if hits:
+                    bad = "gordo_tpu.serve.server"
+            elif node.module == "gordo_tpu":
+                hits = [
+                    a.name for a in node.names
+                    if a.name in ("client", "watchman")
+                ]
+                if hits:
+                    bad = f"gordo_tpu.{hits[0]}"
+        if bad and getattr(node, "lineno", 0) not in noqa_lines:
+            findings.append(
+                (path, node.lineno,
+                 f"import of {bad} inside gordo_tpu/batch/ — the backfill "
+                 "plane is offline by contract: models via "
+                 "artifacts.discover, data via dataset providers, scores "
+                 "into the archive; never serve.server, the HTTP client, "
+                 "or an HTTP library")
             )
     return findings
 
@@ -611,6 +680,7 @@ def lint_file(path: str) -> List[Finding]:
     findings.extend(_artifact_path_findings(path, tree, noqa_lines))
     findings.extend(_artifacts_pack_findings(path, tree, noqa_lines))
     findings.extend(_refresh_import_findings(path, tree, noqa_lines))
+    findings.extend(_batch_import_findings(path, tree, noqa_lines))
 
     for node in ast.walk(tree):
         if isinstance(node, ast.Call):

@@ -1,0 +1,681 @@
+"""Backfill plane tests: archive format/durability, the deterministic
+chunk plan, shard resolution, the runner's fp32 parity with the online
+fused path, resumability, and the end-to-end wiring (CLI, workflow
+Indexed Job, score_history, archive-seeded baselines).
+
+Fast classes run in the tier-1 lane (pure host I/O, no model training);
+the classes that build a real fleet or start a real server are marked
+slow (CI test-full job).
+"""
+
+import asyncio
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+from click.testing import CliRunner
+
+from gordo_tpu import telemetry
+from gordo_tpu.batch import (
+    ArchiveError,
+    ArchivePlanError,
+    BackfillConfig,
+    BackfillError,
+    ScoreArchive,
+    chunk_windows,
+    resolve_shard,
+    run_backfill,
+)
+from gordo_tpu.cli.cli import gordo
+
+
+def _columns(rows, n_tags, t0_ns=0, step_ns=600_000_000_000, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "index-ns": t0_ns + step_ns * np.arange(rows, dtype=np.int64),
+        "total-anomaly-score": rng.standard_normal(rows).astype(np.float32),
+        "tag-anomaly-scores": rng.standard_normal(
+            (rows, n_tags)
+        ).astype(np.float32),
+        "tags": [f"t-{j}" for j in range(n_tags)],
+    }
+
+
+def _create(root, **over):
+    kw = dict(
+        project="p", start="2020-01-01 00:00:00+00:00",
+        end="2020-01-02 00:00:00+00:00", resolution="10min",
+        chunk_rows=48, n_chunks=3, dtype="float32",
+        machines=["m-a", "m-b"],
+    )
+    kw.update(over)
+    return ScoreArchive.create(str(root), **kw)
+
+
+class TestChunkWindows:
+    def test_covers_half_open_range_exactly(self):
+        windows = chunk_windows(
+            "2020-01-01", "2020-01-02", "10min", 48
+        )
+        assert len(windows) == 3  # 144 rows / 48
+        assert windows[0][0] == pd.Timestamp("2020-01-01", tz="UTC")
+        assert windows[-1][1] == pd.Timestamp("2020-01-02", tz="UTC")
+        for (_, a_end), (b_start, _) in zip(windows, windows[1:]):
+            assert a_end == b_start
+
+    def test_ragged_tail_window(self):
+        windows = chunk_windows(
+            "2020-01-01 00:00", "2020-01-01 01:30", "10min", 4
+        )
+        spans = [(t1 - t0) / pd.Timedelta("10min") for t0, t1 in windows]
+        assert spans == [4, 4, 1]
+
+    def test_deterministic_across_calls(self):
+        a = chunk_windows("2020-03-01", "2020-04-01", "1min", 512)
+        b = chunk_windows("2020-03-01", "2020-04-01", "1min", 512)
+        assert a == b
+
+    def test_tz_naive_is_utc(self):
+        (t0, _), = chunk_windows(
+            "2020-01-01", "2020-01-01 00:10", "10min", 100
+        )
+        assert t0 == pd.Timestamp("2020-01-01", tz="UTC")
+
+    def test_bad_range_refused(self):
+        with pytest.raises(ValueError, match="precede"):
+            chunk_windows("2020-02-01", "2020-01-01", "10min", 48)
+
+
+class TestResolveShard:
+    def test_default_unsharded(self, monkeypatch):
+        for var in ("GORDO_BACKFILL_SHARD", "GORDO_BACKFILL_SHARD_INDEX",
+                    "GORDO_BACKFILL_NUM_SHARDS"):
+            monkeypatch.delenv(var, raising=False)
+        assert resolve_shard() == (0, 1)
+
+    def test_explicit_spec(self):
+        assert resolve_shard("2/5") == (2, 5)
+
+    def test_env_spec(self, monkeypatch):
+        monkeypatch.setenv("GORDO_BACKFILL_SHARD", "1/3")
+        assert resolve_shard() == (1, 3)
+
+    def test_indexed_job_env_pair(self, monkeypatch):
+        monkeypatch.delenv("GORDO_BACKFILL_SHARD", raising=False)
+        monkeypatch.setenv("GORDO_BACKFILL_SHARD_INDEX", "3")
+        monkeypatch.setenv("GORDO_BACKFILL_NUM_SHARDS", "4")
+        assert resolve_shard() == (3, 4)
+
+    @pytest.mark.parametrize("bad", ["x/y", "3", "3/3", "-1/2", "1/0"])
+    def test_malformed_specs_refused(self, bad):
+        with pytest.raises(ValueError):
+            resolve_shard(bad)
+
+
+class TestScoreArchive:
+    def test_round_trip_across_chunks(self, tmp_path):
+        arch = _create(tmp_path)
+        c0 = {"m-a": _columns(48, 3, seed=1),
+              "m-b": _columns(48, 2, seed=2)}
+        c1 = {"m-a": _columns(48, 3, t0_ns=48 * 600_000_000_000, seed=3)}
+        arch.write_chunk(0, c0)
+        arch.write_chunk(1, c1)
+
+        rec = arch.read_machine("m-a")
+        assert rec["tags"] == ["t-0", "t-1", "t-2"]
+        assert rec["total-anomaly-score"].dtype == np.float32
+        assert rec["tag-anomaly-scores"].shape == (96, 3)
+        expect = np.concatenate([
+            c0["m-a"]["total-anomaly-score"],
+            c1["m-a"]["total-anomaly-score"],
+        ])
+        assert rec["total-anomaly-score"].tobytes() == expect.tobytes()
+        # m-b only appears in chunk 0
+        assert arch.read_machine("m-b")["tag-anomaly-scores"].shape == (48, 2)
+        assert arch.read_machine("m-unknown") is None
+
+    def test_read_clips_to_half_open_range(self, tmp_path):
+        arch = _create(tmp_path)
+        arch.write_chunk(0, {"m-a": _columns(48, 2)})
+        step = 600_000_000_000
+        rec = arch.read_machine(
+            "m-a",
+            start=pd.Timestamp(10 * step, unit="ns", tz="UTC"),
+            end=pd.Timestamp(20 * step, unit="ns", tz="UTC"),
+        )
+        assert len(rec["index-ns"]) == 10
+        assert rec["index-ns"][0] == 10 * step
+
+    def test_completion_records_are_the_resume_ledger(self, tmp_path):
+        arch = _create(tmp_path)
+        arch.write_chunk(0, {"m-a": _columns(48, 2)})
+        arch.write_chunk(2, {}, meta={"note": "empty window"})
+        assert arch.completed_chunks(0) == {0, 2}
+        assert arch.completed_chunks(1) == set()
+        records = arch.chunk_records()
+        assert records["0/0"]["segment"] is not None
+        assert records["2/0"]["segment"] is None  # empty chunk, no file
+        assert records["2/0"]["note"] == "empty window"
+
+    def test_plan_mismatch_refused(self, tmp_path):
+        _create(tmp_path)
+        with pytest.raises(ArchivePlanError, match="chunk-rows"):
+            _create(tmp_path, chunk_rows=64)
+
+    def test_sibling_shard_merges_roster(self, tmp_path):
+        _create(tmp_path, machines=["m-a"], shard=(0, 2))
+        arch = _create(tmp_path, machines=["m-b"], shard=(1, 2))
+        assert arch.machines() == ["m-a", "m-b"]
+        assert set(arch.index()["shards"]) == {"0", "1"}
+
+    def test_torn_archive_detected(self, tmp_path):
+        arch = _create(tmp_path)
+        fname = arch.write_chunk(0, {"m-a": _columns(8, 2)})
+        os.unlink(os.path.join(arch.directory, fname))
+        with pytest.raises(ArchiveError, match="torn"):
+            arch.read_machine("m-a")
+
+    def test_summary_counts(self, tmp_path):
+        arch = _create(tmp_path)
+        arch.write_chunk(0, {"m-a": _columns(48, 2)})
+        arch.write_chunk(1, {})
+        s = arch.summary()
+        assert s["chunks-completed"] == 2
+        assert s["segments"] == 1
+        assert s["rows"] == 48
+        assert s["plan"]["chunk-rows"] == 48
+
+
+class TestBackfillTelemetry:
+    def test_instruments_registered(self):
+        text = telemetry.render()
+        for metric in (
+            "gordo_backfill_chunks_total",
+            "gordo_backfill_rows_total",
+            "gordo_backfill_samples_total",
+            "gordo_backfill_samples_per_second",
+            "gordo_backfill_device_transfers_total",
+            "gordo_backfill_chunk_occupancy",
+            "gordo_backfill_machines",
+        ):
+            assert metric in text, metric
+
+
+class TestBackfillCli:
+    def test_missing_fleet_exits_resumable(self, tmp_path):
+        result = CliRunner().invoke(gordo, [
+            "backfill", "--model-dir", str(tmp_path),
+            "--start", "2020-01-01", "--end", "2020-01-02",
+        ])
+        # nothing to score is still EX_TEMPFAIL: the supervisor re-runs
+        # once the artifacts exist (Indexed Jobs start before the PVC
+        # has models during a first deploy)
+        assert result.exit_code == 75
+
+
+class TestWorkflowBackfillJob:
+    CONFIG = {
+        "machines": [
+            {"name": f"wfb-{i}", "dataset": {
+                "type": "RandomDataset",
+                "tags": [f"wfb{i}-a", f"wfb{i}-b"],
+                "train_start_date": "2017-12-25T06:00:00Z",
+                "train_end_date": "2017-12-26T06:00:00Z",
+            }}
+            for i in range(3)
+        ]
+    }
+
+    def _generate(self, **kw):
+        from gordo_tpu.workflow import NormalizedConfig, generate_workflow
+
+        return generate_workflow(
+            NormalizedConfig(self.CONFIG, "wfbproj"), **kw
+        )
+
+    def test_indexed_job_with_shard_env_pair(self):
+        docs = self._generate(
+            backfill=("2024-01-01", "2024-02-01"), backfill_shards=3
+        )
+        jobs = [d for d in docs if d.get("kind") == "Job"
+                and "backfill" in d["metadata"]["name"]]
+        assert len(jobs) == 1
+        spec = jobs[0]["spec"]
+        assert spec["completionMode"] == "Indexed"
+        assert spec["completions"] == spec["parallelism"] == 3
+        container = spec["template"]["spec"]["containers"][0]
+        assert container["command"] == ["gordo", "backfill"]
+        assert container["args"][:2] == ["--model-dir", "/models"]
+        env = {e["name"]: e["value"] for e in container["env"]}
+        assert env["GORDO_BACKFILL_SHARD_INDEX"] == "$(JOB_COMPLETION_INDEX)"
+        assert env["GORDO_BACKFILL_NUM_SHARDS"] == "3"
+        # the pod mirrors the builder's volumes: models PVC + config
+        names = {v["name"]
+                 for v in spec["template"]["spec"]["volumes"]}
+        assert "models" in names
+
+    def test_without_backfill_no_job(self):
+        docs = self._generate()
+        assert not any(
+            "backfill" in d.get("metadata", {}).get("name", "")
+            for d in docs
+        )
+
+    def test_shards_beyond_machines_refused(self):
+        with pytest.raises(ValueError, match="atoms of the backfill"):
+            self._generate(
+                backfill=("2024-01-01", "2024-02-01"), backfill_shards=4
+            )
+
+    def test_malformed_range_refused(self):
+        with pytest.raises(ValueError, match="does not parse"):
+            self._generate(backfill=("not-a-time", "2024-02-01"))
+
+    def test_inverted_range_refused(self):
+        with pytest.raises(ValueError, match="must precede"):
+            self._generate(backfill=("2024-02-01", "2024-01-01"))
+
+
+class TestBatchLintGate:
+    @staticmethod
+    def _lint(path):
+        spec = importlib.util.spec_from_file_location(
+            "gordo_lint", os.path.join(
+                os.path.dirname(os.path.dirname(__file__)),
+                "scripts", "lint.py",
+            ),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.lint_file(path)
+
+    def test_http_imports_rejected_in_batch_plane(self, tmp_path):
+        bad = tmp_path / "gordo_tpu" / "batch" / "thing.py"
+        bad.parent.mkdir(parents=True)
+        bad.write_text(
+            "import aiohttp\n"
+            "import urllib.request\n"
+            "from gordo_tpu.serve import server\n"
+            "from gordo_tpu.serve.server import ModelCollection\n"
+            "from gordo_tpu import client\n"
+            "from gordo_tpu.client.client import Client\n"
+            "aiohttp, urllib, server, ModelCollection, client, Client\n"
+        )
+        msgs = [f[2] for f in self._lint(str(bad))]
+        assert sum("backfill" in m for m in msgs) == 6
+
+    def test_scorer_reuse_is_allowed(self, tmp_path):
+        ok = tmp_path / "gordo_tpu" / "batch" / "fine.py"
+        ok.parent.mkdir(parents=True)
+        ok.write_text(
+            "from gordo_tpu.serve.fleet_scorer import FleetScorer\n"
+            "from gordo_tpu.serve import precision\n"
+            "FleetScorer, precision\n"
+        )
+        msgs = [f[2] for f in self._lint(str(ok))]
+        assert not any("backfill" in m for m in msgs)
+
+    def test_batch_plane_is_clean_under_the_gate(self):
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        for rel in ("archive.py", "runner.py", "__init__.py"):
+            path = os.path.join(repo, "gordo_tpu", "batch", rel)
+            assert self._lint(path) == [], rel
+
+
+# ---------------------------------------------------------------------------
+# end-to-end against a real built fleet (slow lane — CI test-full job)
+# ---------------------------------------------------------------------------
+
+PROJECT = {
+    "machines": [
+        {"name": f"bf-{i}", "dataset": {
+            "type": "RandomDataset",
+            "tags": [f"bf{i}-a", f"bf{i}-b", f"bf{i}-c"],
+            "train_start_date": "2017-12-25T06:00:00Z",
+            "train_end_date": "2017-12-26T06:00:00Z",
+        }}
+        for i in range(3)
+    ],
+    "globals": {
+        "model": {
+            "gordo_tpu.anomaly.diff.DiffBasedAnomalyDetector": {
+                "base_estimator": {
+                    "gordo_tpu.pipeline.Pipeline": {
+                        "steps": [
+                            "gordo_tpu.ops.scalers.MinMaxScaler",
+                            {"gordo_tpu.models.estimator.AutoEncoder": {
+                                "kind": "feedforward_hourglass",
+                                "epochs": 1,
+                                "batch_size": 64,
+                            }},
+                        ]
+                    }
+                }
+            }
+        }
+    },
+}
+
+START = "2017-12-26 06:00:00+00:00"
+END = "2017-12-27 06:00:00+00:00"  # 24h @ 10min = 144 rows
+CHUNK_ROWS = 48
+
+
+@pytest.fixture(scope="module")
+def fleet_dir(tmp_path_factory):
+    from gordo_tpu.builder import build_project
+    from gordo_tpu.workflow import NormalizedConfig
+
+    out = tmp_path_factory.mktemp("backfill-artifacts")
+    result = build_project(
+        NormalizedConfig(PROJECT, "bfproj").machines, str(out)
+    )
+    assert not result.failed
+    return str(out)
+
+
+def _backfill(fleet_dir, archive_dir, **over):
+    kw = dict(
+        model_dir=fleet_dir, start=START, end=END,
+        archive_dir=archive_dir, project="bfproj",
+        chunk_rows=CHUNK_ROWS,
+    )
+    kw.update(over)
+    return run_backfill(BackfillConfig(**kw))
+
+
+def _online_scores(fleet_dir, names=None):
+    """The online fused path's scores over the backfill windows: the
+    server's exact FleetScorer geometry fed the identical chunk slices
+    the runner stages."""
+    from gordo_tpu import artifacts
+    from gordo_tpu.compile import load_warmup_manifest
+    from gordo_tpu.dataset import dataset_from_metadata
+    from gordo_tpu.serve import precision
+    from gordo_tpu.serve.fleet_scorer import FleetScorer
+
+    store, refs = artifacts.discover(fleet_dir, quarantine=True)
+    refs = sorted(refs, key=lambda r: r.name)
+    if names is not None:
+        refs = [r for r in refs if r.name in set(names)]
+    models = {r.name: r.load_model() for r in refs}
+    metas = {r.name: (r.load_metadata() or {}) for r in refs}
+    manifest_dtype = (load_warmup_manifest(fleet_dir) or {}).get("dtype")
+    scorer = FleetScorer.from_models(
+        models, pack_store=store,
+        dtype=precision.serve_dtype(default=manifest_dtype),
+    )
+    frames = {}
+    for name, meta in metas.items():
+        X, _ = dataset_from_metadata(
+            meta["dataset"], START, END
+        ).get_data()
+        frames[name] = X
+    out = {name: {"total": [], "tags": []} for name in models}
+    for t0, t1 in chunk_windows(START, END, "10min", CHUNK_ROWS):
+        X_by = {}
+        for name, X in frames.items():
+            lo, hi = X.index.searchsorted(t0), X.index.searchsorted(t1)
+            if hi > lo:
+                X_by[name] = X.iloc[lo:hi].to_numpy(np.float32)
+        if not X_by:
+            continue
+        with telemetry.FLEET_HEALTH.suspended():
+            results = scorer.score_all(X_by)
+        for name, res in results.items():
+            if "error" in res:
+                continue
+            out[name]["total"].append(
+                np.asarray(res["total-anomaly-score"], np.float32)
+            )
+            out[name]["tags"].append(
+                np.asarray(res["tag-anomaly-scores"], np.float32)
+            )
+    return {
+        name: {
+            "total": np.concatenate(cols["total"]),
+            "tags": np.concatenate(cols["tags"]),
+        }
+        for name, cols in out.items() if cols["total"]
+    }
+
+
+@pytest.mark.slow
+class TestBackfillEndToEnd:
+    def test_parity_with_online_fused_path(self, fleet_dir, tmp_path):
+        summary = _backfill(fleet_dir, str(tmp_path / "arch"))
+        assert summary["chunks"] == 3
+        assert summary["chunks-ok"] == 3
+        assert summary["remaining"] == 0
+        assert summary["rows"] > 0
+        assert summary["device-transfers"] >= 3  # >= one per chunk
+        assert summary["samples-per-second"] > 0
+
+        arch = ScoreArchive(str(tmp_path / "arch"))
+        online = _online_scores(fleet_dir)
+        assert set(arch.machines()) == set(online)
+        for name, cols in online.items():
+            rec = arch.read_machine(name)
+            # the acceptance bar: archive bytes fp32-IDENTICAL to the
+            # online fused path over the same windows (same dispatch
+            # membership → same padded program geometry)
+            assert rec["total-anomaly-score"].tobytes() == \
+                cols["total"].tobytes(), name
+            assert rec["tag-anomaly-scores"].tobytes() == \
+                cols["tags"].tobytes(), name
+
+    def test_kill_and_resume_is_byte_identical(self, fleet_dir, tmp_path):
+        uninterrupted = str(tmp_path / "one-shot")
+        interrupted = str(tmp_path / "resumed")
+        _backfill(fleet_dir, uninterrupted)
+
+        partial = _backfill(fleet_dir, interrupted, max_chunks=1)
+        assert partial["chunks-ok"] == 1
+        assert partial["remaining"] == 2
+        resumed = _backfill(fleet_dir, interrupted)
+        assert resumed["chunks-skipped"] == 1
+        assert resumed["chunks-ok"] == 2
+        assert resumed["remaining"] == 0
+
+        a, b = ScoreArchive(uninterrupted), ScoreArchive(interrupted)
+        assert a.machines() == b.machines()
+        for name in a.machines():
+            ra, rb = a.read_machine(name), b.read_machine(name)
+            assert ra["index-ns"].tobytes() == rb["index-ns"].tobytes()
+            assert ra["total-anomaly-score"].tobytes() == \
+                rb["total-anomaly-score"].tobytes()
+            assert ra["tag-anomaly-scores"].tobytes() == \
+                rb["tag-anomaly-scores"].tobytes()
+
+    def test_plan_drift_on_resume_refused(self, fleet_dir, tmp_path):
+        archive_dir = str(tmp_path / "arch")
+        _backfill(fleet_dir, archive_dir, max_chunks=1)
+        with pytest.raises((ArchivePlanError, BackfillError)):
+            _backfill(fleet_dir, archive_dir, chunk_rows=CHUNK_ROWS * 2)
+
+    def test_sharded_runs_are_disjoint_and_merge(self, fleet_dir, tmp_path):
+        archive_dir = str(tmp_path / "arch")
+        s0 = _backfill(fleet_dir, archive_dir, shard="0/2")
+        s1 = _backfill(fleet_dir, archive_dir, shard="1/2")
+        assert s0["machines"] + s1["machines"] == 3
+        arch = ScoreArchive(archive_dir)
+        assert len(arch.machines()) == 3
+        full = ScoreArchive(str(tmp_path / "full"))
+        _backfill(fleet_dir, str(tmp_path / "full"))
+        for name in arch.machines():
+            merged = arch.read_machine(name)
+            whole = full.read_machine(name)
+            assert merged is not None and whole is not None
+            # shard membership changes dispatch geometry, so scores are
+            # shard-local — but coverage must match the unsharded run
+            assert merged["index-ns"].tobytes() == \
+                whole["index-ns"].tobytes()
+
+    def test_machine_subset_and_unknown_machine(self, fleet_dir, tmp_path):
+        summary = _backfill(
+            fleet_dir, str(tmp_path / "sub"), machines=["bf-1"]
+        )
+        assert summary["machines"] == 1
+        arch = ScoreArchive(str(tmp_path / "sub"))
+        assert arch.machines() == ["bf-1"]
+        with pytest.raises(BackfillError, match="not in the artifact"):
+            _backfill(fleet_dir, str(tmp_path / "sub2"),
+                      machines=["no-such-machine"])
+
+    def test_score_history_reads_archive(self, fleet_dir, tmp_path):
+        from gordo_tpu.client import Client
+
+        archive_dir = str(tmp_path / "arch")
+        _backfill(fleet_dir, archive_dir)
+        frames = Client("bfproj").score_history(archive_dir=archive_dir)
+        assert set(frames) == {"bf-0", "bf-1", "bf-2"}
+        df = frames["bf-0"]
+        assert df.index.tz is not None
+        assert list(df.columns)[0] == "total-anomaly-score"
+        assert [c for c in df.columns if c.startswith("tag-anomaly-")] == [
+            "tag-anomaly-score-bf0-a",
+            "tag-anomaly-score-bf0-b",
+            "tag-anomaly-score-bf0-c",
+        ]
+        clipped = Client("bfproj").score_history(
+            ["bf-0"], archive_dir=archive_dir,
+            start="2017-12-26 12:00:00Z", end="2017-12-26 14:00:00Z",
+        )
+        assert len(clipped["bf-0"]) <= 12
+        assert (clipped["bf-0"].index >= "2017-12-26 12:00:00Z").all()
+
+    def test_baselines_from_archive(self, fleet_dir, tmp_path):
+        archive_dir = str(tmp_path / "arch")
+        _backfill(fleet_dir, archive_dir)
+        docs = telemetry.baselines_from_archive(archive_dir)
+        assert set(docs) == {"bf-0", "bf-1", "bf-2"}
+        for doc in docs.values():
+            assert doc.get("count", 0) > 0 or doc.get("counts")
+        reg = telemetry.FLEET_HEALTH
+        try:
+            applied = telemetry.baselines_from_archive(
+                archive_dir, machines=["bf-0"], apply=True
+            )
+            assert set(applied) == {"bf-0"}
+        finally:
+            reg.clear(["bf-0", "bf-1", "bf-2"])
+
+    def test_cli_backfill_and_resume_exit_codes(self, fleet_dir, tmp_path):
+        archive_dir = str(tmp_path / "arch")
+        runner = CliRunner()
+        bounded = runner.invoke(gordo, [
+            "backfill", "--model-dir", fleet_dir,
+            "--archive-dir", archive_dir, "--project-name", "bfproj",
+            "--start", START, "--end", END,
+            "--chunk-rows", str(CHUNK_ROWS), "--max-chunks", "1",
+        ])
+        # progress archived but range unfinished → EX_TEMPFAIL
+        assert bounded.exit_code == 75, bounded.output
+        summary = json.loads(bounded.output.strip().splitlines()[-1])
+        assert summary["remaining"] == 2
+
+        finished = runner.invoke(gordo, [
+            "backfill", "--model-dir", fleet_dir,
+            "--archive-dir", archive_dir, "--project-name", "bfproj",
+            "--start", START, "--end", END,
+            "--chunk-rows", str(CHUNK_ROWS),
+        ])
+        assert finished.exit_code == 0, finished.output
+        summary = json.loads(finished.output.strip().splitlines()[-1])
+        assert summary["chunks-skipped"] == 1
+        assert summary["remaining"] == 0
+
+
+@pytest.mark.slow
+class TestArchiveHttpParity:
+    """The archive path and the live HTTP bulk route must agree byte-for-
+    byte: same windows, same dispatch membership, same fused programs —
+    the backfill plane is the server's scorer without the server."""
+
+    def test_bulk_route_matches_archive(self, fleet_dir, tmp_path):
+        import aiohttp
+        from aiohttp import web
+
+        from gordo_tpu.dataset import dataset_from_metadata
+        from gordo_tpu.serve import ModelCollection, build_app, codec
+
+        archive_dir = str(tmp_path / "arch")
+        _backfill(fleet_dir, archive_dir)
+        arch = ScoreArchive(archive_dir)
+        names = arch.machines()
+
+        async def runner():
+            collection = ModelCollection.from_directory(
+                fleet_dir, project="bfproj"
+            )
+            frames = {}
+            for name in names:
+                meta = collection.get(name).metadata
+                X, _ = dataset_from_metadata(
+                    meta["dataset"], START, END
+                ).get_data()
+                frames[name] = X
+            app_runner = web.AppRunner(build_app(collection))
+            await app_runner.setup()
+            site = web.TCPSite(app_runner, "127.0.0.1", 0)
+            await site.start()
+            port = app_runner.addresses[0][1]
+            url = (f"http://127.0.0.1:{port}/gordo/v0/bfproj/"
+                   f"_bulk/anomaly/prediction")
+            per_machine = {n: {"total": [], "tags": []} for n in names}
+            try:
+                async with aiohttp.ClientSession() as session:
+                    for t0, t1 in chunk_windows(
+                        START, END, "10min", CHUNK_ROWS
+                    ):
+                        X_by = {}
+                        for name, X in frames.items():
+                            lo = X.index.searchsorted(t0)
+                            hi = X.index.searchsorted(t1)
+                            if hi > lo:
+                                X_by[name] = X.iloc[lo:hi].to_numpy(
+                                    np.float32
+                                )
+                        if not X_by:
+                            continue
+                        with telemetry.FLEET_HEALTH.suspended():
+                            async with session.post(
+                                url,
+                                data=codec.packb({"X": X_by}),
+                                headers={
+                                    "Content-Type":
+                                        codec.MSGPACK_CONTENT_TYPE,
+                                    "Accept": codec.MSGPACK_CONTENT_TYPE,
+                                },
+                            ) as resp:
+                                assert resp.status == 200
+                                body = codec.unpackb(await resp.read())
+                        for name, res in body["data"].items():
+                            per_machine[name]["total"].append(
+                                np.asarray(
+                                    res["total-anomaly-score"],
+                                    np.float32,
+                                )
+                            )
+                            per_machine[name]["tags"].append(
+                                np.asarray(
+                                    res["tag-anomaly-scores"], np.float32
+                                )
+                            )
+            finally:
+                await app_runner.cleanup()
+            return per_machine
+
+        http_scores = asyncio.run(runner())
+        telemetry.FLEET_HEALTH.clear(names)
+        for name in names:
+            rec = arch.read_machine(name)
+            total = np.concatenate(http_scores[name]["total"])
+            tags = np.concatenate(http_scores[name]["tags"])
+            assert rec["total-anomaly-score"].tobytes() == \
+                total.tobytes(), name
+            assert rec["tag-anomaly-scores"].tobytes() == \
+                tags.tobytes(), name

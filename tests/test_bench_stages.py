@@ -15,8 +15,12 @@ def test_default_runs_every_stage_in_priority_order():
         "build", "build_pipeline", "artifact_io", "hot_reload", "serving",
         "serving_precision", "serving_sharded", "serving_openloop",
         "telemetry_overhead", "health_overhead", "cold_start", "refresh",
-        "lstm",
+        "backfill", "lstm",
     ]
+
+
+def test_backfill_stage_selectable():
+    assert bench.parse_stages(["--stage", "backfill"]) == ["backfill"]
 
 
 def test_cold_start_stage_selectable():

@@ -122,6 +122,29 @@ class TestClient:
                 "tag-anomaly-scores", "ct-4") in frame.columns
             assert ("total-anomaly-threshold", "") in frame.columns
 
+    def test_predict_bulk_respects_samples_budget(self, model_dir,
+                                                  monkeypatch):
+        # 6 total columns across the fleet; 120 samples -> 20-row rounds
+        # instead of batch_size=100 — a long range must still cover the
+        # whole period, just over more (smaller) bulk bodies
+        monkeypatch.setenv("GORDO_CLIENT_MAX_BULK_SAMPLES", "120")
+
+        def run(port):
+            return Client("cliproj", port=port, batch_size=100).predict(
+                "2017-12-27T06:00:00Z", "2017-12-28T06:00:00Z"
+            )
+
+        results = _serve_and(model_dir, run)
+        assert len(results) == 2
+        for res in results:
+            assert res.ok, res.error_messages
+            frame = res.predictions
+            assert len(frame) == 145
+            assert frame.index.is_monotonic_increasing
+            assert np.isfinite(
+                frame[("total-anomaly-score", "")].to_numpy()
+            ).all()
+
     def test_predict_forwards(self, model_dir, tmp_path):
         sink = tmp_path / "sink"
 

@@ -1,0 +1,62 @@
+"""Client transport-layer unit tests (fast lane): the bulk-round
+samples budget.
+
+ISSUE 14 satellite: a bulk round's payload spans EVERY machine, so
+``batch_size`` alone bounds only the row axis — a long-time-range
+request against a wide fleet used to pack one giant body through the
+codec.  ``bulk_rows_budget`` shrinks the row slice so no round exceeds
+``GORDO_CLIENT_MAX_BULK_SAMPLES`` total samples.
+"""
+
+import pytest
+
+from gordo_tpu.client.io import (
+    DEFAULT_MAX_BULK_SAMPLES,
+    ENV_MAX_BULK_SAMPLES,
+    bulk_rows_budget,
+    max_bulk_samples,
+)
+
+
+class TestMaxBulkSamples:
+    def test_default(self, monkeypatch):
+        monkeypatch.delenv(ENV_MAX_BULK_SAMPLES, raising=False)
+        assert max_bulk_samples() == DEFAULT_MAX_BULK_SAMPLES
+
+    def test_env_override(self, monkeypatch):
+        monkeypatch.setenv(ENV_MAX_BULK_SAMPLES, "12345")
+        assert max_bulk_samples() == 12345
+
+    @pytest.mark.parametrize("bad", ["not-a-number", "-5", "0", ""])
+    def test_invalid_env_falls_back(self, monkeypatch, bad):
+        monkeypatch.setenv(ENV_MAX_BULK_SAMPLES, bad)
+        assert max_bulk_samples() == DEFAULT_MAX_BULK_SAMPLES
+
+
+class TestBulkRowsBudget:
+    def test_narrow_fleet_keeps_batch_size(self, monkeypatch):
+        monkeypatch.delenv(ENV_MAX_BULK_SAMPLES, raising=False)
+        # 30 total columns: the default budget is far beyond
+        # batch_size rows, so the row-axis contract stands
+        assert bulk_rows_budget(30, 1000) == 1000
+
+    def test_wide_fleet_shrinks_rows(self, monkeypatch):
+        monkeypatch.setenv(ENV_MAX_BULK_SAMPLES, "10000")
+        # 10k machines x 5 tags: 10000 // 50000 -> min 1 row per round
+        assert bulk_rows_budget(50_000, 1000) == 1
+        # 100 columns -> 100 rows per round
+        assert bulk_rows_budget(100, 1000) == 100
+
+    def test_progress_is_always_possible(self, monkeypatch):
+        monkeypatch.setenv(ENV_MAX_BULK_SAMPLES, "1")
+        assert bulk_rows_budget(10_000_000, 1000) == 1
+
+    def test_zero_columns_degenerate(self):
+        assert bulk_rows_budget(0, 250) == 250
+        assert bulk_rows_budget(-3, 250) == 250
+
+    def test_budget_never_exceeded(self, monkeypatch):
+        monkeypatch.setenv(ENV_MAX_BULK_SAMPLES, "7777")
+        for cols in (1, 3, 77, 1000, 7777, 20000):
+            rows = bulk_rows_budget(cols, 10_000)
+            assert rows * cols <= 7777 or rows == 1

@@ -339,3 +339,123 @@ def test_iroc_tag_without_window_samples_yields_empty(tmp_path):
     out = list(provider.load_series(times[2], times[-1], ["present", "early"]))
     assert len(out[0]) > 0
     assert len(out[1]) == 0  # empty series, not a KeyError
+
+
+# -- edge cases: empty frames, NaN runs, duplicate stamps, tz handling --------
+class TestFilterRowsEdgeCases:
+    def test_empty_frame_passes_through(self):
+        df = pd.DataFrame(columns=["a", "b"], dtype=float)
+        out = pandas_filter_rows(df, "`a` > 0")
+        assert out.empty
+        assert list(out.columns) == ["a", "b"]
+
+    def test_empty_frame_with_buffer(self):
+        df = pd.DataFrame(columns=["a"], dtype=float)
+        assert pandas_filter_rows(df, "`a` > 0", buffer_size=3).empty
+
+    def test_nan_rows_are_filtered_not_kept(self):
+        # NaN compares False under eval — a NaN run must drop, never
+        # survive into training
+        df = pd.DataFrame({"a": [1.0, np.nan, np.nan, 2.0, 3.0]})
+        out = pandas_filter_rows(df, "`a` > 0")
+        assert list(out["a"]) == [1.0, 2.0, 3.0]
+
+    def test_buffer_widens_around_nan_runs(self):
+        df = pd.DataFrame({"a": [1.0, 2.0, np.nan, 3.0, 4.0, 5.0]})
+        out = pandas_filter_rows(df, "`a` > 0", buffer_size=1)
+        # the NaN's positional neighbors (rows 1 and 3) drop with it
+        assert list(out["a"]) == [1.0, 4.0, 5.0]
+
+    def test_buffer_larger_than_frame_empties_it(self):
+        df = pd.DataFrame({"a": [np.nan, 1.0, 2.0]})
+        out = pandas_filter_rows(df, "`a` > 0", buffer_size=10)
+        assert out.empty
+
+    def test_all_rows_filtered_keeps_schema(self):
+        df = pd.DataFrame({"a": [-1.0, -2.0], "b": [1.0, 2.0]})
+        out = pandas_filter_rows(df, "`a` > 0")
+        assert out.empty
+        assert list(out.columns) == ["a", "b"]
+
+    def test_duplicate_timestamps_filter_positionally(self):
+        stamp = pd.Timestamp("2020-01-01", tz="UTC")
+        idx = pd.DatetimeIndex([stamp, stamp, stamp + pd.Timedelta("10min")])
+        df = pd.DataFrame({"a": [1.0, -1.0, 2.0]}, index=idx)
+        out = pandas_filter_rows(df, "`a` > 0")
+        # the two rows sharing a stamp filter independently
+        assert list(out["a"]) == [1.0, 2.0]
+        assert out.index[0] == stamp
+
+    def test_duplicate_timestamps_with_buffer(self):
+        stamp = pd.Timestamp("2020-01-01", tz="UTC")
+        idx = pd.DatetimeIndex(
+            [stamp, stamp, stamp + pd.Timedelta("10min"),
+             stamp + pd.Timedelta("20min")]
+        )
+        df = pd.DataFrame({"a": [1.0, -1.0, 2.0, 3.0]}, index=idx)
+        out = pandas_filter_rows(df, "`a` > 0", buffer_size=1)
+        # widening is positional (rolling over rows), so the duplicate
+        # stamp's good twin and the NEXT row drop, not every same-stamp
+        # row by label
+        assert list(out["a"]) == [3.0]
+
+    def test_tz_naive_and_aware_indexes_both_work(self):
+        naive = pd.DataFrame(
+            {"a": [1.0, -1.0]},
+            index=pd.date_range("2020-01-01", periods=2, freq="10min"),
+        )
+        aware = naive.tz_localize("UTC")
+        assert list(pandas_filter_rows(naive, "`a` > 0")["a"]) == [1.0]
+        out = pandas_filter_rows(aware, "`a` > 0", buffer_size=0)
+        assert list(out["a"]) == [1.0]
+        assert out.index.tz is not None
+
+    def test_multiple_expressions_and_semantics(self):
+        df = pd.DataFrame({"a": [1.0, 5.0, np.nan], "b": [1.0, -1.0, 1.0]})
+        out = pandas_filter_rows(df, ["`a` > 0", "`b` > 0"])
+        assert list(out["a"]) == [1.0]
+
+
+class TestSensorTagEdgeCases:
+    def test_empty_tag_list(self):
+        assert normalize_sensor_tags([]) == []
+
+    def test_asset_inherited_by_strings_and_short_lists(self):
+        tags = normalize_sensor_tags(["t1", ["t2"], ("t3",)], asset="plant")
+        assert [t.asset for t in tags] == ["plant"] * 3
+
+    def test_sensor_tag_without_asset_adopts_default(self):
+        bare = SensorTag("t1")
+        (out,) = normalize_sensor_tags([bare], asset="plant")
+        assert out == SensorTag("t1", "plant")
+
+    def test_sensor_tag_with_asset_keeps_its_own(self):
+        tagged = SensorTag("t1", "rig")
+        (out,) = normalize_sensor_tags([tagged], asset="plant")
+        assert out.asset == "rig"
+
+    def test_dict_without_name_raises(self):
+        from gordo_tpu.dataset.sensor_tag import SensorTagNormalizationError
+
+        with pytest.raises(SensorTagNormalizationError, match="name"):
+            normalize_sensor_tags([{"asset": "plant"}])
+
+    def test_overlong_list_raises(self):
+        from gordo_tpu.dataset.sensor_tag import SensorTagNormalizationError
+
+        with pytest.raises(SensorTagNormalizationError, match="must be"):
+            normalize_sensor_tags([["a", "b", "c"]])
+
+    def test_unnormalizable_type_raises(self):
+        from gordo_tpu.dataset.sensor_tag import SensorTagNormalizationError
+
+        with pytest.raises(SensorTagNormalizationError):
+            normalize_sensor_tags([42])
+
+    def test_to_list_of_strings_round_trip(self):
+        from gordo_tpu.dataset.sensor_tag import to_list_of_strings
+
+        tags = normalize_sensor_tags(
+            [{"name": "t1", "asset": "a"}, "t2", ["t3", "b"]]
+        )
+        assert to_list_of_strings(tags) == ["t1", "t2", "t3"]

@@ -589,3 +589,115 @@ class TestRefreshAcceptance:
         assert after["outcome"] == "idle"
         assert self.SHIFTED not in after["drifting"]
         reg.clear(names)
+
+
+@pytest.mark.slow
+class TestRefreshLongHorizonSoak:
+    """ISSUE 14 satellite: the refresh plane under sustained drift — 20
+    compressed drift→refresh→flip cycles against ONE live serving
+    collection.  Pins the long-horizon invariants a single-cycle test
+    can't: generations stay strictly monotone, the persisted selector
+    state stays bounded (it must not accrete per-cycle entries), no
+    machine is ever quarantined, and the live collection follows every
+    flip by delta reload alone (no restart, no full rescan)."""
+
+    CYCLES = 20
+
+    def _soak_yaml(self):
+        machines = "\n".join(
+            f"""
+  - name: soak-{i}
+    dataset:
+      type: RandomDataset
+      tags: [soak{i}-a, soak{i}-b, soak{i}-c]
+      train_start_date: "2017-12-25T06:00:00Z"
+      train_end_date: "2017-12-26T06:00:00Z"
+"""
+            for i in range(2)
+        )
+        return f"""
+machines:{machines}
+globals:
+  model:
+    gordo_tpu.anomaly.diff.DiffBasedAnomalyDetector:
+      base_estimator:
+        gordo_tpu.pipeline.Pipeline:
+          steps:
+            - gordo_tpu.ops.scalers.MinMaxScaler
+            - gordo_tpu.models.estimator.AutoEncoder:
+                kind: feedforward_hourglass
+                epochs: 2
+                batch_size: 64
+"""
+
+    def test_twenty_cycle_soak(self, tmp_path, monkeypatch):
+        from gordo_tpu.builder import build_project
+        from gordo_tpu.serve.server import ModelCollection
+        from gordo_tpu.workflow import NormalizedConfig, load_machine_config
+
+        # the soak drives rebuild mechanics, not loss quality — a huge
+        # parity factor keeps every warm rebuild on the warm path
+        monkeypatch.setenv("GORDO_REFRESH_PARITY_FACTOR", "1e6")
+        out = str(tmp_path / "models")
+        cfg = NormalizedConfig(
+            load_machine_config(self._soak_yaml()), "soakproj"
+        )
+        names = [m.name for m in cfg.machines]
+        result = build_project(cfg.machines, out, max_bucket_size=2)
+        assert not result.failed
+        generation = artifacts.read_generation(out)
+
+        reg = telemetry.FLEET_HEALTH
+        reg.clear(names)
+        coll = ModelCollection.from_directory(out, project="soakproj")
+        rcfg = RefreshConfig(
+            machines=cfg.machines, output_dir=out,
+            hysteresis=1, cooldown_seconds=0,
+        )
+        state_file = refresh_loop.state_path(out)
+        state_size_early = None
+
+        for cycle in range(self.CYCLES):
+            target = names[cycle % len(names)]
+            statuses = {
+                n: ("drifting" if n == target else "ok") for n in names
+            }
+            fh.write_rollup(out, _health_doc(statuses))
+
+            # the CronJob face: a fresh selector per cycle, streaks and
+            # cooldowns riding state.json — the growth-bounded artifact
+            with reg.suspended():
+                summary = refresh_once(rcfg)
+            assert summary["outcome"] == "rebuilt", (cycle, summary)
+            assert summary["rebuilt"] == [target], (cycle, summary)
+            assert not summary["failed"], (cycle, summary)
+
+            # strictly monotone generations, one flip per cycle
+            assert summary["generation"] == generation + 1, (cycle, summary)
+            generation = summary["generation"]
+
+            # the live collection follows by delta reload alone
+            changes = coll.maybe_delta_reload()
+            assert changes["reloaded"] == [target], (cycle, changes)
+            assert changes["added"] == changes["removed"] == []
+            assert coll.generation == generation
+            assert coll.quarantined == {}, (cycle, coll.quarantined)
+
+            if cycle == 1:
+                state_size_early = os.path.getsize(state_file)
+
+        # bounded state: one entry per fleet machine, not per cycle —
+        # the file must not grow past its steady-state size (small slack
+        # for float-digit jitter in last_rebuild timestamps)
+        with open(state_file) as fh_state:
+            state = json.load(fh_state)
+        assert sorted(state["machines"]) == sorted(names)
+        final_size = os.path.getsize(state_file)
+        assert final_size <= state_size_early + 64, (
+            state_size_early, final_size,
+        )
+
+        # the fleet survived 20 rebuild generations intact
+        _, refs = artifacts.discover(out, quarantine=True)
+        assert sorted(r.name for r in refs) == sorted(names)
+        reg.clear(names)
