@@ -2569,6 +2569,288 @@ def bench_backfill(out: dict) -> None:
         stop(procs)
 
 
+def bench_serving_wire(out: dict) -> None:
+    """ISSUE 15 acceptance: the GSB1 columnar bulk wire vs the r18
+    msgpack bulk wire, end-to-end through the real ``Client`` against a
+    REAL ``run-server`` subprocess.
+
+    Protocol (docs/perf.md "Bulk wire"):
+
+    - one trained machine replicated across N names (512), v2 packs,
+      identical tag lists — the same fleet the backfill bench uses, so
+      the comparison is wire codec + client materialization, not model
+      or provider variance;
+    - both legs are the actual ``Client`` (``use_bulk=True``) replaying
+      a range and COUNTING every per-tag score sample it received:
+
+      * ``columnar``: the r19 default — ``Accept`` negotiates GSB1,
+        the client decodes zero-copy ``np.frombuffer`` views and the
+        samples are counted off the LAZY column access (no DataFrame
+        is ever built — the per-machine frame materialization the r18
+        profile showed at 35x the raw wire floor is simply not paid);
+      * ``msgpack``: ``use_columnar=False``, the r18 wire — per-machine
+        DataFrames materialized via ``res.predictions``, exactly how
+        BENCH_r18's ``backfill_512_http_replay_samples_per_sec``
+        (264,367/s) was measured.
+
+      The msgpack leg replays FEWER chunks (rates are normalized to
+      samples/s) so the slow leg fits the stage budget;
+    - legs are interleaved (C M C M ...) and each wire reports its
+      best-of-``BENCH_WIRE_REPEATS`` — interleaving keeps slow drift
+      (page cache, CPU thermal) from biasing one wire;
+    - an un-timed warmup leg per wire lands the server's stacked-
+      program compiles and both codec paths before any clock starts;
+    - attestation: ``serving_wire_value_identity_ok`` — one slab posted
+      twice to the same server, once per ``Accept``; every float array
+      in the decoded responses must match BITWISE (fp32), scalars
+      exactly.  The columnar wire is a relayout, not a requantization;
+    - gate: columnar client e2e samples/s >= 3x the r18 msgpack
+      baseline at 512 machines on CPU.
+    """
+    import urllib.request
+
+    import pandas as pd
+
+    from gordo_tpu.client import Client
+    from gordo_tpu.serve import codec
+
+    n_machines = int(os.environ.get("BENCH_WIRE_MACHINES", "512"))
+    rows = int(os.environ.get("BENCH_WIRE_ROWS", "2048"))
+    col_chunks = int(os.environ.get("BENCH_WIRE_CHUNKS", "8"))
+    mp_chunks = int(os.environ.get("BENCH_WIRE_MSGPACK_CHUNKS", "2"))
+    repeats = int(os.environ.get("BENCH_WIRE_REPEATS", "2"))
+    out["cpu_cores"] = os.cpu_count()
+
+    model, metadata = _build_serving_model()
+    resolution = (metadata.get("dataset") or {}).get("resolution", "10min")
+    step = pd.tseries.frequencies.to_offset(resolution)
+    names = [f"wire-{i:05d}" for i in range(n_machines)]
+    art_dir = _backfill_fleet_dir(model, metadata, names)
+
+    procs: "list[subprocess.Popen]" = []
+    logs: "list[str]" = []
+
+    def free_port() -> int:
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    def spawn(port: int) -> subprocess.Popen:
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.pop("GORDO_SERVE_SHARD", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        log_path = os.path.join(art_dir, f"server-{port}.log")
+        logs.append(log_path)
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "gordo_tpu.cli.cli", "run-server",
+                "--model-dir", art_dir, "--project", "bench",
+                "--host", "127.0.0.1", "--port", str(port),
+                "--rescan-interval", "0",
+            ],
+            env=env,
+            stdout=open(log_path, "w"), stderr=subprocess.STDOUT,
+        )
+        procs.append(proc)
+        return proc
+
+    def wait_ready(port: int, timeout_s: float) -> None:
+        deadline = time.monotonic() + timeout_s
+        url = f"http://127.0.0.1:{port}/healthz"
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(url, timeout=2) as resp:
+                    if resp.status == 200:
+                        return
+            except Exception:
+                time.sleep(0.25)
+        raise RuntimeError(f"wire server on :{port} never became ready")
+
+    def stop(to_stop: "list[subprocess.Popen]") -> None:
+        for proc in to_stop:
+            proc.terminate()
+        for proc in to_stop:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    start = pd.Timestamp("2024-01-01T00:00:00Z")
+
+    def leg(port: int, columnar: bool, chunks: int, timed: bool) -> dict:
+        """One full client replay over ``chunks`` windows; per-tag score
+        samples counted off the wire-appropriate access path.  The clock
+        covers replay AND consumption: the lazy client defers frame work
+        to first access, so stopping at ``predict()`` would undercharge
+        the msgpack leg exactly the cost this stage exists to measure."""
+        client = Client(
+            "bench", port=port, use_bulk=True, batch_size=rows,
+            use_columnar=columnar,
+        )
+        end = start + step * (rows * chunks)
+        t0 = time.perf_counter()
+        results = client.predict(str(start), str(end))
+        samples = 0
+        for res in results:
+            if not res.ok:
+                raise RuntimeError(
+                    f"wire replay failed for {res.name}: "
+                    f"{res.error_messages}"
+                )
+            if columnar:
+                # lazy column access — no DataFrame on this path, which
+                # IS the measured difference
+                samples += int(
+                    np.asarray(res.raw.column("tag-anomaly-scores")).size
+                )
+            else:
+                frame = res.predictions
+                n_tag_cols = sum(
+                    1 for c in frame.columns
+                    if c[0] == "tag-anomaly-scores"
+                )
+                samples += len(frame) * n_tag_cols
+        dt = time.perf_counter() - t0
+        if timed:
+            log(f"serving_wire {'columnar' if columnar else 'msgpack'} "
+                f"leg: {samples / dt:,.0f} samples/s "
+                f"({samples:,} samples / {dt:.1f}s, {chunks} chunks)")
+        return {
+            "samples": samples,
+            "seconds": dt,
+            "samples_per_sec": samples / dt if dt > 0 else 0.0,
+        }
+
+    def value_identity(port: int) -> bool:
+        """One slab, posted twice; the two wires must decode to the same
+        fp32 BITS for every array and the same python floats."""
+        n_tags = len((metadata.get("dataset") or {}).get("tag_list") or [])
+        rng = np.random.default_rng(19)
+        slab = rng.standard_normal(
+            (min(rows, 512), max(1, n_tags))
+        ).astype(np.float32)
+        subset = names[: min(8, len(names))]
+        body = codec.packb({"X": {n: slab for n in subset}})
+        url = (
+            f"http://127.0.0.1:{port}"
+            "/gordo/v0/bench/_bulk/anomaly/prediction"
+        )
+
+        def post(accept: str) -> bytes:
+            req = urllib.request.Request(
+                url, data=body, method="POST",
+                headers={
+                    "Content-Type": codec.MSGPACK_CONTENT_TYPE,
+                    "Accept": accept,
+                },
+            )
+            with urllib.request.urlopen(req, timeout=300) as resp:
+                if resp.status != 200:
+                    raise RuntimeError(f"wire identity -> {resp.status}")
+                return resp.read()
+
+        mp_data = codec.unpackb(post(codec.MSGPACK_CONTENT_TYPE))["data"]
+        col_data = codec.decode_columnar(post(
+            f"{codec.COLUMNAR_CONTENT_TYPE}, {codec.MSGPACK_CONTENT_TYPE}"
+        ))["data"]
+        if sorted(mp_data) != sorted(col_data):
+            return False
+        for name, ref in mp_data.items():
+            got = col_data[name]
+            if sorted(got) != sorted(ref):
+                return False
+            for key, val in ref.items():
+                if isinstance(val, np.ndarray):
+                    if got[key].dtype != val.dtype:
+                        return False
+                    if got[key].tobytes() != val.tobytes():
+                        return False
+                elif got[key] != val:
+                    return False
+        return True
+
+    server = None
+    try:
+        port = free_port()
+        server = spawn(port)
+        wait_ready(port, 240.0)
+
+        # identity first: it doubles as a codec-path warmup on both wires
+        out["serving_wire_value_identity_ok"] = value_identity(port)
+
+        # un-timed warmup legs land stacked-program compiles + budget-
+        # shaped bodies for both wires
+        leg(port, columnar=True, chunks=1, timed=False)
+        leg(port, columnar=False, chunks=1, timed=False)
+
+        col_best: "dict | None" = None
+        mp_best: "dict | None" = None
+        for _ in range(max(1, repeats)):
+            c = leg(port, columnar=True, chunks=col_chunks, timed=True)
+            m = leg(port, columnar=False, chunks=mp_chunks, timed=True)
+            if col_best is None or c["samples_per_sec"] > col_best["samples_per_sec"]:
+                col_best = c
+            if mp_best is None or m["samples_per_sec"] > mp_best["samples_per_sec"]:
+                mp_best = m
+
+        col_sps = col_best["samples_per_sec"]
+        mp_sps = mp_best["samples_per_sec"]
+        out["serving_wire_machines"] = n_machines
+        out["serving_wire_chunk_rows"] = rows
+        out["serving_wire_columnar_chunks"] = col_chunks
+        out["serving_wire_msgpack_chunks"] = mp_chunks
+        out["serving_wire_columnar_samples_per_sec"] = round(col_sps)
+        out["serving_wire_columnar_samples"] = col_best["samples"]
+        out["serving_wire_columnar_seconds"] = round(col_best["seconds"], 3)
+        out["serving_wire_msgpack_samples_per_sec"] = round(mp_sps)
+        out["serving_wire_msgpack_samples"] = mp_best["samples"]
+        out["serving_wire_msgpack_seconds"] = round(mp_best["seconds"], 3)
+        out["serving_wire_speedup_vs_msgpack"] = (
+            col_sps / mp_sps if mp_sps > 0 else 0.0
+        )
+        out["serving_wire_r18_baseline_samples_per_sec"] = (
+            R18_BULK_REPLAY_SAMPLES_PER_SEC
+        )
+        out["serving_wire_vs_r18_baseline"] = round(
+            col_sps / R18_BULK_REPLAY_SAMPLES_PER_SEC, 3
+        )
+        out["serving_wire_ge_3x_r18_ok"] = (
+            col_sps >= 3.0 * R18_BULK_REPLAY_SAMPLES_PER_SEC
+        )
+        log(f"serving_wire gate: columnar {col_sps:,.0f}/s vs r18 "
+            f"msgpack baseline {R18_BULK_REPLAY_SAMPLES_PER_SEC:,}/s -> "
+            f"{col_sps / R18_BULK_REPLAY_SAMPLES_PER_SEC:.2f}x "
+            f"(>= 3x: "
+            f"{'PASS' if out['serving_wire_ge_3x_r18_ok'] else 'FAIL'}); "
+            f"in-run msgpack {mp_sps:,.0f}/s -> "
+            f"{out['serving_wire_speedup_vs_msgpack']:.2f}x")
+        out["serving_wire_speedup_vs_msgpack"] = round(
+            out["serving_wire_speedup_vs_msgpack"], 3
+        )
+    except Exception:
+        for log_path in logs:
+            try:
+                with open(log_path) as fh:
+                    tail = fh.read()[-2000:]
+                if tail:
+                    log(f"--- {log_path} tail ---\n{tail}")
+            except OSError:
+                pass
+        raise
+    finally:
+        if server is not None:
+            stop([server])
+        shutil.rmtree(art_dir, ignore_errors=True)
+
+
+#: BENCH_r18.json backfill_512_http_replay_samples_per_sec — the msgpack
+#: bulk client-replay rate the r19 columnar wire is gated against
+R18_BULK_REPLAY_SAMPLES_PER_SEC = 264367
+
+
 def init_devices(attempts: int = 5, backoff_s: float = 2.0):
     """Initialize the jax backend with bounded retry.
 
@@ -2691,8 +2973,8 @@ def run_stage_bounded(
 #: costs the least important remaining numbers)
 STAGES = ("build", "build_pipeline", "artifact_io", "hot_reload",
           "serving", "serving_precision", "serving_sharded",
-          "serving_openloop", "telemetry_overhead", "health_overhead",
-          "cold_start", "refresh", "backfill", "lstm")
+          "serving_wire", "serving_openloop", "telemetry_overhead",
+          "health_overhead", "cold_start", "refresh", "backfill", "lstm")
 
 
 def parse_cli(argv: "list[str]") -> "tuple[list[str], int | None]":
@@ -2829,6 +3111,10 @@ def main(argv: "list[str] | None" = None) -> None:
         "serving_sharded": (
             lambda: bench_serving_sharded(out),
             lambda: min(remaining() * 0.7, 600),
+        ),
+        "serving_wire": (
+            lambda: bench_serving_wire(out),
+            lambda: min(remaining() * 0.8, 900),
         ),
         "serving_openloop": (
             lambda: bench_serving_openloop(out),

@@ -106,9 +106,11 @@ async def request_json(
 ) -> Dict[str, Any]:
     """``method url`` → parsed body with jittered exponential-backoff retry.
 
-    Responses decode by content type: ``application/x-msgpack`` through the
-    binary codec (array leaves come back as ndarrays), anything else as
-    JSON.
+    Responses decode by content type: ``application/x-gordo-columnar``
+    through the GSB1 block codec (array leaves come back as ZERO-COPY
+    ``np.frombuffer`` views into the response body — no per-machine
+    splitting or copying), ``application/x-msgpack`` through the binary
+    codec (array leaves come back as ndarrays), anything else as JSON.
 
     Every request carries the context's trace id in the
     ``X-Gordo-Trace-Id`` header (minted here when the caller hasn't bound
@@ -168,6 +170,8 @@ async def request_json(
                     raise exc
                 from gordo_tpu.serve import codec
 
+                if resp.content_type == codec.COLUMNAR_CONTENT_TYPE:
+                    return codec.decode_columnar(await resp.read())
                 if resp.content_type == codec.MSGPACK_CONTENT_TYPE:
                     return codec.unpackb(await resp.read())
                 return await resp.json()
@@ -248,6 +252,42 @@ async def post_msgpack(
         headers={
             "Content-Type": codec.MSGPACK_CONTENT_TYPE,
             "Accept": codec.MSGPACK_CONTENT_TYPE,
+        },
+        **kw,
+    )
+
+
+async def post_bulk(
+    session: aiohttp.ClientSession,
+    url: str,
+    payload: Dict[str, Any],
+    *,
+    columnar: bool = True,
+    **kw,
+) -> Dict[str, Any]:
+    """POST a msgpack body and negotiate the GSB1 columnar response
+    (``Accept: application/x-gordo-columnar, application/x-msgpack``):
+    stacked bulk results arrive as contiguous blocks decoded into
+    zero-copy views — the ~35x frame-materialization gap BENCH_r18
+    measured lived in the per-machine split/copy this skips.  Servers
+    that predate the block codec match the msgpack fallback in the same
+    header, so the round degrades transparently; ``columnar=False``
+    pins plain msgpack (parity tooling, old-wire comparisons)."""
+    from gordo_tpu.serve import codec
+
+    accept = (
+        f"{codec.COLUMNAR_CONTENT_TYPE}, {codec.MSGPACK_CONTENT_TYPE}"
+        if columnar
+        else codec.MSGPACK_CONTENT_TYPE
+    )
+    return await request_json(
+        session,
+        "POST",
+        url,
+        data=codec.packb(payload),
+        headers={
+            "Content-Type": codec.MSGPACK_CONTENT_TYPE,
+            "Accept": accept,
         },
         **kw,
     )

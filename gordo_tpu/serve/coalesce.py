@@ -738,7 +738,15 @@ class CoalescingScorer:
         pending: Any,
     ) -> None:
         """Assemble per-machine results (host-side numpy slicing) and
-        resolve the round's futures — off the drain thread."""
+        resolve the round's futures — off the drain thread.
+
+        This stays the NON-columnar ``assemble``: a coalesced round
+        fans out to many single-machine responses, each negotiated and
+        encoded for its own requester, so the per-machine split happens
+        here regardless of wire format.  The GSB1 columnar path
+        (``assemble_columnar`` + ``encode_columnar``) belongs to the
+        ``_bulk`` route, which bypasses the coalescer entirely — one
+        requester consumes the whole stacked result."""
         try:
             assemble = getattr(pending, "assemble", None)
             out = assemble() if assemble is not None else pending

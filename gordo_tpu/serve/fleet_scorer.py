@@ -839,6 +839,73 @@ class FleetDispatch:
                 self.results[name] = res
         return self.results
 
+    def assemble_columnar(self) -> "codec.ColumnarResult":
+        """The columnar sibling of :meth:`assemble`: keep the stacked
+        host outputs STACKED and return a :class:`codec.ColumnarResult`
+        of per-bucket blocks plus a (machine → block/slot/row-extent)
+        map, instead of splitting into per-machine dicts.
+
+        The blocks are zero-copy views into the dispatch outputs (a
+        leading-slot prefix of a C-contiguous array is still
+        contiguous), so the bulk encode path — ``encode_columnar`` over
+        this result — never materializes a per-machine array.  Error
+        and fallback machines (everything already final in
+        ``self.results``) ride the result's ``rest`` dict with exact
+        msgpack semantics.  Value parity with :meth:`assemble` is
+        bitwise: both slice the same stacked host bytes.  Fleet-health
+        sketches are recorded here exactly as ``assemble`` does, so the
+        drift plane sees the same stream regardless of wire format.
+        """
+        from gordo_tpu import telemetry
+        from gordo_tpu.serve import codec
+
+        pending, self._pending = self._pending, []
+        blocks: List[np.ndarray] = []
+        machines: Dict[str, Dict[str, Tuple[int, int, Optional[int]]]] = {}
+        scalar_blocks: set = set()
+        for out, bucket, slots in pending:
+            # ship only the occupied slot prefix: subset dispatches use a
+            # contiguous prefix and full dispatches pad with duplicate
+            # slot-0 rows, so wire waste stays bounded and no padding slot
+            # carries anything a real slot doesn't
+            n_slots = max(slot for _, slot, _, _ in slots) + 1
+            key_block: Dict[str, int] = {}
+            for k, v in out.items():
+                key_block[k] = len(blocks)
+                blocks.append(np.asarray(v)[:n_slots])
+            thr_block = agg_block = None
+            if bucket.with_thresholds:
+                thr_block = len(blocks)
+                blocks.append(np.asarray(bucket.thresholds_np))
+                agg_block = len(blocks)
+                blocks.append(np.asarray(bucket.agg_thresholds_np))
+                # decodes to a python float — dtype= must not cast it
+                scalar_blocks.add(agg_block)
+            total_block = key_block.get("total-anomaly-score")
+            for name, slot, stack_pos, n_valid in slots:
+                entry: Dict[str, Tuple[int, int, Optional[int]]] = {
+                    k: (b, slot, n_valid) for k, b in key_block.items()
+                }
+                if thr_block is not None:
+                    entry["tag-anomaly-thresholds"] = (
+                        thr_block, stack_pos, None,
+                    )
+                    entry["total-anomaly-threshold"] = (
+                        agg_block, stack_pos, None,
+                    )
+                machines[name] = entry
+                telemetry.FLEET_HEALTH.record(
+                    name,
+                    None if total_block is None
+                    else blocks[total_block][slot][:n_valid],
+                )
+        return codec.ColumnarResult(
+            blocks=blocks,
+            machines=machines,
+            scalar_blocks=scalar_blocks,
+            rest=dict(self.results),
+        )
+
 
 class FleetScorer:
     """Serve MANY machines' anomaly scoring as stacked device programs.

@@ -89,6 +89,8 @@ METRICS_CONTENT_TYPE = "text/plain"
 
 
 def _codec_label(content_type: Optional[str]) -> str:
+    if content_type == codec.COLUMNAR_CONTENT_TYPE:
+        return "columnar"
     if content_type == codec.MSGPACK_CONTENT_TYPE:
         return "msgpack"
     if content_type == "application/json":
@@ -862,9 +864,12 @@ async def _read_and_parse_single(request: web.Request, entry: "ModelEntry"):
 async def _respond(
     request: web.Request, obj: Any, status: int = 200
 ) -> web.Response:
-    """Encode a scoring response: msgpack when the client asks
-    (``Accept: application/x-msgpack`` — raw array buffers, memcpy speed;
-    the bundled client uses it for bulk), JSON otherwise with ndarray
+    """Encode a scoring response: GSB1 columnar blocks when the client
+    lists ``Accept: application/x-gordo-columnar`` (the bulk route hands
+    this path a still-stacked ``ColumnarResult`` — zero per-machine
+    splitting on either end of the wire), msgpack when the client asks
+    (``Accept: application/x-msgpack`` — raw array buffers, memcpy speed),
+    JSON otherwise with ndarray
     leaves encoded by the native fastjson kernel (~13x stdlib json, which
     was the measured HTTP serving ceiling — see ``serve/codec.py``).
     An ``Accept`` ``dtype=`` media parameter selects the wire float
@@ -1177,6 +1182,24 @@ async def bulk_anomaly_prediction(request: web.Request) -> web.Response:
         X_by: Dict[str, np.ndarray] = {}
         idx_by: Dict[str, pd.DatetimeIndex] = {}
         errors: Dict[str, Dict[str, str]] = {}
+        # bulk clients replay one fetch window across the fleet, so the
+        # per-machine index lists are usually IDENTICAL — parse each
+        # distinct list once (list equality is a C compare; re-running
+        # pd.to_datetime per machine was the parse loop's hottest path)
+        idx_cache: Dict[tuple, "tuple[list, pd.DatetimeIndex]"] = {}
+
+        def parse_index_cached(raw: Any, n_rows: int):
+            key = None
+            if isinstance(raw, list) and raw and len(raw) == n_rows:
+                key = (raw[0], raw[-1], len(raw))
+                hit = idx_cache.get(key)
+                if hit is not None and hit[0] == raw:
+                    return hit[1]
+            index = parse_index({"index": raw}, n_rows)
+            if key is not None and index is not None:
+                idx_cache[key] = (raw, index)
+            return index
+
         for name, rows in payload["X"].items():
             entry = collection.get(name)
             try:
@@ -1200,9 +1223,7 @@ async def bulk_anomaly_prediction(request: web.Request) -> web.Response:
                 X = parse_X({"X": rows}, entry.tags)
                 _validate_width(X, entry)
                 if isinstance(indices, dict) and name in indices:
-                    index = parse_index(
-                        {"index": indices[name]}, X.shape[0]
-                    )
+                    index = parse_index_cached(indices[name], X.shape[0])
                     if index is not None:
                         idx_by[name] = index
                 X_by[name] = X
@@ -1223,13 +1244,27 @@ async def bulk_anomaly_prediction(request: web.Request) -> web.Response:
     deadline = request.get(DEADLINE_KEY)
     if deadline is not None and time.monotonic() >= deadline:
         return _deadline_expired_response("before bulk dispatch")
+    # a columnar client keeps the stacked dispatch output STACKED: decide
+    # the assembly mode from Accept BEFORE dispatch so the hot path never
+    # splits per machine just to re-glue the pieces at encode time
+    columnar = codec.wants_columnar(request.headers.get("Accept"))
     try:
         # resolve the lazy scorer inside the executor too: first-call param
         # stacking for a large project must not stall the accept loop
         with telemetry.span("server.bulk", machines=len(X_by_name)):
-            out = await loop.run_in_executor(
-                None, lambda: collection.fleet_scorer.score_all(X_by_name)
-            )
+            if columnar:
+                col = await loop.run_in_executor(
+                    None,
+                    lambda: collection.fleet_scorer.dispatch_all(
+                        X_by_name
+                    ).assemble_columnar(),
+                )
+                out = col.rest
+            else:
+                col = None
+                out = await loop.run_in_executor(
+                    None, lambda: collection.fleet_scorer.score_all(X_by_name)
+                )
     except Exception as exc:
         logger.exception("Bulk anomaly scoring failed")
         return web.json_response({"error": str(exc)}, status=500)
@@ -1239,24 +1274,48 @@ async def bulk_anomaly_prediction(request: web.Request) -> web.Response:
         name: {k: v for k, v in res.items() if k != "client-error"}
         for name, res in out.items()
     }
+    # the parse loop dedupes equal indices to shared DatetimeIndex
+    # objects, so one (index, n_out, resolution) rendering serves every
+    # machine that shares the window — the per-machine isoformat loops
+    # were, at fleet width, a bigger bill than the scoring itself
+    tc_cache: Dict[tuple, Dict[str, List[str]]] = {}
+
+    def cached_time_columns(name: str, n_out: int) -> Dict[str, List[str]]:
+        entry = collection.get(name)
+        resolution = entry.resolution if entry is not None else None
+        index = index_by_name[name]
+        key = (id(index), n_out, resolution)
+        cols = tc_cache.get(key)
+        if cols is None:
+            cols = time_columns(index, n_out, resolution)
+            tc_cache[key] = cols
+        return cols
+
     for name, res in data.items():
         if name in index_by_name and "model-output" in res:
-            entry = collection.get(name)
-            res.update(
-                time_columns(
-                    index_by_name[name],
-                    len(res["model-output"]),
-                    entry.resolution if entry is not None else None,
+            res.update(cached_time_columns(name, len(res["model-output"])))
+    if col is not None:
+        # stacked machines never left the blocks; their time-column
+        # partials ride the rest blob and merge client-side on decode
+        for name in index_by_name:
+            rows = col.rows(name)
+            if rows:
+                data.setdefault(name, {}).update(
+                    cached_time_columns(name, rows)
                 )
-            )
     data.update(machine_errors)
-    return await _respond(
-        request,
-        {
+    if col is not None:
+        col.rest = data
+        payload_obj: Any = {
+            "data": col,
+            "time-seconds": round(time.perf_counter() - t0, 6),
+        }
+    else:
+        payload_obj = {
             "data": data,
             "time-seconds": round(time.perf_counter() - t0, 6),
-        },
-    )
+        }
+    return await _respond(request, payload_obj)
 
 
 async def download_model(request: web.Request) -> web.Response:

@@ -59,7 +59,8 @@ D2H_ASARRAY_MODULES = {"np", "numpy"}
 #: stay allowed.  ``# noqa`` opts a line out, as elsewhere.
 HOST_MATH_FORBIDDEN_SCOPES = {
     "scorer.py": {"_run", "predict", "anomaly_arrays"},
-    "fleet_scorer.py": {"score", "score_subset", "assemble"},
+    "fleet_scorer.py": {"score", "score_subset", "assemble",
+                        "assemble_columnar"},
 }
 HOST_MATH_MODULES = {"np", "numpy"}
 HOST_MATH_CALLS = {
@@ -69,6 +70,28 @@ HOST_MATH_CALLS = {
     "dot", "einsum",
 }
 SERVE_DIR = os.path.join("gordo_tpu", "serve")
+
+#: bulk-wire hot-loop contract (r19): the bulk encode/decode paths move
+#: stacked blocks and (machine → extent) maps — building a per-machine
+#: pandas frame inside them reintroduces the ~35x frame-materialization
+#: wall BENCH_r18 measured (264k samples/s against a 9.4M/s wire floor).
+#: Frames belong behind the client's LazyFrame (first-access
+#: materialization), never inside the bulk request/response loops.
+#: ``# noqa`` opts a line out, as elsewhere.
+BULK_FRAME_FORBIDDEN_SCOPES = {
+    "server.py": {"bulk_anomaly_prediction"},
+    "codec.py": {"encode_columnar", "decode_columnar"},
+    "fleet_scorer.py": {"assemble", "assemble_columnar"},
+    "client.py": {"_predict_bulk"},
+}
+BULK_FRAME_MODULES = {"pd", "pandas"}
+BULK_FRAME_CALLS = {"DataFrame", "concat"}
+#: bare-name calls that materialize a frame (the client's own builder)
+BULK_FRAME_NAMES = {"DataFrame", "_frame_from_payload"}
+BULK_FRAME_DIRS = (
+    os.path.join("gordo_tpu", "serve"),
+    os.path.join("gordo_tpu", "client"),
+)
 
 #: the ONE module family allowed to touch jax.jit directly: the compile
 #: plane (gordo_tpu/compile/) owns every jitted program in the stack —
@@ -630,6 +653,53 @@ def _host_math_findings(
     return findings
 
 
+def _bulk_frame_findings(
+    path: str, tree: ast.AST, noqa_lines: set
+) -> List[Finding]:
+    """Flag per-machine pandas frame construction (``pd.DataFrame`` /
+    ``pd.concat`` / ``_frame_from_payload``) inside the bulk wire hot
+    loops (``BULK_FRAME_FORBIDDEN_SCOPES``): the server bulk handler,
+    the GSB1 encode/decode pair, the stacked assemblers and the
+    client's bulk reassembly all move raw blocks — frame building is
+    the r18 35x wall and lives behind the LazyFrame's first-access
+    materialization instead."""
+    norm = os.path.normpath(path)
+    if not any(d in norm for d in BULK_FRAME_DIRS):
+        return []
+    scopes = BULK_FRAME_FORBIDDEN_SCOPES.get(os.path.basename(norm))
+    if not scopes:
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name not in scopes:
+            continue
+        for call in ast.walk(node):
+            if not isinstance(call, ast.Call):
+                continue
+            func = call.func
+            bad = None
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr in BULK_FRAME_CALLS
+                and isinstance(func.value, ast.Name)
+                and func.value.id in BULK_FRAME_MODULES
+            ):
+                bad = f"{func.value.id}.{func.attr}"
+            elif isinstance(func, ast.Name) and func.id in BULK_FRAME_NAMES:
+                bad = func.id
+            if bad and call.lineno not in noqa_lines:
+                findings.append(
+                    (path, call.lineno,
+                     f"per-machine frame construction {bad}() inside "
+                     f"{node.name}() — the bulk wire hot loop ships raw "
+                     "blocks; materialize frames behind LazyFrame.frame "
+                     "(first access), never per chunk in the loop")
+                )
+    return findings
+
+
 def lint_file(path: str) -> List[Finding]:
     findings: List[Finding] = []
     with open(path, encoding="utf-8") as f:
@@ -675,6 +745,7 @@ def lint_file(path: str) -> List[Finding]:
     findings.extend(_faults_findings(path, tree, noqa_lines))
     findings.extend(_swallow_findings(path, tree, noqa_lines))
     findings.extend(_host_math_findings(path, tree, noqa_lines))
+    findings.extend(_bulk_frame_findings(path, tree, noqa_lines))
     findings.extend(_shard_findings(path, tree, noqa_lines))
     findings.extend(_jit_findings(path, tree, noqa_lines))
     findings.extend(_artifact_path_findings(path, tree, noqa_lines))

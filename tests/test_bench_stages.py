@@ -13,9 +13,9 @@ import bench
 def test_default_runs_every_stage_in_priority_order():
     assert bench.parse_stages([]) == [
         "build", "build_pipeline", "artifact_io", "hot_reload", "serving",
-        "serving_precision", "serving_sharded", "serving_openloop",
-        "telemetry_overhead", "health_overhead", "cold_start", "refresh",
-        "backfill", "lstm",
+        "serving_precision", "serving_sharded", "serving_wire",
+        "serving_openloop", "telemetry_overhead", "health_overhead",
+        "cold_start", "refresh", "backfill", "lstm",
     ]
 
 
@@ -29,6 +29,12 @@ def test_cold_start_stage_selectable():
 
 def test_refresh_stage_selectable():
     assert bench.parse_stages(["--stage", "refresh"]) == ["refresh"]
+
+
+def test_serving_wire_stage_selectable():
+    assert bench.parse_stages(["--stage", "serving_wire"]) == [
+        "serving_wire"
+    ]
 
 
 def test_artifact_io_stage_selectable():
@@ -118,3 +124,27 @@ def test_cold_start_stage_smoke(monkeypatch):
     assert out["cold_start_cache_hit_metrics"], (
         "persistent-cache hits must be attested in the child's exposition"
     )
+
+
+@pytest.mark.slow
+def test_serving_wire_stage_smoke(monkeypatch):
+    """The CI slow-lane serving_wire smoke (ISSUE 15 satellite): a tiny
+    fleet, one chunk per leg — the stage must produce both wire legs,
+    the speedup ratio, and the fp32 value-identity attestation. The gate
+    fields exist but are only ENFORCED at full scale (--round)."""
+    monkeypatch.setenv("BENCH_WIRE_MACHINES", "8")
+    monkeypatch.setenv("BENCH_WIRE_CHUNKS", "1")
+    monkeypatch.setenv("BENCH_WIRE_MSGPACK_CHUNKS", "1")
+    monkeypatch.setenv("BENCH_WIRE_ROWS", "256")
+    monkeypatch.setenv("BENCH_WIRE_REPEATS", "1")
+    out = {}
+    bench.bench_serving_wire(out)
+    assert out["serving_wire_columnar_samples_per_sec"] > 0
+    assert out["serving_wire_msgpack_samples_per_sec"] > 0
+    assert out["serving_wire_speedup_vs_msgpack"] == pytest.approx(
+        out["serving_wire_columnar_samples_per_sec"]
+        / out["serving_wire_msgpack_samples_per_sec"],
+        rel=5e-3,
+    )
+    assert out["serving_wire_value_identity_ok"] is True
+    assert "serving_wire_ge_3x_r18_ok" in out

@@ -240,6 +240,46 @@ def test_predict_bulk_matches_per_machine(model_dir):
         )
 
 
+def test_columnar_bulk_matches_msgpack_bitwise(model_dir):
+    """The GSB1 columnar wire (the bulk default) must yield frames that
+    are VALUE-IDENTICAL to the msgpack wire — same fp32 bits, since both
+    ship the server's raw array bytes — and the lazy result must expose
+    raw column access without ever building a DataFrame."""
+
+    def run(port):
+        columnar = Client(
+            "cliproj", port=port, batch_size=60, use_bulk=True
+        ).predict("2017-12-27T06:00:00Z", "2017-12-27T18:00:00Z")
+        msgpack = Client(
+            "cliproj", port=port, batch_size=60, use_bulk=True,
+            use_columnar=False,
+        ).predict("2017-12-27T06:00:00Z", "2017-12-27T18:00:00Z")
+        return columnar, msgpack
+
+    columnar, msgpack = _serve_and(model_dir, run)
+    assert [r.name for r in columnar] == [r.name for r in msgpack]
+    for col, mp in zip(columnar, msgpack):
+        assert col.ok, col.error_messages
+        # frame-free path: raw chunks and concatenated columns, no
+        # DataFrame materialized yet
+        lazy = col.raw
+        assert lazy is not None and lazy._frame is None
+        total = col.arrays("total-anomaly-score")
+        scores = col.arrays("tag-anomaly-scores")
+        threshold = col.arrays("total-anomaly-threshold")
+        assert lazy._frame is None  # still no frame
+        assert total.dtype == np.float32 and total.ndim == 1
+        assert scores.ndim == 2 and len(scores) == len(total)
+        assert isinstance(threshold, float)
+        # bitwise identity against the msgpack wire
+        np.testing.assert_array_equal(total, mp.arrays("total-anomaly-score"))
+        assert scores.tobytes() == mp.arrays("tag-anomaly-scores").tobytes()
+        assert threshold == mp.arrays("total-anomaly-threshold")
+        # and the materialized frames agree too (exercises LazyFrame.frame)
+        pd.testing.assert_frame_equal(col.predictions, mp.predictions)
+        assert lazy._frame is not None  # .predictions cached the frame
+
+
 def test_frame_from_payload_thresholds_when_rows_equal_tags():
     """Known keys dispatch by name: with n_rows == n_tags, a per-tag
     threshold vector must still become per-tag constant columns and a

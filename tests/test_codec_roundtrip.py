@@ -1,0 +1,282 @@
+"""Property-style codec round-trip suite (ISSUE 15 satellite).
+
+Random shapes — including empty, zero-width, F-order and big-endian
+inputs — across every supported wire dtype must survive each codec
+(GSB1 columnar, msgpack, JSON) VALUE-IDENTICAL, and alien dtypes must
+fail the 415 contract (:class:`UnsupportedWireDtype`), never a 500.
+The columnar cases also pin the r19 tentpole's parity claim: decoding
+the GSB1 encoding of a stacked result is bitwise-equal to decoding the
+msgpack encoding of its per-machine split.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from gordo_tpu.serve import codec
+
+SHAPES = [(0,), (1,), (7,), (0, 4), (3, 0), (5, 3), (2, 3, 4), (64, 9)]
+WIRE_DTYPES = [
+    "float16", "float32", "float64", "bfloat16",
+    "<i4", "<i8", "<u1", "|b1",
+]
+
+
+def _rand(rng, shape, name):
+    dt = codec.wire_np_dtype(name)
+    if dt.kind == "f" or dt.name == "bfloat16":
+        return (rng.standard_normal(shape) * 10).astype(dt)
+    if dt.kind == "b":
+        return rng.integers(0, 2, shape).astype(bool)
+    info = np.iinfo(dt)
+    return rng.integers(info.min, min(info.max, 1 << 30), shape).astype(dt)
+
+
+def _assert_value_identical(a, b, ctx):
+    b = np.asarray(b)
+    assert b.dtype == np.asarray(a).dtype, ctx
+    assert np.asarray(a).tobytes() == b.tobytes(), ctx
+
+
+class TestMsgpackRoundTrip:
+    @pytest.mark.parametrize("name", WIRE_DTYPES)
+    @pytest.mark.parametrize("shape", SHAPES)
+    def test_exact(self, name, shape):
+        rng = np.random.default_rng(hash((name, shape)) % 2**32)
+        a = _rand(rng, shape, name)
+        out = codec.unpackb(codec.packb({"x": a}))["x"]
+        _assert_value_identical(a, out, (name, shape))
+
+    def test_f_order_input(self):
+        a = np.asfortranarray(
+            np.arange(30, dtype=np.float32).reshape(5, 6)
+        )
+        assert not a.flags.c_contiguous
+        out = codec.unpackb(codec.packb({"x": a}))["x"]
+        _assert_value_identical(np.ascontiguousarray(a), out, "F-order")
+
+    def test_big_endian_input_normalized(self):
+        a = np.arange(20, dtype=">f8").reshape(4, 5)
+        out = codec.unpackb(codec.packb({"x": a}))["x"]
+        # the wire is little-endian by contract; values are identical
+        assert out.dtype == np.dtype("<f8")
+        np.testing.assert_array_equal(out, a.astype("<f8"))
+
+    def test_memoryview_path_matches_tobytes(self):
+        """Satellite 2: arrays above the memoryview threshold encode to
+        the same wire bytes the tobytes() path produced."""
+        rng = np.random.default_rng(0)
+        big = rng.standard_normal((100, 17)).astype(np.float64)
+        assert big.nbytes >= codec._MEMVIEW_MIN_NBYTES
+        buf = codec._array_wire_buffer(big)
+        assert isinstance(buf, memoryview)
+        assert bytes(buf) == big.tobytes()
+        small = big[:1, :3]
+        assert isinstance(codec._array_wire_buffer(
+            np.ascontiguousarray(small)), bytes)
+
+    def test_alien_dtype_raises_415(self):
+        with pytest.raises(codec.UnsupportedWireDtype):
+            codec.unpackb(
+                codec.packb(
+                    {"__nd__": True, "dtype": "complex128", "shape": [1],
+                     "data": b"\x00" * 16}
+                )
+            )
+
+
+class TestJsonRoundTrip:
+    @pytest.mark.parametrize("name", ["float32", "float64"])
+    @pytest.mark.parametrize("shape", [(0,), (7,), (5, 3), (3, 0)])
+    def test_exact(self, name, shape):
+        rng = np.random.default_rng(hash((name, shape)) % 2**32)
+        a = _rand(rng, shape, name)
+        out = np.asarray(
+            json.loads(codec.dumps_bytes({"x": a}))["x"], dtype=a.dtype
+        )
+        _assert_value_identical(a, out, (name, shape))
+
+
+class TestColumnarRoundTrip:
+    def _result(self, rng, dtype_name="float32"):
+        dt = codec.wire_np_dtype(dtype_name)
+        scores = _rand(rng, (4, 11, 3), dtype_name)
+        total = _rand(rng, (4, 11), dtype_name)
+        thr = _rand(rng, (4, 3), "float64")
+        agg = _rand(rng, (4,), "float32")
+        machines = {}
+        for i, rows in enumerate((11, 7, 1, 0)):
+            machines[f"m{i}"] = {
+                "tag-anomaly-scores": (0, i, rows),
+                "total-anomaly-score": (1, i, rows),
+                "tag-anomaly-thresholds": (2, i, None),
+                "total-anomaly-threshold": (3, i, None),
+            }
+        return codec.ColumnarResult(
+            blocks=[scores.astype(dt), total.astype(dt), thr, agg],
+            machines=machines,
+            scalar_blocks={3},
+            rest={
+                "fellback": {
+                    "model-output": _rand(rng, (6, 3), "float32"),
+                    "total-anomaly-threshold": 1.25,
+                },
+                "broken": {"error": "no such machine"},
+                "m1": {"start": ["2020-01-01T00:00:00Z"],
+                       "end": ["2020-01-01T00:10:00Z"]},
+            },
+        )
+
+    @pytest.mark.parametrize("dtype_name", ["float32", "float64", "bfloat16"])
+    def test_columnar_equals_msgpack_of_split(self, dtype_name):
+        """The tentpole parity pin: GSB1 decode == msgpack decode of the
+        per-machine split, bitwise, including padded-slot extents,
+        scalar thresholds, rest-blob machines and time-column merges."""
+        rng = np.random.default_rng(5)
+        col = self._result(rng, dtype_name)
+        payload_split = {"data": col.split(), "time-seconds": 0.25}
+        via_msgpack = codec.unpackb(codec.packb(payload_split))
+        via_columnar = codec.decode_columnar(
+            codec.encode_columnar({"data": col, "time-seconds": 0.25})
+        )
+        assert via_columnar["time-seconds"] == 0.25
+        assert sorted(via_columnar["data"]) == sorted(via_msgpack["data"])
+        for name, ref in via_msgpack["data"].items():
+            got = via_columnar["data"][name]
+            assert sorted(got) == sorted(ref), name
+            for key, v in ref.items():
+                w = got[key]
+                if isinstance(v, np.ndarray):
+                    _assert_value_identical(v, w, (name, key))
+                else:
+                    assert v == w and type(v) is type(w), (name, key)
+
+    def test_views_are_zero_copy(self):
+        rng = np.random.default_rng(6)
+        body = codec.encode_columnar({"data": self._result(rng)})
+        out = codec.decode_columnar(body)
+        arr = out["data"]["m0"]["tag-anomaly-scores"]
+        # np.frombuffer views are read-only windows into the body buffer
+        assert not arr.flags.writeable
+        assert not arr.flags.owndata
+
+    def test_dtype_param_casts_blocks_not_scalars(self):
+        rng = np.random.default_rng(7)
+        col = self._result(rng)
+        agg0 = float(np.asarray(col.blocks[3])[0])
+        encode, ct = codec.negotiate(
+            f"{codec.COLUMNAR_CONTENT_TYPE};dtype=bfloat16, "
+            f"{codec.MSGPACK_CONTENT_TYPE}"
+        )
+        assert ct == codec.COLUMNAR_CONTENT_TYPE
+        out = codec.decode_columnar(encode({"data": col}))
+        assert out["data"]["m0"]["tag-anomaly-scores"].dtype.name == "bfloat16"
+        assert out["data"]["m0"]["tag-anomaly-thresholds"].dtype.name == (
+            "bfloat16"
+        )
+        # scalar threshold parity with msgpack: python float, uncast
+        thr = out["data"]["m0"]["total-anomaly-threshold"]
+        assert isinstance(thr, float) and thr == agg0
+
+    def test_no_op_dtype_cast_elided(self):
+        """Satellite 1: a float leaf already at the negotiated wire dtype
+        is returned as-is — no astype copy."""
+        import ml_dtypes
+
+        a32 = np.ones((4, 4), np.float32)
+        assert codec._cast_float_arrays(a32, np.dtype(np.float32)) is a32
+        bf = np.ones((4, 4), ml_dtypes.bfloat16)
+        assert codec._cast_float_arrays(bf, np.dtype(ml_dtypes.bfloat16)) is bf
+        # ...and bf16 leaves DO cast when a different dtype is negotiated
+        # (their dtype kind is 'V', which the old kind=='f' check missed)
+        assert codec._cast_float_arrays(
+            bf, np.dtype(np.float32)
+        ).dtype == np.float32
+
+    def test_degenerate_non_bulk_object(self):
+        """Any response object survives the columnar encoder (zero-block
+        body, msgpack rest): the ONE-negotiation-rule holds for every
+        route, not just bulk."""
+        obj = {"model": {"name": "x"}, "rows": [1, 2, 3],
+               "arr": np.arange(5, dtype=np.int64)}
+        out = codec.decode_columnar(codec.encode_columnar(obj))
+        assert out["model"] == {"name": "x"} and out["rows"] == [1, 2, 3]
+        _assert_value_identical(obj["arr"], out["arr"], "arr")
+
+    def test_msgpack_and_json_fallbacks_split(self):
+        """A ColumnarResult reaching the msgpack or JSON encoder (e.g. a
+        probe without the columnar Accept) degrades to per-machine
+        dicts, never a stringified object."""
+        rng = np.random.default_rng(8)
+        col = self._result(rng)
+        mp = codec.unpackb(codec.packb({"data": col}))
+        _assert_value_identical(
+            np.asarray(col.blocks[0])[0][:11],
+            mp["data"]["m0"]["tag-anomaly-scores"], "msgpack fallback",
+        )
+        js = json.loads(codec.dumps_bytes({"data": col}))
+        assert len(js["data"]["m0"]["tag-anomaly-scores"]) == 11
+
+    def test_empty_and_zero_width_blocks(self):
+        col = codec.ColumnarResult(
+            blocks=[np.zeros((2, 0, 4), np.float32),
+                    np.zeros((2, 5, 0), np.float64)],
+            machines={"a": {"x": (0, 0, 0), "y": (1, 0, 5)}},
+        )
+        out = codec.decode_columnar(codec.encode_columnar({"data": col}))
+        assert out["data"]["a"]["x"].shape == (0, 4)
+        assert out["data"]["a"]["y"].shape == (5, 0)
+
+    def test_bad_magic_rejected(self):
+        with pytest.raises(ValueError):
+            codec.decode_columnar(b"NOPE" + b"\x00" * 16)
+
+    def test_alien_block_dtype_raises_415(self):
+        """A crafted header with an unsupported block dtype fails the
+        415 contract (UnsupportedWireDtype), not a numpy crash."""
+        body = codec.encode_columnar(
+            {"data": codec.ColumnarResult(
+                blocks=[np.zeros(4, np.float32)],
+                machines={"a": {"x": (0, 0, None)}},
+            )}
+        )
+        header_len = int.from_bytes(body[4:8], "little")
+        header = json.loads(body[8:8 + header_len])
+        header["blocks"][0]["dtype"] = "complex128"
+        evil = json.dumps(header, separators=(",", ":")).encode()
+        forged = (
+            codec._COLUMNAR_MAGIC
+            + len(evil).to_bytes(4, "little")
+            + evil
+            + body[8 + header_len:]
+        )
+        with pytest.raises(codec.UnsupportedWireDtype):
+            codec.decode_columnar(forged)
+
+    def test_negotiate_alien_dtype_param_raises(self):
+        with pytest.raises(codec.UnsupportedWireDtype):
+            codec.negotiate(f"{codec.COLUMNAR_CONTENT_TYPE};dtype=int128")
+
+
+class TestNegotiatePrecedence:
+    def test_columnar_wins_over_msgpack(self):
+        _, ct = codec.negotiate(
+            f"{codec.COLUMNAR_CONTENT_TYPE}, {codec.MSGPACK_CONTENT_TYPE}"
+        )
+        assert ct == codec.COLUMNAR_CONTENT_TYPE
+
+    def test_msgpack_alone_untouched(self):
+        _, ct = codec.negotiate(codec.MSGPACK_CONTENT_TYPE)
+        assert ct == codec.MSGPACK_CONTENT_TYPE
+
+    def test_json_fallback_untouched(self):
+        _, ct = codec.negotiate("application/json")
+        assert ct == "application/json"
+
+    def test_wants_columnar(self):
+        assert codec.wants_columnar(
+            f"{codec.COLUMNAR_CONTENT_TYPE}, {codec.MSGPACK_CONTENT_TYPE}"
+        )
+        assert not codec.wants_columnar(codec.MSGPACK_CONTENT_TYPE)
+        assert not codec.wants_columnar(None)

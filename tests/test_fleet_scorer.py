@@ -188,6 +188,62 @@ def test_dispatch_all_assemble_matches_score_all(models):
         assert pending.assemble() is out
 
 
+def test_assemble_columnar_bitwise_matches_assemble(models):
+    """The r19 columnar wire parity pin at the assembler: encoding the
+    still-stacked ``assemble_columnar`` result through GSB1 and decoding
+    it must be BITWISE identical to ``assemble`` — per machine, per key,
+    dtype included — for subset, full-bucket and mixed valid/invalid
+    dispatches.  One dispatch per bucket on both paths."""
+    from gordo_tpu.serve import codec
+
+    scorer = FleetScorer.from_models(models[0])
+    rng = np.random.default_rng(21)
+    names = sorted(models[0])
+    cases = {
+        "subset": {names[2]: rng.standard_normal((40, 3)).astype(np.float32)},
+        "full": {
+            n: rng.standard_normal((40 + 3 * i, 3)).astype(np.float32)
+            for i, n in enumerate(names)
+        },
+        "mixed": {
+            names[0]: rng.standard_normal((40, 3)).astype(np.float32),
+            names[1]: rng.standard_normal((40, 2)).astype(np.float32),  # bad
+        },
+    }
+    for label, X_by in cases.items():
+        expected = scorer.score_all(X_by)
+        pending = scorer.dispatch_all(X_by)
+        n_dispatches = pending.n_device_dispatches
+        col = pending.assemble_columnar()
+        assert pending.n_device_dispatches == 0  # drained, like assemble
+        decoded = codec.decode_columnar(
+            codec.encode_columnar({"data": col})
+        )["data"]
+        # error machines must strip "client-error" exactly like the bulk
+        # handler does on the msgpack path
+        decoded = {
+            n: {k: v for k, v in r.items() if k != "client-error"}
+            for n, r in decoded.items()
+        }
+        expected_clean = {
+            n: {k: v for k, v in r.items() if k != "client-error"}
+            for n, r in expected.items()
+        }
+        assert sorted(decoded) == sorted(expected_clean), label
+        for n in expected_clean:
+            assert sorted(decoded[n]) == sorted(expected_clean[n]), (label, n)
+            for key, val in expected_clean[n].items():
+                got = decoded[n][key]
+                if isinstance(val, np.ndarray):
+                    assert got.dtype == val.dtype, (label, n, key)
+                    assert got.tobytes() == val.tobytes(), (label, n, key)
+                else:
+                    assert got == val and type(got) is type(val), (
+                        label, n, key,
+                    )
+        assert n_dispatches >= 1 or label == "mixed"
+
+
 def test_estimate_knee_against_real_dispatch_paths(models):
     """The coalescer's knee sweep must run against the REAL fleet scorer —
     gathered-subset dispatches below the bucket size (1, 2) and the full

@@ -387,10 +387,12 @@ async def _start_replicas(model_dir, count):
 
 
 @pytest.mark.slow
-def test_scatter_gather_byte_parity_and_order(model_dir):
+@pytest.mark.parametrize("wire", ["msgpack", "columnar"])
+def test_scatter_gather_byte_parity_and_order(model_dir, wire):
     """2-replica bulk scoring must return BYTE-identical arrays to the
     single process, reassembled in the original machine order (the slow-
-    lane parity pin of the sharded tier)."""
+    lane parity pin of the sharded tier) — on both the msgpack wire and
+    the r19 GSB1 columnar wire."""
     from gordo_tpu.serve import codec
 
     rng = np.random.default_rng(5)
@@ -405,11 +407,26 @@ def test_scatter_gather_byte_parity_and_order(model_dir):
             urls = [str(r.server.make_url("")) for r in replicas]
             router = ShardRouter(MACHINES, urls)
             plan = router.split(X_by)
-            # scatter concurrently, msgpack wire (raw array bytes)
+            # scatter concurrently; both wires ship raw array bytes
+            if wire == "columnar":
+                accept = (
+                    f"{codec.COLUMNAR_CONTENT_TYPE}, "
+                    f"{codec.MSGPACK_CONTENT_TYPE}"
+                )
+            else:
+                accept = codec.MSGPACK_CONTENT_TYPE
             headers = {
                 "Content-Type": codec.MSGPACK_CONTENT_TYPE,
-                "Accept": codec.MSGPACK_CONTENT_TYPE,
+                "Accept": accept,
             }
+
+            async def decode(resp):
+                if wire == "columnar":
+                    assert (
+                        resp.content_type == codec.COLUMNAR_CONTENT_TYPE
+                    )
+                    return codec.decode_columnar(await resp.read())
+                return codec.unpackb(await resp.read())
 
             async def post(client, members):
                 resp = await client.post(
@@ -420,7 +437,7 @@ def test_scatter_gather_byte_parity_and_order(model_dir):
                     headers=headers,
                 )
                 assert resp.status == 200
-                return codec.unpackb(await resp.read())["data"]
+                return (await decode(resp))["data"]
 
             parts = await asyncio.gather(*(
                 post(replicas[urls.index(u)], members)
@@ -437,7 +454,7 @@ def test_scatter_gather_byte_parity_and_order(model_dir):
                 headers=headers,
             )
             assert resp.status == 200
-            single_out = codec.unpackb(await resp.read())["data"]
+            single_out = (await decode(resp))["data"]
             return sharded, single_out
         finally:
             for r in replicas:

@@ -528,6 +528,82 @@ def test_msgpack_content_negotiation(model_dir):
     assert isinstance(single["data"]["model-output"], np.ndarray)
 
 
+def test_columnar_content_negotiation(model_dir):
+    """The r19 bulk wire: Accept listing the GSB1 columnar type (with
+    msgpack fallback, the client's header) gets a columnar response that
+    decodes BITWISE identical to the msgpack response for the same
+    request — arrays, scalar thresholds and per-machine time columns."""
+    import pandas as pd
+
+    from gordo_tpu.serve import codec
+
+    rng = np.random.default_rng(13)
+    X_a = rng.standard_normal((40, 3)).astype(np.float32)
+    X_b = rng.standard_normal((25, 3)).astype(np.float32)
+    index_a = [
+        t.isoformat()
+        for t in pd.date_range("2020-01-01", periods=40, freq="10min",
+                               tz="UTC")
+    ]
+    payload = codec.packb(
+        {"X": {"machine-a": X_a, "machine-b": X_b},
+         "index": {"machine-a": index_a}}
+    )
+
+    async def fn(client):
+        mp_resp = await client.post(
+            "/gordo/v0/testproj/_bulk/anomaly/prediction",
+            data=payload,
+            headers={"Content-Type": codec.MSGPACK_CONTENT_TYPE,
+                     "Accept": codec.MSGPACK_CONTENT_TYPE},
+        )
+        assert mp_resp.status == 200, await mp_resp.text()
+        col_resp = await client.post(
+            "/gordo/v0/testproj/_bulk/anomaly/prediction",
+            data=payload,
+            headers={
+                "Content-Type": codec.MSGPACK_CONTENT_TYPE,
+                "Accept": (
+                    f"{codec.COLUMNAR_CONTENT_TYPE}, "
+                    f"{codec.MSGPACK_CONTENT_TYPE}"
+                ),
+            },
+        )
+        assert col_resp.status == 200, await col_resp.text()
+        assert col_resp.content_type == codec.COLUMNAR_CONTENT_TYPE
+        # alien dtype params stay a 415 on the columnar type too
+        bad = await client.post(
+            "/gordo/v0/testproj/_bulk/anomaly/prediction",
+            data=payload,
+            headers={
+                "Content-Type": codec.MSGPACK_CONTENT_TYPE,
+                "Accept": f"{codec.COLUMNAR_CONTENT_TYPE};dtype=int128",
+            },
+        )
+        assert bad.status == 415
+        return (
+            codec.unpackb(await mp_resp.read()),
+            codec.decode_columnar(await col_resp.read()),
+        )
+
+    mp_body, col_body = _call(model_dir, fn)
+    assert sorted(col_body["data"]) == sorted(mp_body["data"])
+    for name, ref in mp_body["data"].items():
+        got = col_body["data"][name]
+        assert sorted(got) == sorted(ref), name
+        for key, val in ref.items():
+            if isinstance(val, np.ndarray):
+                assert got[key].dtype == val.dtype, (name, key)
+                assert got[key].tobytes() == val.tobytes(), (name, key)
+            else:
+                assert got[key] == val, (name, key)
+    # time columns made it through the rest blob for the indexed machine
+    a = col_body["data"]["machine-a"]
+    assert len(a["start"]) == len(a["model-output"])
+    assert a["start"][0].startswith("2020-01-01T00:00:00")
+    assert "start" not in col_body["data"]["machine-b"]
+
+
 def test_replay_bench_smoke(model_dir):
     """The replayed-stream HTTP benchmark harness drives a real server and
     reports coherent numbers for every mode/wire combination — and its
