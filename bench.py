@@ -1904,6 +1904,150 @@ def bench_cold_start(out: dict) -> None:
         shutil.rmtree(cache_dir, ignore_errors=True)
 
 
+def scaleout_child_main(argv: "list[str]") -> None:
+    """Forked measurement half of :func:`bench_multi_device`: this
+    process was spawned with ``XLA_FLAGS=--xla_force_host_platform_
+    device_count=N`` already in its environment (device topology is
+    fixed at backend init, so the quantity under test only exists in a
+    fresh process — the cold_start pattern), builds one machine,
+    replicates it across a stacked fleet model-sharded over ALL its
+    devices, and prints exactly one JSON line: steady-state
+    ``score_all`` samples/s after a compile round and a warm round."""
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--devices", type=int, required=True)
+    p.add_argument("--machines", type=int, default=32)
+    p.add_argument("--rows", type=int, default=1024)
+    p.add_argument("--rounds", type=int, default=8)
+    a = p.parse_args(argv)
+    try:
+        import jax
+
+        from gordo_tpu.parallel.mesh import fleet_mesh
+        from gordo_tpu.serve.fleet_scorer import FleetScorer
+
+        devices = jax.devices()
+        if len(devices) != a.devices:
+            raise RuntimeError(
+                f"forced {a.devices} host devices, backend exposes "
+                f"{len(devices)}"
+            )
+        model, _metadata = _build_serving_model()
+        names = [f"md-{i:03d}" for i in range(a.machines)]
+        mesh = fleet_mesh(devices) if len(devices) > 1 else None
+        scorer = FleetScorer.from_models(
+            {n: model for n in names}, mesh=mesh
+        )
+        rng = np.random.default_rng(11)
+        X_by = {
+            n: rng.standard_normal((a.rows, N_TAGS)).astype(np.float32)
+            for n in names
+        }
+        scorer.score_all(X_by)  # compile + first transfers
+        scorer.score_all(X_by)  # steady state
+        t0 = time.perf_counter()
+        for _ in range(a.rounds):
+            scorer.score_all(X_by)
+        dt = time.perf_counter() - t0
+        samples = a.rounds * a.machines * a.rows * N_TAGS
+        print(json.dumps({
+            "devices": len(devices),
+            "machines": a.machines,
+            "rows": a.rows,
+            "rounds": a.rounds,
+            "n_stacked": scorer.n_stacked,
+            "seconds": round(dt, 4),
+            "samples_per_sec": round(samples / dt) if dt > 0 else None,
+        }), flush=True)
+    except Exception as exc:  # one diagnostic line, never a dead rc
+        print(
+            json.dumps({"error": f"{type(exc).__name__}: {exc}"}),
+            flush=True,
+        )
+        raise SystemExit(1)
+    raise SystemExit(0)
+
+
+def bench_multi_device(out: dict) -> None:
+    """ISSUE 16 satellite: the stacked fleet-scoring scale-out curve over
+    REAL XLA device counts — forked children swept over
+    ``--xla_force_host_platform_device_count`` in {1,2,4,8}
+    (:func:`scaleout_child_main`), each measuring steady-state
+    ``FleetScorer.score_all`` throughput for an identical replicated
+    fleet model-sharded across its devices.
+
+    This banks the r13 replica-scaling gate (>=1.6x aggregate at 2)
+    against real devices instead of the "unmeasurable, 1 visible core"
+    caveat — with the matching honesty note when the host exposes fewer
+    cores than devices: forced host-platform devices timeshare the
+    physical cores, so a flat curve there bounds sharding/scheduling
+    overhead rather than disproving the multi-chip win.
+    """
+    counts = [
+        int(x) for x in
+        os.environ.get("BENCH_MULTI_DEVICE_COUNTS", "1,2,4,8").split(",")
+    ]
+    machines = int(os.environ.get("BENCH_MULTI_DEVICE_MACHINES", "32"))
+    rows = int(os.environ.get("BENCH_MULTI_DEVICE_ROWS", "1024"))
+    rounds = int(os.environ.get("BENCH_MULTI_DEVICE_ROUNDS", "8"))
+    cores = os.cpu_count()
+    out["cpu_cores"] = cores
+
+    def child(n_dev: int) -> dict:
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = [
+            f for f in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        ]
+        flags.append(f"--xla_force_host_platform_device_count={n_dev}")
+        env["XLA_FLAGS"] = " ".join(flags)
+        res = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--scaleout-child", "--devices", str(n_dev),
+             "--machines", str(machines), "--rows", str(rows),
+             "--rounds", str(rounds)],
+            env=env, stdout=subprocess.PIPE, text=True, timeout=420,
+        )
+        lines = (res.stdout or "").strip().splitlines()
+        doc = json.loads(lines[-1]) if lines else {}
+        if res.returncode != 0 or "error" in doc:
+            raise RuntimeError(
+                f"scaleout child @{n_dev} rc={res.returncode}: "
+                f"{doc.get('error', 'no output')}"
+            )
+        return doc
+
+    curve: dict = {}
+    for n_dev in counts:
+        doc = child(n_dev)
+        curve[str(n_dev)] = doc["samples_per_sec"]
+        log(f"multi_device @{n_dev}: {doc['samples_per_sec']:,} samples/s "
+            f"({doc['n_stacked']} stacked, {doc['seconds']}s)")
+    out["multi_device_counts"] = counts
+    out["multi_device_machines"] = machines
+    out["multi_device_samples_per_sec"] = curve
+    base = curve.get("1")
+    if base:
+        speedups = {k: round(v / base, 3) for k, v in curve.items() if v}
+        out["multi_device_speedup_vs_1"] = speedups
+        at2 = speedups.get("2")
+        if at2 is not None:
+            out["multi_device_speedup_at_2"] = at2
+            out["multi_device_ge_1_6x_at_2_ok"] = at2 >= 1.6
+            log(f"multi_device gate: {at2:.2f}x @2 devices >= 1.6x -> "
+                f"{'PASS' if at2 >= 1.6 else 'FAIL'}")
+    if cores is not None and cores < max(counts):
+        out["multi_device_core_note"] = (
+            f"{cores} visible core(s) for up to {max(counts)} forced "
+            "host devices: device programs timeshare the cores, so a "
+            "flat curve bounds sharding overhead rather than disproving "
+            "the multi-chip win"
+        )
+
+
 def _refresh_parity(out: dict, size: int, warm_dir: str, cold_dir: str,
                     subset, Xp, series: str, median_tol: float,
                     max_tol: float) -> bool:
@@ -2569,6 +2713,353 @@ def bench_backfill(out: dict) -> None:
         stop(procs)
 
 
+def bench_scores_lifecycle(out: dict) -> None:
+    """ISSUE 16 acceptance: the score-archive lifecycle at fleet-year
+    scale — compaction throughput vs raw mmap scan speed, aggregate
+    byte-identity across compaction, and the ``/scores/aggregate``
+    pushdown vs client-side fetch-and-aggregate over ``score_history``.
+
+    Protocol (docs/perf.md "Archive lifecycle"):
+
+    - a synthetic 512-machine archive: 8 chunks x 2048 rows at 30min
+      resolution (~341 days — a fleet-year of scored history; ~75M
+      scored samples, ~370 MB of GSA1 columns) written through the REAL
+      ``write_chunk`` path (fsync'd segments + completion records);
+    - raw scan: every byte of every data segment summed through the
+      same ``np.memmap`` reads the query plane uses (best of 2, warm
+      page cache — the comparator compaction has to keep up with);
+    - compaction: ``compact_scores`` at a 90d partition (3 periods of
+      2-3 chunks each; the trailing single-chunk period stays as a
+      chunk file — eligibility needs >= 2 segments).  Throughput =
+      bytes moved (input scanned + output fsync'd) / wall clock, gated
+      >= 0.5x the scan rate; the write-only rate and the medium's
+      measured durable-write ceiling are recorded alongside (the fsync
+      before each index flip pins the write side to the disk, so the
+      honest comparison needs both numbers);
+    - aggregates (count/mean/max/p50/p90/p99/exceed over 7d periods)
+      run before and after compaction and must be BYTE-identical;
+    - pushdown: a real ``run-server`` subprocess over a 1-model v2 pack
+      dir holding the archive; ``client.score_summary`` end-to-end
+      (HTTP + server-side mmap scan + GSB1 columnar wire + decode) vs
+      the pre-r20 client-side path — ``client.score_history`` (LOCAL
+      mmap reads, zero wire cost: a handicap the gate absorbs)
+      materializing 512 frames + pandas groupby computing the SAME
+      stats.  Gate: pushdown >= 10x faster end-to-end.
+    """
+    import socket
+    import urllib.request
+
+    import pandas as pd
+
+    from gordo_tpu.batch import (
+        AGGREGATE_STATS,
+        ScoreArchive,
+        compact_scores,
+        gc_scores,
+        plan_compaction,
+    )
+    from gordo_tpu.client import Client
+
+    n_machines = int(os.environ.get("BENCH_SCORES_MACHINES", "512"))
+    chunk_rows = int(os.environ.get("BENCH_SCORES_CHUNK_ROWS", "2048"))
+    n_chunks = int(os.environ.get("BENCH_SCORES_CHUNKS", "8"))
+    n_tags = int(os.environ.get("BENCH_SCORES_TAGS", "8"))
+    agg_period = "7d"
+    threshold = 1.0
+    out["cpu_cores"] = os.cpu_count()
+
+    model, metadata = _build_serving_model()
+    art_dir = _backfill_fleet_dir(model, metadata, ["scores-m-000"])
+    # the stage measures SOFTWARE throughput (compactor and scan on the
+    # same medium); a device-independent medium keeps the ratio from
+    # collapsing into this container's fsync bandwidth, which is probed
+    # and recorded separately against the real disk below.
+    shm = os.environ.get("BENCH_SCORES_DIR", "/dev/shm")
+    if os.path.isdir(shm) and os.access(shm, os.W_OK):
+        shm_dir = tempfile.mkdtemp(prefix="gordo-bench-scores-", dir=shm)
+        for entry in os.listdir(art_dir):
+            shutil.move(os.path.join(art_dir, entry),
+                        os.path.join(shm_dir, entry))
+        os.rmdir(art_dir)
+        art_dir = shm_dir
+        out["scores_archive_medium"] = "tmpfs"
+    else:
+        out["scores_archive_medium"] = "disk"
+    procs: "list[subprocess.Popen]" = []
+    logs: "list[str]" = []
+
+    def free_port() -> int:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    def spawn(port: int) -> subprocess.Popen:
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.pop("GORDO_SERVE_SHARD", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        log_path = os.path.join(art_dir, f"server-{port}.log")
+        logs.append(log_path)
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "gordo_tpu.cli.cli", "run-server",
+                "--model-dir", art_dir, "--project", "bench",
+                "--host", "127.0.0.1", "--port", str(port),
+                "--rescan-interval", "0",
+            ],
+            env=env,
+            stdout=open(log_path, "w"), stderr=subprocess.STDOUT,
+        )
+        procs.append(proc)
+        return proc
+
+    def wait_ready(port: int, timeout_s: float) -> None:
+        deadline = time.monotonic() + timeout_s
+        url = f"http://127.0.0.1:{port}/healthz"
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(url, timeout=2) as resp:
+                    if resp.status == 200:
+                        return
+            except Exception:
+                time.sleep(0.25)
+        raise RuntimeError(f"scores server on :{port} never became ready")
+
+    def stop(to_stop: "list[subprocess.Popen]") -> None:
+        for proc in to_stop:
+            proc.terminate()
+        for proc in to_stop:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    try:
+        # -- build the fleet-year archive through the real write path ---
+        step = pd.Timedelta("30min")
+        step_ns = int(step.value)
+        start = pd.Timestamp("2024-01-01T00:00:00Z")
+        names = [f"scm-{i:04d}" for i in range(n_machines)]
+        arch = ScoreArchive.create(
+            art_dir, project="bench", start=str(start),
+            end=str(start + step * (chunk_rows * n_chunks)),
+            resolution="30min", chunk_rows=chunk_rows,
+            n_chunks=n_chunks, dtype="float32", machines=names,
+        )
+        tags = [f"t{j}" for j in range(n_tags)]
+        rng = np.random.default_rng(3)
+        t0_ns = int(start.value)
+        span_ns = chunk_rows * step_ns
+        t_build = time.perf_counter()
+        for c in range(n_chunks):
+            idx = (
+                t0_ns + c * span_ns
+                + step_ns * np.arange(chunk_rows, dtype=np.int64)
+            )
+            tot = rng.random((n_machines, chunk_rows), dtype=np.float32) * 3
+            tag = rng.random(
+                (n_machines, chunk_rows, n_tags), dtype=np.float32
+            )
+            arch.write_chunk(c, {
+                name: {
+                    "index-ns": idx,
+                    "total-anomaly-score": tot[i],
+                    "tag-anomaly-scores": tag[i],
+                    "tags": tags,
+                }
+                for i, name in enumerate(names)
+            })
+        build_s = time.perf_counter() - t_build
+        rows_total = n_machines * chunk_rows * n_chunks
+        out["scores_machines"] = n_machines
+        out["scores_rows"] = rows_total
+        out["scores_samples"] = rows_total * (n_tags + 1)
+        out["scores_archive_build_s"] = round(build_s, 2)
+
+        # -- raw mmap scan floor (best of 2, warm cache) ----------------
+        def mmap_scan() -> "tuple[int, float]":
+            t0 = time.perf_counter()
+            nbytes = 0
+            sink = 0
+            for path in arch._data_segments():
+                buf = np.memmap(path, dtype=np.uint8, mode="r")
+                sink += int(np.add.reduce(buf, dtype=np.int64))
+                nbytes += buf.size
+            return nbytes, time.perf_counter() - t0
+
+        scan_bytes, scan_1 = mmap_scan()
+        _, scan_2 = mmap_scan()
+        scan_s = min(scan_1, scan_2)
+        scan_bps = scan_bytes / scan_s if scan_s > 0 else 0.0
+        out["scores_archive_mb"] = round(scan_bytes / 1e6, 1)
+        out["scores_scan_mb_per_s"] = round(scan_bps / 1e6, 1)
+        log(f"scores scan: {scan_bytes / 1e6:,.0f} MB in {scan_s:.2f}s "
+            f"({scan_bps / 1e6:,.0f} MB/s)")
+
+        # -- aggregate before compaction (also the local-latency point) -
+        t0 = time.perf_counter()
+        agg_pre = arch.aggregate(
+            stats=list(AGGREGATE_STATS), period=agg_period,
+            threshold=threshold,
+        )
+        out["scores_aggregate_local_s"] = round(time.perf_counter() - t0, 3)
+
+        # -- durable-write ceiling of the real disk -------------------
+        # a production compactor must fsync every period file before
+        # the index flip, so on spinning/virtio media its write side is
+        # device-bound.  Probe the container's disk with a dd-style
+        # write+fsync so the report carries that ceiling next to the
+        # software throughput measured above it.
+        probe_path = os.path.join(
+            tempfile.gettempdir(), "gordo_bench_disk_probe.tmp"
+        )
+        probe_mb = 128
+        block = np.random.default_rng(0).integers(
+            0, 256, probe_mb * 1_000_000, dtype=np.uint8
+        ).tobytes()
+        t0 = time.perf_counter()
+        with open(probe_path, "wb") as fh:
+            fh.write(block)
+            fh.flush()
+            os.fsync(fh.fileno())
+        disk_bps = len(block) / (time.perf_counter() - t0)
+        os.unlink(probe_path)
+        del block
+        out["scores_disk_write_mb_per_s"] = round(disk_bps / 1e6, 1)
+
+        # -- compaction vs the scan floor -------------------------------
+        # throughput counts the bytes the compactor MOVES per wall
+        # second: every input byte scanned off the chunk segments plus
+        # every output byte written durably — the two directions of
+        # compaction I/O, both recorded separately below.
+        eligible = plan_compaction(art_dir, period="90d")["eligible"]
+        read_bytes = sum(
+            os.path.getsize(os.path.join(arch.directory, fname))
+            for info in eligible.values()
+            for _c, _s, fname in info["segments"]
+        )
+        t0 = time.perf_counter()
+        summary = compact_scores(art_dir, period="90d")
+        compact_s = time.perf_counter() - t0
+        write_bps = (
+            summary["bytes-written"] / compact_s if compact_s > 0 else 0.0
+        )
+        io_bps = (
+            (read_bytes + summary["bytes-written"]) / compact_s
+            if compact_s > 0 else 0.0
+        )
+        ratio = io_bps / scan_bps if scan_bps > 0 else 0.0
+        out["scores_compact_periods"] = summary["periods-compacted"]
+        out["scores_compact_segments_merged"] = summary["segments-merged"]
+        out["scores_compact_mb_read"] = round(read_bytes / 1e6, 1)
+        out["scores_compact_mb_written"] = round(
+            summary["bytes-written"] / 1e6, 1
+        )
+        out["scores_compact_s"] = round(compact_s, 2)
+        out["scores_compact_write_mb_per_s"] = round(write_bps / 1e6, 1)
+        out["scores_compact_vs_disk_ratio"] = round(
+            write_bps / disk_bps, 3
+        ) if disk_bps > 0 else None
+        out["scores_compact_mb_per_s"] = round(io_bps / 1e6, 1)
+        out["scores_compact_vs_scan_ratio"] = round(ratio, 3)
+        out["scores_compact_ge_half_scan_ok"] = ratio >= 0.5
+        log(f"scores compact: {summary['periods-compacted']} periods "
+            f"({len(eligible)} planned), "
+            f"{summary['bytes-written'] / 1e6:,.0f} MB written + "
+            f"{read_bytes / 1e6:,.0f} MB scanned in {compact_s:.2f}s "
+            f"({io_bps / 1e6:,.0f} MB/s moved, {ratio:.2f}x scan; "
+            f"write side {write_bps / 1e6:,.0f} MB/s vs disk "
+            f"{disk_bps / 1e6:,.0f} MB/s) -> "
+            f"{'PASS' if ratio >= 0.5 else 'FAIL'}")
+
+        # -- byte-identity across compaction ----------------------------
+        agg_post = arch.aggregate(
+            stats=list(AGGREGATE_STATS), period=agg_period,
+            threshold=threshold,
+        )
+        identical = agg_pre["periods"] == agg_post["periods"] and all(
+            agg_pre["stats"][k].tobytes() == agg_post["stats"][k].tobytes()
+            for k in agg_pre["stats"]
+        )
+        out["scores_aggregate_bytes_identical_ok"] = identical
+        log(f"scores aggregate byte-identity across compaction: "
+            f"{'PASS' if identical else 'FAIL'}")
+
+        # -- pushdown vs client-side fetch-and-aggregate ----------------
+        port = free_port()
+        spawn(port)
+        wait_ready(port, 240.0)
+        client = Client("bench", port=port)
+        client.score_summary(machines=names[:1], period=agg_period)  # warm
+        t0 = time.perf_counter()
+        doc = client.score_summary(
+            stats=list(AGGREGATE_STATS), period=agg_period,
+            threshold=threshold,
+        )
+        push_s = time.perf_counter() - t0
+        resp_bytes = sum(
+            np.asarray(a).nbytes
+            for stats_map in doc["data"].values()
+            for a in stats_map.values()
+        )
+        midx = {n: i for i, n in enumerate(agg_post["machines"])}
+        parity = all(
+            np.array_equal(
+                np.asarray(doc["data"][n][k]), agg_post["stats"][k][midx[n]]
+            )
+            for n in doc["data"] for k in AGGREGATE_STATS
+        )
+        out["scores_pushdown_parity_ok"] = parity
+
+        t0 = time.perf_counter()
+        frames = client.score_history(archive_dir=art_dir)
+        fetched = 0
+        for frame in frames.values():
+            fetched += int(frame.size)
+            s = frame["total-anomaly-score"]
+            grouped = s.groupby(pd.Grouper(freq="7D"))
+            grouped.agg(["count", "mean", "max"])
+            grouped.quantile([0.5, 0.9, 0.99])
+            s.gt(threshold).groupby(pd.Grouper(freq="7D")).sum()
+        fetch_s = time.perf_counter() - t0
+        speedup = fetch_s / push_s if push_s > 0 else 0.0
+        out["scores_pushdown_s"] = round(push_s, 3)
+        out["scores_pushdown_response_kb"] = round(resp_bytes / 1e3, 1)
+        out["scores_pushdown_periods"] = len(doc["periods"])
+        out["scores_fetch_aggregate_s"] = round(fetch_s, 2)
+        out["scores_fetch_aggregate_cells"] = fetched
+        out["scores_pushdown_speedup"] = round(speedup, 2)
+        out["scores_pushdown_ge_10x_ok"] = speedup >= 10.0
+        log(f"scores pushdown: {push_s:.3f}s "
+            f"({resp_bytes / 1e3:,.0f} KB over the wire) vs "
+            f"fetch-and-aggregate {fetch_s:.2f}s "
+            f"({fetched:,} frame cells) -> {speedup:.1f}x >= 10x "
+            f"{'PASS' if speedup >= 10.0 else 'FAIL'}")
+
+        # -- retention (destructive: runs last) -------------------------
+        now_s = (start + step * (chunk_rows * n_chunks)).timestamp()
+        t0 = time.perf_counter()
+        g = gc_scores(art_dir, keep_days=180, now=now_s)
+        out["scores_gc_s"] = round(time.perf_counter() - t0, 3)
+        out["scores_gc_segments_deleted"] = g["segments-deleted"]
+        out["scores_gc_mb_reclaimed"] = round(g["bytes-reclaimed"] / 1e6, 1)
+        log(f"scores gc --keep 180: {g['segments-deleted']} segment(s), "
+            f"{g['bytes-reclaimed'] / 1e6:,.0f} MB reclaimed")
+    except Exception:
+        for log_path in logs:
+            try:
+                with open(log_path) as fh:
+                    tail = fh.read()[-2000:]
+                if tail:
+                    log(f"--- {log_path} tail ---\n{tail}")
+            except OSError:
+                pass
+        raise
+    finally:
+        stop(procs)
+        shutil.rmtree(art_dir, ignore_errors=True)
+
+
 def bench_serving_wire(out: dict) -> None:
     """ISSUE 15 acceptance: the GSB1 columnar bulk wire vs the r18
     msgpack bulk wire, end-to-end through the real ``Client`` against a
@@ -2974,7 +3465,8 @@ def run_stage_bounded(
 STAGES = ("build", "build_pipeline", "artifact_io", "hot_reload",
           "serving", "serving_precision", "serving_sharded",
           "serving_wire", "serving_openloop", "telemetry_overhead",
-          "health_overhead", "cold_start", "refresh", "backfill", "lstm")
+          "health_overhead", "cold_start", "multi_device", "refresh",
+          "backfill", "scores_lifecycle", "lstm")
 
 
 def parse_cli(argv: "list[str]") -> "tuple[list[str], int | None]":
@@ -3132,12 +3624,20 @@ def main(argv: "list[str] | None" = None) -> None:
             lambda: bench_cold_start(out),
             lambda: min(remaining() * 0.7, 420),
         ),
+        "multi_device": (
+            lambda: bench_multi_device(out),
+            lambda: min(remaining() * 0.8, 900),
+        ),
         "refresh": (
             lambda: bench_refresh(out),
             lambda: min(remaining() * 0.8, 900),
         ),
         "backfill": (
             lambda: bench_backfill(out),
+            lambda: min(remaining() * 0.8, 900),
+        ),
+        "scores_lifecycle": (
+            lambda: bench_scores_lifecycle(out),
             lambda: min(remaining() * 0.8, 900),
         ),
         "lstm": (
@@ -3160,4 +3660,10 @@ def main(argv: "list[str] | None" = None) -> None:
 
 
 if __name__ == "__main__":
+    # forked measurement child for bench_multi_device — dispatched before
+    # main() so the parent's argparse (whose choices are STAGES) never
+    # sees the child flags
+    if "--scaleout-child" in sys.argv:
+        argv = [a for a in sys.argv[1:] if a != "--scaleout-child"]
+        scaleout_child_main(argv)
     main()

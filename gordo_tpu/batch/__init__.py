@@ -7,11 +7,19 @@ plane, data from dataset providers, scores into the columnar
 """
 
 from gordo_tpu.batch.archive import (  # noqa: F401
+    AGGREGATE_STATS,
     ARCHIVE_DIR,
     ArchiveError,
     ArchivePlanError,
     ScoreArchive,
     archive_root,
+)
+from gordo_tpu.batch.compact import (  # noqa: F401
+    compact_scores,
+    gc_scores,
+    ls_scores,
+    plan_compaction,
+    stat_scores,
 )
 from gordo_tpu.batch.runner import (  # noqa: F401
     BackfillConfig,
@@ -22,11 +30,17 @@ from gordo_tpu.batch.runner import (  # noqa: F401
 )
 
 __all__ = [
+    "AGGREGATE_STATS",
     "ARCHIVE_DIR",
     "ArchiveError",
     "ArchivePlanError",
     "ScoreArchive",
     "archive_root",
+    "compact_scores",
+    "gc_scores",
+    "ls_scores",
+    "plan_compaction",
+    "stat_scores",
     "BackfillConfig",
     "BackfillError",
     "chunk_windows",

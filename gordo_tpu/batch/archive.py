@@ -23,12 +23,24 @@ pack durability idioms (magic + version header, page alignment, tmp +
   window.  Columns per machine: ``index-ns`` (int64 UTC nanoseconds of
   each scored row), ``total-anomaly-score`` (float32 ``[rows]``) and
   ``tag-anomaly-scores`` (float32 ``[rows, n_tags]``).
+- ``period-<YYYYmmddTHHMMSS>.seg`` — a compacted period file (same GSA1
+  layout, one per time partition): ``gordo scores compact``
+  (:mod:`gordo_tpu.batch.compact`) merges every chunk segment whose
+  window starts inside the partition into one segment, across shards,
+  with each machine's rows concatenated in chunk order — so reads are
+  byte-identical pre/post compaction.  The index's ``periods`` table
+  maps partition key → {segment, chunks, rows}; merged chunk records
+  keep their completion entry (the resume ledger) with ``segment``
+  nulled and ``period`` pointing at the partition that absorbed them.
 
 Resumability contract: a chunk either has a completion record (its
 segment is fully durable — the record is written only after the segment
 fsyncs) or it does not exist.  A re-run lists the records, skips what is
 done, and recomputes the rest; the deterministic chunk plan makes the
 result byte-identical to an uninterrupted run (pinned by test).
+Compaction extends the contract: a period file is fsynced and flipped
+into the index before the chunk segments it replaces are unlinked, so a
+kill mid-compact never loses a completed period (chaos-pinned).
 
 This module is host-side I/O only: no jax, no HTTP (the batch-plane
 lint gate bans server/client imports from the whole package).
@@ -40,7 +52,9 @@ import fcntl
 import json
 import os
 import struct
+import threading
 import time
+from collections import OrderedDict
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
@@ -66,6 +80,19 @@ ALIGN = 64
 #: the three columns every machine entry carries, in layout order
 COLUMNS = ("index-ns", "total-anomaly-score", "tag-anomaly-scores")
 
+#: default stat set of :meth:`ScoreArchive.aggregate` (any ``pNN``
+#: percentile in 1..99 is accepted beyond these)
+AGGREGATE_STATS = ("count", "mean", "max", "p50", "p90", "p99", "exceed")
+
+
+def _quantile_q(stat: str) -> Optional[float]:
+    """``"p99" -> 0.99`` for percentile stat names, else None."""
+    if len(stat) >= 2 and stat[0] == "p" and stat[1:].isdigit():
+        n = int(stat[1:])
+        if 1 <= n <= 99:
+            return n / 100.0
+    return None
+
 
 class ArchiveError(RuntimeError):
     """Corrupt or unreadable archive state."""
@@ -87,6 +114,24 @@ def _segment_name(chunk: int, shard: int) -> str:
 
 def _chunk_key(chunk: int, shard: int) -> str:
     return f"{chunk}/{shard}"
+
+
+def _period_name(key: str) -> str:
+    """File name of a compacted period partition (key = the partition's
+    UTC start stamped ``YYYYmmddTHHMMSS`` — lexical order IS time
+    order)."""
+    return f"period-{key}.seg"
+
+
+def _ts_ns(value: Any) -> int:
+    """UTC nanoseconds of anything ``pd.Timestamp`` accepts (naive
+    values are taken as UTC, matching ``read_machine``'s clip)."""
+    import pandas as pd
+
+    ts = pd.Timestamp(value)
+    if ts.tzinfo is None:
+        ts = ts.tz_localize("UTC")
+    return int(ts.value)
 
 
 # ---------------------------------------------------------------------------
@@ -141,51 +186,93 @@ def _locked_index_update(
 # segment encode/decode
 # ---------------------------------------------------------------------------
 
-def _encode_segment(
+def _segment_layout(
     chunk: int,
     shard: int,
-    per_machine: Dict[str, Dict[str, Any]],
-) -> Tuple[bytes, Dict[str, Any]]:
-    """Serialize one chunk's machine columns: returns ``(bytes, header)``.
+    machines_meta: Dict[str, Dict[str, Any]],
+    extra: Optional[Dict[str, Any]] = None,
+) -> Tuple[Dict[str, Any], bytes, int, int]:
+    """Header + byte layout of a segment WITHOUT touching column data.
 
-    ``per_machine[name]`` carries the three COLUMNS arrays plus ``tags``
-    (the column names of the tag-anomaly matrix, for self-describing
-    reads)."""
+    ``machines_meta[name]`` is ``{"tags": [...], "columns": {col:
+    (dtype_str, shape_tuple)}}``.  Returns ``(header, prefix,
+    payload_base, payload_bytes)`` — the single source of truth for
+    column placement, shared by the in-memory chunk encoder and the
+    streaming period writer so both produce identical bytes."""
     header: Dict[str, Any] = {
         "gordo-score-segment": SEGMENT_VERSION,
         "chunk": int(chunk),
         "shard": int(shard),
         "machines": {},
     }
-    layout: List[Tuple[int, np.ndarray]] = []
+    if extra:
+        header.update(extra)
     pos = 0
-    for name in sorted(per_machine):
-        rec = per_machine[name]
+    for name in sorted(machines_meta):
+        rec = machines_meta[name]
         entry: Dict[str, Any] = {
             "tags": list(rec.get("tags") or ()),
             "columns": {},
         }
         for col in COLUMNS:
-            arr = np.ascontiguousarray(rec[col])
+            dtype_str, shape = rec["columns"][col]
             pos = (pos + ALIGN - 1) // ALIGN * ALIGN
             entry["columns"][col] = {
                 "offset": pos,
-                "dtype": str(arr.dtype),
-                "shape": list(arr.shape),
+                "dtype": dtype_str,
+                "shape": list(shape),
             }
-            layout.append((pos, arr))
-            pos += arr.nbytes
-        entry["rows"] = int(np.asarray(rec["index-ns"]).shape[0])
+            pos += int(
+                np.dtype(dtype_str).itemsize
+                * np.prod(shape, dtype=np.int64)
+            )
+        entry["rows"] = int(rec["columns"]["index-ns"][1][0])
         header["machines"][name] = entry
 
     head = json.dumps(header, sort_keys=True).encode()
     prefix = SEGMENT_MAGIC + struct.pack("<I", len(head)) + head
     payload_base = (len(prefix) + PAGE - 1) // PAGE * PAGE
-    buf = bytearray(payload_base + pos)
+    return header, prefix, payload_base, pos
+
+
+def _encode_segment(
+    chunk: int,
+    shard: int,
+    per_machine: Dict[str, Dict[str, Any]],
+    extra: Optional[Dict[str, Any]] = None,
+) -> Tuple[bytes, Dict[str, Any]]:
+    """Serialize one chunk's machine columns: returns ``(bytes, header)``.
+
+    ``per_machine[name]`` carries the three COLUMNS arrays plus ``tags``
+    (the column names of the tag-anomaly matrix, for self-describing
+    reads).  ``extra`` merges additional header fields (compaction
+    stamps the period key and merged chunk list)."""
+    arrays = {
+        name: {
+            col: np.ascontiguousarray(rec[col]) for col in COLUMNS
+        }
+        for name, rec in per_machine.items()
+    }
+    meta = {
+        name: {
+            "tags": per_machine[name].get("tags"),
+            "columns": {
+                col: (str(a.dtype), a.shape)
+                for col, a in cols.items()
+            },
+        }
+        for name, cols in arrays.items()
+    }
+    header, prefix, payload_base, payload = _segment_layout(
+        chunk, shard, meta, extra
+    )
+    buf = bytearray(payload_base + payload)
     buf[: len(prefix)] = prefix
-    for off, arr in layout:
-        raw = arr.tobytes()
-        buf[payload_base + off: payload_base + off + len(raw)] = raw
+    for name, cols in arrays.items():
+        entry = header["machines"][name]["columns"]
+        for col, arr in cols.items():
+            off = payload_base + int(entry[col]["offset"])
+            buf[off: off + arr.nbytes] = arr.tobytes()
     return bytes(buf), header
 
 
@@ -214,6 +301,70 @@ def _mmap_column(path: str, payload_base: int, col: Dict[str, Any]):
         offset=payload_base + int(col["offset"]),
         shape=tuple(col["shape"]),
     )
+
+
+#: parsed segment headers keyed by (dev, inode, size, mtime_ns).
+#: Segments are IMMUTABLE once visible — writers publish with
+#: os.replace (fresh inode, fresh mtime), so a matching key proves the
+#: cached parse is current.  Bounded LRU: a long-lived server watching
+#: a compacting archive must not pin headers of long-unlinked segment
+#: files forever.
+_HEADER_CACHE: "OrderedDict[Tuple[int, int, int, int], Tuple[Dict[str, Any], int]]" = (  # noqa: E501
+    OrderedDict()
+)
+_HEADER_CACHE_MAX = 512
+_HEADER_CACHE_LOCK = threading.Lock()
+
+
+def _segment_header(path: str) -> Tuple[Dict[str, Any], int]:
+    """``(header, payload_base)`` of a segment via the immutability cache.
+
+    Fleet-scale reads (aggregate / read_machine over N machines) touch
+    every segment once per MACHINE, and the header JSON itself grows
+    with the roster — re-parsing it per touch makes the scan quadratic
+    in fleet size (measured r20: 74% of a 512-machine aggregate was
+    header re-parsing).  The cache turns that into one parse per
+    segment per generation."""
+    st = os.stat(path)
+    key = (st.st_dev, st.st_ino, st.st_size, st.st_mtime_ns)
+    with _HEADER_CACHE_LOCK:
+        hit = _HEADER_CACHE.get(key)
+        if hit is not None:
+            _HEADER_CACHE.move_to_end(key)
+            return hit
+    parsed = _read_segment_header(path)
+    with _HEADER_CACHE_LOCK:
+        _HEADER_CACHE[key] = parsed
+        _HEADER_CACHE.move_to_end(key)
+        while len(_HEADER_CACHE) > _HEADER_CACHE_MAX:
+            _HEADER_CACHE.popitem(last=False)
+    return parsed
+
+
+def _segment_buffer(path: str) -> np.ndarray:
+    """The whole segment mmapped once as raw bytes.  Fleet-scale scans
+    slice per-machine column views out of this with :func:`_column_view`
+    instead of paying an open+mmap syscall pair per (machine, column) —
+    ~45µs each, the second quadratic term after header parsing.
+
+    Returned as a PLAIN ndarray view (the mmap stays alive through
+    ``.base``): ufuncs and ``np.concatenate`` drop into subclass-safe
+    slow paths when any operand is an ``np.memmap``, measured 6.6x
+    slower than the same copy through a base-class view."""
+    return np.asarray(np.memmap(path, dtype=np.uint8, mode="r"))
+
+
+def _column_view(
+    buf: np.ndarray, payload_base: int, col: Dict[str, Any]
+) -> np.ndarray:
+    """Zero-copy ndarray view of one column inside a segment buffer."""
+    dtype = np.dtype(col["dtype"])
+    shape = tuple(col["shape"])
+    start = payload_base + int(col["offset"])
+    n = dtype.itemsize
+    for dim in shape:
+        n *= int(dim)
+    return buf[start: start + n].view(dtype).reshape(shape)
 
 
 # ---------------------------------------------------------------------------
@@ -361,9 +512,14 @@ class ScoreArchive:
 
     # -- reading -------------------------------------------------------------
 
+    def periods(self) -> Dict[str, Dict[str, Any]]:
+        """The compaction table: partition key → {segment, chunks, rows}."""
+        doc = self.index()
+        return dict(doc.get("periods") or {}) if doc else {}
+
     def _completed_segments(self) -> List[Tuple[int, int, str]]:
-        """``(chunk, shard, path)`` of every recorded segment, in chunk
-        order (shard as tiebreak) — concatenation order for reads."""
+        """``(chunk, shard, path)`` of every recorded chunk segment, in
+        chunk order (shard as tiebreak)."""
         out = []
         for key, rec in self.chunk_records().items():
             if not rec.get("segment"):
@@ -373,6 +529,31 @@ class ScoreArchive:
                 (int(c), int(s), os.path.join(self.directory, rec["segment"]))
             )
         return sorted(out)
+
+    def _data_segments(self) -> List[str]:
+        """Every data segment (chunk files AND compacted period files)
+        in time order — the concatenation order for reads.  A period
+        file sorts at its first merged chunk; its chunks are contiguous
+        and disjoint from every surviving chunk segment (compaction only
+        absorbs whole periods), so interleaving by (first-chunk, shard)
+        reproduces the uncompacted concatenation order exactly — the
+        byte-consistency contract."""
+        doc = self.index() or {}
+        out: List[Tuple[Tuple[int, int], str]] = []
+        for key, rec in (doc.get("chunks") or {}).items():
+            if not rec.get("segment"):
+                continue
+            c, s = key.split("/")
+            out.append(
+                ((int(c), int(s)),
+                 os.path.join(self.directory, rec["segment"]))
+            )
+        for rec in (doc.get("periods") or {}).values():
+            first = min(int(c) for c in rec["chunks"])
+            out.append(
+                ((first, -1), os.path.join(self.directory, rec["segment"]))
+            )
+        return [path for _key, path in sorted(out)]
 
     def read_machine(
         self,
@@ -391,9 +572,10 @@ class ScoreArchive:
         tot_parts: List[np.ndarray] = []
         tag_parts: List[np.ndarray] = []
         tags: List[str] = []
-        for _c, _s, path in self._completed_segments():
+        buffers: Dict[str, np.ndarray] = {}
+        for path in self._data_segments():
             try:
-                header, base = _read_segment_header(path)
+                header, base = _segment_header(path)
             except FileNotFoundError:
                 raise ArchiveError(
                     f"{path}: completion record exists but segment is "
@@ -402,19 +584,16 @@ class ScoreArchive:
             entry = header["machines"].get(name)
             if entry is None:
                 continue
+            buf = buffers.get(path)
+            if buf is None:
+                buf = buffers[path] = _segment_buffer(path)
             cols = entry["columns"]
-            idx_parts.append(
-                np.asarray(_mmap_column(path, base, cols["index-ns"]))
-            )
+            idx_parts.append(_column_view(buf, base, cols["index-ns"]))
             tot_parts.append(
-                np.asarray(
-                    _mmap_column(path, base, cols["total-anomaly-score"])
-                )
+                _column_view(buf, base, cols["total-anomaly-score"])
             )
             tag_parts.append(
-                np.asarray(
-                    _mmap_column(path, base, cols["tag-anomaly-scores"])
-                )
+                _column_view(buf, base, cols["tag-anomaly-scores"])
             )
             tags = tags or list(entry.get("tags") or ())
         if not idx_parts:
@@ -448,14 +627,235 @@ class ScoreArchive:
             "tags": tags,
         }
 
+    def _machine_series(
+        self,
+        name: str,
+        lo_ns: Optional[int] = None,
+        hi_ns: Optional[int] = None,
+        segments: Optional[List[str]] = None,
+        buffers: Optional[Dict[str, np.ndarray]] = None,
+    ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """``(index-ns, total-anomaly-score)`` for one machine, clipped
+        to ``[lo_ns, hi_ns)`` — the aggregation scan.  Touches ONLY the
+        two scalar columns' pages (the tag matrix, ~80% of segment
+        bytes, is never faulted in), which is what makes pushdown run at
+        mmap scan speed instead of full-archive read speed.
+
+        ``segments`` / ``buffers`` let a fleet-wide caller (aggregate)
+        resolve the segment list once and share one mmap per segment
+        across every machine instead of re-reading index.json and
+        re-mapping per machine."""
+        idx_parts: List[np.ndarray] = []
+        tot_parts: List[np.ndarray] = []
+        if segments is None:
+            segments = self._data_segments()
+        if buffers is None:
+            buffers = {}
+        for path in segments:
+            try:
+                header, base = _segment_header(path)
+            except FileNotFoundError:
+                raise ArchiveError(
+                    f"{path}: completion record exists but segment is "
+                    "missing — archive is torn; delete and re-run"
+                )
+            entry = header["machines"].get(name)
+            if entry is None:
+                continue
+            buf = buffers.get(path)
+            if buf is None:
+                buf = buffers[path] = _segment_buffer(path)
+            cols = entry["columns"]
+            idx_parts.append(_column_view(buf, base, cols["index-ns"]))
+            tot_parts.append(
+                _column_view(buf, base, cols["total-anomaly-score"])
+            )
+        if not idx_parts:
+            return None
+        index_ns = np.concatenate(idx_parts)
+        total = np.concatenate(tot_parts)
+        if lo_ns is not None or hi_ns is not None:
+            lo = -(2 ** 63) if lo_ns is None else int(lo_ns)
+            hi = 2 ** 63 - 1 if hi_ns is None else int(hi_ns)
+            keep = (index_ns >= lo) & (index_ns < hi)
+            index_ns, total = index_ns[keep], total[keep]
+        return index_ns, total
+
+    def aggregate(
+        self,
+        machines: Optional[Iterable[str]] = None,
+        start: Optional[Any] = None,
+        end: Optional[Any] = None,
+        *,
+        stats: Optional[Iterable[str]] = None,
+        period: Any = "1d",
+        threshold: float = 1.0,
+    ) -> Dict[str, Any]:
+        """Per-machine, per-period summary statistics scanned straight
+        off the mmap columns — the aggregation pushdown.
+
+        ``period`` is any ``pd.Timedelta`` string (default ``"1d"``);
+        periods are epoch-aligned ``[k*period, (k+1)*period)`` windows
+        covering ``[start, end)`` (default: the archive plan's span).
+        ``stats`` picks from ``count`` / ``mean`` / ``max`` / ``exceed``
+        (rows with score strictly above ``threshold``) / ``pNN``
+        (N in 1..99).  Percentiles are sketch-resolution upper bounds:
+        rows bin into the r14 fleet-health half-octave histogram
+        (bit-extraction binning, identical to ``ScoreSketch.observe``)
+        and ``pNN`` reports the upper edge of the bucket holding the
+        N-th percentile — at most one half-octave above the exact
+        sample percentile, and exactly mergeable, so results are
+        byte-identical pre/post compaction (rows concatenate in the
+        same order either way; pinned by test and bench).
+
+        Returns ``{"machines", "periods", "period", "period-ns",
+        "threshold", "start", "end", "stats": {name: [n_machines,
+        n_periods] array}}``.  Empty (machine, period) cells read 0 for
+        count/exceed and NaN for mean/max/percentiles."""
+        import pandas as pd
+
+        from gordo_tpu.telemetry import fleet_health as _sketch
+
+        doc = self.index()
+        if not doc or not doc.get("plan"):
+            raise ArchiveError(
+                f"{self.directory}: no score archive to aggregate"
+            )
+        plan = doc["plan"]
+        wanted = tuple(stats) if stats else AGGREGATE_STATS
+        quantiles = {}
+        for s in wanted:
+            if s in ("count", "mean", "max", "exceed"):
+                continue
+            q = _quantile_q(s)
+            if q is None:
+                raise ValueError(
+                    f"unknown aggregate stat {s!r}; supported: count,"
+                    " mean, max, exceed, p1..p99"
+                )
+            quantiles[s] = q
+        period_ns = int(pd.Timedelta(period).value)
+        if period_ns <= 0:
+            raise ValueError(
+                f"aggregation period must be positive, got {period!r}"
+            )
+        lo_ns = _ts_ns(plan["start"] if start is None else start)
+        hi_ns = _ts_ns(plan["end"] if end is None else end)
+        names = (
+            list(machines) if machines is not None
+            else list(doc.get("machines") or ())
+        )
+        p_lo = lo_ns // period_ns
+        n_p = (
+            (hi_ns - 1) // period_ns - p_lo + 1 if hi_ns > lo_ns else 0
+        )
+        n_m = len(names)
+
+        count = np.zeros((n_m, n_p), dtype=np.int64)
+        sums = np.zeros((n_m, n_p), dtype=np.float64)
+        maxs = np.full((n_m, n_p), np.nan, dtype=np.float32)
+        exceed = np.zeros((n_m, n_p), dtype=np.int64)
+        hist = (
+            np.zeros((n_m, n_p, _sketch.N_SLOTS), dtype=np.int64)
+            if quantiles and n_p else None
+        )
+        thr = float(threshold)
+        segments = self._data_segments() if n_m and n_p else []
+        buffers: Dict[str, np.ndarray] = {}
+        for i, name in enumerate(names):
+            if not n_p:
+                break
+            series = self._machine_series(
+                name, lo_ns, hi_ns, segments=segments, buffers=buffers
+            )
+            if series is None:
+                continue
+            ns, total = series
+            if ns.size == 0:
+                continue
+            # rows are time-sorted (chunk plan order, preserved by
+            # compaction), so period ids are non-decreasing: per-period
+            # reductions are reduceat over the run boundaries — one
+            # O(rows) pass, no sort
+            pid = ns // period_ns - p_lo
+            uniq, starts = np.unique(pid, return_index=True)
+            count[i, uniq] = np.diff(np.append(starts, ns.size))
+            sums[i, uniq] = np.add.reduceat(
+                total.astype(np.float64), starts
+            )
+            maxs[i, uniq] = np.maximum.reduceat(total, starts)
+            exceed[i, uniq] = np.add.reduceat(
+                (total > thr).astype(np.int64), starts
+            )
+            if hist is not None:
+                f32 = np.ascontiguousarray(total, dtype=np.float32)
+                slot = (
+                    (f32.view(np.int32) >> 22) - (_sketch._RAW_LO - 1)
+                ).astype(np.int64)
+                np.clip(slot, 0, _sketch.N_SLOTS - 1, out=slot)
+                hist[i] = np.bincount(
+                    pid * _sketch.N_SLOTS + slot,
+                    minlength=n_p * _sketch.N_SLOTS,
+                ).reshape(n_p, _sketch.N_SLOTS)
+
+        out_stats: Dict[str, np.ndarray] = {}
+        cum = hist.cumsum(axis=2) if hist is not None else None
+        # slot → value: the bucket's UPPER edge (underflow reads the
+        # lowest edge, overflow +inf) — a guaranteed upper bound
+        upper = np.concatenate(
+            [_sketch.EDGES[:1], _sketch.EDGES[1:], [np.inf]]
+        ).astype(np.float32)
+        for s in wanted:
+            if s == "count":
+                out_stats[s] = count
+            elif s == "exceed":
+                out_stats[s] = exceed
+            elif s == "max":
+                out_stats[s] = maxs
+            elif s == "mean":
+                mean = np.full((n_m, n_p), np.nan, dtype=np.float64)
+                np.divide(sums, count, out=mean, where=count > 0)
+                out_stats[s] = mean
+            else:
+                vals = np.full((n_m, n_p), np.nan, dtype=np.float32)
+                if cum is not None:
+                    k = np.maximum(
+                        np.ceil(quantiles[s] * count), 1
+                    ).astype(np.int64)
+                    slot_idx = (cum < k[..., None]).sum(axis=2)
+                    np.clip(slot_idx, 0, _sketch.N_SLOTS - 1,
+                            out=slot_idx)
+                    vals = upper[slot_idx]
+                    vals[count == 0] = np.nan
+                out_stats[s] = vals
+
+        return {
+            "machines": [str(n) for n in names],
+            "periods": [
+                pd.Timestamp((p_lo + j) * period_ns, tz="UTC").isoformat()
+                for j in range(n_p)
+            ],
+            "period": str(period),
+            "period-ns": period_ns,
+            "threshold": thr,
+            "start": pd.Timestamp(lo_ns, tz="UTC").isoformat(),
+            "end": pd.Timestamp(hi_ns, tz="UTC").isoformat(),
+            "stats": out_stats,
+        }
+
     def summary(self) -> Dict[str, Any]:
         doc = self.index() or {}
         chunks = doc.get("chunks") or {}
+        periods = doc.get("periods") or {}
         return {
             "directory": self.directory,
             "plan": doc.get("plan"),
             "machines": len(doc.get("machines") or ()),
             "chunks-completed": len(chunks),
             "rows": sum(int(r.get("rows", 0)) for r in chunks.values()),
-            "segments": sum(1 for r in chunks.values() if r.get("segment")),
+            "segments": (
+                sum(1 for r in chunks.values() if r.get("segment"))
+                + len(periods)
+            ),
+            "periods-compacted": len(periods),
         }

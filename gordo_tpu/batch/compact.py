@@ -1,0 +1,601 @@
+"""Score-archive lifecycle: compaction and retention.
+
+Reference status: absent upstream — the reference stack had no score
+store at all, let alone a lifecycle for one.  The r18 backfill plane
+writes one GSA1 segment per (time-chunk, shard) and never merges or
+deletes, so a fleet that scores continuously grows ``.gordo-scores/``
+without bound in both bytes and file count.  This module is the
+lifecycle half of the archive's production story (the query half is
+:meth:`ScoreArchive.aggregate`):
+
+- :func:`compact_scores` (``gordo scores compact``) merges the small
+  per-chunk segments of each closed time partition into ONE period file
+  (``period-<key>.seg``, same GSA1 layout), across shards, keeping every
+  machine's rows in chunk order so reads stay byte-identical.  The
+  discipline is write-new-then-flip, borrowed from the artifact plane's
+  generation writes: the period file is written to a tmp name, fsynced,
+  renamed, and only THEN does the flock-serialized index flip the chunk
+  records over to it — after which the absorbed chunk segments are
+  unlinked.  A kill at any point loses nothing: pre-flip the chunk
+  segments still back every read and the next run rewrites the same
+  period bytes (the merge is deterministic); post-flip the period file
+  is durable and leftovers are swept.  The ``scores.compact`` fault
+  point fires between the tmp fsync and the rename — the chaos suite's
+  kill-mid-compact seam.
+- :func:`gc_scores` (``gordo scores gc --keep DAYS``) prunes segments
+  whose entire window is older than the cutoff, mirroring the r15
+  artifact-generation gc: refuse a keep that would empty the archive,
+  mutate the index first (a read never sees a record pointing at an
+  unlinked file), unlink after, report a JSON summary.  Completion
+  records survive pruning (``pruned: true``) so a backfill resume never
+  re-scores — and thereby silently resurrects — retired windows.
+
+Both report through ``gordo_scores_*`` telemetry (segments merged,
+bytes written/reclaimed) so fleet dashboards can watch the lifecycle
+run.  Host-side I/O only, like the rest of the batch plane (lint-gated:
+no server/client/HTTP imports).
+"""
+
+from __future__ import annotations
+
+import fcntl
+import logging
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from gordo_tpu import faults, telemetry
+from gordo_tpu.batch.archive import (
+    COLUMNS,
+    LOCK_FILE,
+    ArchiveError,
+    ScoreArchive,
+    _column_view,
+    _locked_index_update,
+    _period_name,
+    _read_index,
+    _segment_buffer,
+    _segment_header,
+    _segment_layout,
+    _ts_ns,
+)
+from gordo_tpu.utils.disk_registry import fsync_dir
+
+logger = logging.getLogger(__name__)
+
+#: compaction partition length (any ``pd.Timedelta`` string); the CLI
+#: and :func:`compact_scores` default to this env var, then ``"1d"``
+ENV_PERIOD = "GORDO_SCORES_PERIOD"
+#: retention default for ``gordo scores gc`` (days)
+ENV_KEEP = "GORDO_SCORES_KEEP"
+
+DEFAULT_PERIOD = "1d"
+DEFAULT_KEEP_DAYS = 90
+
+_PERIODS_COMPACTED = telemetry.counter(
+    "gordo_scores_periods_compacted_total",
+    "Time partitions merged into period files by score-archive "
+    "compaction",
+)
+_SEGMENTS_MERGED = telemetry.counter(
+    "gordo_scores_segments_merged_total",
+    "Per-chunk segments absorbed into period files by compaction",
+)
+_COMPACT_BYTES_WRITTEN = telemetry.counter(
+    "gordo_scores_compact_bytes_written_total",
+    "Bytes of period files written by score-archive compaction",
+)
+_COMPACT_BYTES_RECLAIMED = telemetry.counter(
+    "gordo_scores_compact_bytes_reclaimed_total",
+    "Bytes of absorbed chunk segments unlinked after a period flip",
+)
+_GC_SEGMENTS = telemetry.counter(
+    "gordo_scores_gc_segments_total",
+    "Score-archive segments deleted by retention gc",
+)
+_GC_BYTES_RECLAIMED = telemetry.counter(
+    "gordo_scores_gc_bytes_reclaimed_total",
+    "Bytes reclaimed by score-archive retention gc",
+)
+
+
+def _resolve_period(period: Optional[Any]) -> Tuple[str, int]:
+    """``(spelling, nanoseconds)`` of the compaction partition length
+    (arg > ``GORDO_SCORES_PERIOD`` > ``"1d"``)."""
+    import pandas as pd
+
+    if period is None:
+        period = os.environ.get(ENV_PERIOD, "") or DEFAULT_PERIOD
+    ns = int(pd.Timedelta(period).value)
+    if ns <= 0:
+        raise ValueError(
+            f"compaction period must be positive, got {period!r}"
+        )
+    return str(period), ns
+
+
+def _chunk_geometry(plan: Dict[str, Any]) -> Tuple[int, int]:
+    """``(plan start ns, chunk span ns)`` — chunk ``c`` covers
+    ``[start + c*span, start + (c+1)*span)``."""
+    import pandas as pd
+
+    step_ns = int(pd.Timedelta(plan["resolution"]).value)
+    return _ts_ns(plan["start"]), int(plan["chunk-rows"]) * step_ns
+
+
+def _period_key(start_ns: int) -> str:
+    import pandas as pd
+
+    return pd.Timestamp(start_ns, tz="UTC").strftime("%Y%m%dT%H%M%S")
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    return True
+
+
+# ---------------------------------------------------------------------------
+# compaction
+# ---------------------------------------------------------------------------
+
+def plan_compaction(
+    root: str, period: Optional[Any] = None
+) -> Dict[str, Any]:
+    """What ``compact_scores`` would merge: partition key →
+    ``{"chunks", "segments", "start-ns"}`` for every ELIGIBLE partition
+    — all of its chunks have completion records for every shard of the
+    job, it is not already compacted, and it holds at least two segment
+    files (merging one is churn, not compaction).  Read-only."""
+    arch = ScoreArchive(root)
+    doc = arch.index()
+    if not doc or not doc.get("plan"):
+        raise ArchiveError(f"{arch.directory}: no score archive to compact")
+    plan = doc["plan"]
+    period_str, period_ns = _resolve_period(period)
+    start_ns, span_ns = _chunk_geometry(plan)
+    records = doc.get("chunks") or {}
+    done = doc.get("periods") or {}
+    shard_meta = doc.get("shards") or {}
+    n_shards = max(
+        [int(v.get("of", 1)) for v in shard_meta.values()] + [1]
+    )
+
+    by_period: Dict[int, List[int]] = {}
+    for c in range(int(plan["n-chunks"])):
+        p = (start_ns + c * span_ns) // period_ns
+        by_period.setdefault(p, []).append(c)
+
+    eligible: Dict[str, Dict[str, Any]] = {}
+    for p, chunks in sorted(by_period.items()):
+        key = _period_key(p * period_ns)
+        if key in done:
+            continue
+        segments: List[Tuple[int, int, str]] = []
+        complete = True
+        for c in chunks:
+            for s in range(n_shards):
+                rec = records.get(f"{c}/{s}")
+                if rec is None:
+                    complete = False
+                    break
+                if rec.get("segment"):
+                    segments.append((c, s, rec["segment"]))
+            if not complete:
+                break
+        if not complete or len(segments) < 2:
+            continue
+        eligible[key] = {
+            "chunks": list(chunks),
+            "segments": sorted(segments),
+            "start-ns": p * period_ns,
+        }
+    return {
+        "directory": arch.directory,
+        "period": period_str,
+        "period-ns": period_ns,
+        "eligible": eligible,
+    }
+
+
+def _merge_sources(
+    directory: str, segments: List[Tuple[int, int, str]]
+) -> Tuple[Dict[str, Dict[str, List[np.ndarray]]], Dict[str, List[str]]]:
+    """Zero-copy mmap views of every machine's columns across
+    ``segments`` in (chunk, shard) order — exactly the order
+    ``_data_segments`` reads uncompacted files in, so the merged period
+    file is byte-consistent with the segments it replaces.  Returns
+    ``(sources, tags)``; nothing is materialized until the views are
+    concatenated straight into the period file."""
+    sources: Dict[str, Dict[str, List[np.ndarray]]] = {}
+    tags: Dict[str, List[str]] = {}
+    for _c, _s, fname in segments:
+        path = os.path.join(directory, fname)
+        try:
+            header, base = _segment_header(path)
+        except FileNotFoundError:
+            raise ArchiveError(
+                f"{path}: completion record exists but segment is "
+                "missing — archive is torn; delete and re-run"
+            )
+        buf = _segment_buffer(path)
+        for name, entry in header["machines"].items():
+            cols = entry["columns"]
+            slot = sources.setdefault(name, {col: [] for col in COLUMNS})
+            if name not in tags:
+                tags[name] = list(entry.get("tags") or ())
+            for col in COLUMNS:
+                slot[col].append(_column_view(buf, base, cols[col]))
+    return sources, tags
+
+
+def _write_period_file(
+    tmp: str,
+    key: str,
+    chunks: List[int],
+    sources: Dict[str, Dict[str, List[np.ndarray]]],
+    tags: Dict[str, List[str]],
+) -> Tuple[int, Dict[str, Any]]:
+    """Stream the merged period segment into ``tmp`` in ONE data pass:
+    layout is computed from column metadata alone, the file is sized
+    with ftruncate, and each output column is concatenated directly
+    into its mmapped destination slice (``np.concatenate(out=...)``) —
+    no intermediate merged arrays, no ``tobytes`` staging, no bytearray
+    assembly.  r20 measured the staged encoder at 5 memory passes per
+    byte (60 MB/s wall); this path is bounded by one memcpy plus the
+    fsync.  Returns ``(bytes_written, header)``; the tmp is fsynced but
+    NOT renamed — the caller owns the flip."""
+    meta = {}
+    for name, cols in sources.items():
+        colmeta = {}
+        for col in COLUMNS:
+            parts = cols[col]
+            rows = int(sum(p.shape[0] for p in parts))
+            shape = (rows,) + tuple(parts[0].shape[1:])
+            colmeta[col] = (str(parts[0].dtype), shape)
+        meta[name] = {"tags": tags.get(name) or [], "columns": colmeta}
+    header, prefix, payload_base, payload = _segment_layout(
+        min(chunks), -1, meta,
+        extra={"period": key, "chunks": sorted(chunks)},
+    )
+    total = payload_base + payload
+    with open(tmp, "wb") as fh:
+        os.ftruncate(fh.fileno(), total)
+        dest = np.memmap(tmp, dtype=np.uint8, mode="r+", shape=(total,))
+        # copy through a base-class view: concatenate with an np.memmap
+        # operand takes a subclass-safe path measured 6.6x slower
+        payload_view = np.asarray(dest)
+        payload_view[: len(prefix)] = np.frombuffer(prefix, dtype=np.uint8)
+        for name, cols in sources.items():
+            entry = header["machines"][name]["columns"]
+            for col in COLUMNS:
+                view = _column_view(payload_view, payload_base, entry[col])
+                np.concatenate(cols[col], axis=0, out=view)
+        dest.flush()
+        del dest
+        os.fsync(fh.fileno())
+    return total, header
+
+
+def _compact_one(
+    arch: ScoreArchive, key: str, info: Dict[str, Any]
+) -> Dict[str, Any]:
+    """Merge one partition: write-new, fault seam, flip, unlink."""
+    segments: List[Tuple[int, int, str]] = info["segments"]
+    sources, tags = _merge_sources(arch.directory, segments)
+    fname = _period_name(key)
+    path = os.path.join(arch.directory, fname)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    nbytes, header = _write_period_file(
+        tmp, key, info["chunks"], sources, tags
+    )
+    # the kill-mid-compact seam: a crash between the tmp fsync and the
+    # flip loses nothing — every read still resolves to the chunk
+    # segments, and the next run deterministically rewrites these bytes
+    faults.check("scores.compact", period=key)
+    os.replace(tmp, path)
+    fsync_dir(arch.directory)
+
+    expected = {f"{c}/{s}": name for c, s, name in segments}
+    chunk_set = set(info["chunks"])
+
+    def mutate(doc: Dict[str, Any]) -> None:
+        chunks = doc.setdefault("chunks", {})
+        for ck, want in expected.items():
+            rec = chunks.get(ck)
+            if rec is None or rec.get("segment") != want:
+                raise ArchiveError(
+                    f"score archive changed under compaction "
+                    f"(chunk {ck}); re-run"
+                )
+        for ck, rec in chunks.items():
+            if int(ck.split("/")[0]) in chunk_set:
+                rec["segment"] = None
+                rec["period"] = key
+        doc.setdefault("periods", {})[key] = {
+            "segment": fname,
+            "chunks": sorted(chunk_set),
+            "rows": int(sum(
+                e["rows"] for e in header["machines"].values()
+            )),
+            "bytes": nbytes,
+            "compacted-at": time.time(),
+        }
+
+    _locked_index_update(arch.directory, mutate)
+
+    reclaimed = 0
+    for _c, _s, old in segments:
+        old_path = os.path.join(arch.directory, old)
+        try:
+            size = os.path.getsize(old_path)
+            os.unlink(old_path)
+            reclaimed += size
+        except FileNotFoundError:
+            pass
+    _PERIODS_COMPACTED.inc(1.0)
+    _SEGMENTS_MERGED.inc(float(len(segments)))
+    _COMPACT_BYTES_WRITTEN.inc(float(nbytes))
+    _COMPACT_BYTES_RECLAIMED.inc(float(reclaimed))
+    return {
+        "period": key,
+        "segment": fname,
+        "segments-merged": len(segments),
+        "bytes-written": nbytes,
+        "bytes-reclaimed": reclaimed,
+    }
+
+
+def _sweep_leftovers(directory: str) -> Dict[str, int]:
+    """Unlink crash leftovers, under the index flock: dead writers' tmp
+    files, and chunk segments whose own record says they were already
+    absorbed (``period``) or pruned (``pruned``) — the unlink that a
+    kill between an index flip and its cleanup skipped.  Files with no
+    index record are left alone: a racing backfill writer owns the gap
+    between its segment rename and its completion record."""
+    swept = {"files": 0, "bytes": 0}
+    with open(os.path.join(directory, LOCK_FILE), "a+") as lock:
+        fcntl.flock(lock.fileno(), fcntl.LOCK_EX)
+        doc = _read_index(directory) or {}
+        # records do not retain the old file name; reconstruct it from
+        # the key (the naming rule is deterministic)
+        absorbed = set()
+        for ck, rec in (doc.get("chunks") or {}).items():
+            if rec.get("segment") is None and (
+                rec.get("period") or rec.get("pruned")
+            ):
+                c, s = ck.split("/")
+                absorbed.add(f"chunk-{int(c):05d}-s{int(s):02d}.seg")
+        for entry in sorted(os.listdir(directory)):
+            path = os.path.join(directory, entry)
+            if ".tmp." in entry:
+                pid = entry.rsplit(".", 1)[-1]
+                if pid.isdigit() and _pid_alive(int(pid)):
+                    continue
+            elif entry not in absorbed:
+                continue
+            try:
+                size = os.path.getsize(path)
+                os.unlink(path)
+            except FileNotFoundError:
+                continue
+            swept["files"] += 1
+            swept["bytes"] += size
+    return swept
+
+
+def compact_scores(
+    root: str,
+    period: Optional[Any] = None,
+    dry_run: bool = False,
+) -> Dict[str, Any]:
+    """Merge every eligible time partition's chunk segments into one
+    period file each (see module docstring for the crash discipline).
+    Re-entrant: an interrupted run resumes by recomputing the same
+    deterministic merges; already-compacted partitions are skipped.
+    Returns a JSON-ready summary (the CLI prints it verbatim)."""
+    cp = plan_compaction(root, period)
+    arch = ScoreArchive(root)
+    summary: Dict[str, Any] = {
+        "directory": cp["directory"],
+        "period": cp["period"],
+        "periods-compacted": 0,
+        "segments-merged": 0,
+        "bytes-written": 0,
+        "bytes-reclaimed": 0,
+        "periods": [],
+    }
+    if dry_run:
+        summary["dry-run"] = True
+        summary["eligible"] = {
+            key: [name for _c, _s, name in info["segments"]]
+            for key, info in cp["eligible"].items()
+        }
+        return summary
+    for key in sorted(cp["eligible"]):
+        done = _compact_one(arch, key, cp["eligible"][key])
+        summary["periods"].append(done)
+        summary["periods-compacted"] += 1
+        summary["segments-merged"] += done["segments-merged"]
+        summary["bytes-written"] += done["bytes-written"]
+        summary["bytes-reclaimed"] += done["bytes-reclaimed"]
+    swept = _sweep_leftovers(arch.directory)
+    summary["leftovers-swept"] = swept["files"]
+    summary["bytes-reclaimed"] += swept["bytes"]
+    return summary
+
+
+# ---------------------------------------------------------------------------
+# retention
+# ---------------------------------------------------------------------------
+
+def gc_scores(
+    root: str,
+    keep_days: Optional[float] = None,
+    now: Optional[float] = None,
+) -> Dict[str, Any]:
+    """Delete every segment (chunk or period) whose entire window ended
+    more than ``keep_days`` days ago (arg > ``GORDO_SCORES_KEEP`` > 90).
+
+    Mirrors the artifact plane's ``gc_generations``: refuses a keep
+    below one day (an archive is never collectable wholesale by
+    accident), flips the index BEFORE unlinking (a reader never follows
+    a record to a missing file), and keeps completion records — marked
+    ``pruned`` — so a backfill resume does not re-score retired windows
+    and resurrect the data gc just reclaimed."""
+    if keep_days is None:
+        keep_days = float(
+            os.environ.get(ENV_KEEP, "") or DEFAULT_KEEP_DAYS
+        )
+    keep_days = float(keep_days)
+    if keep_days < 1:
+        raise ValueError(
+            "refusing to gc the score archive: --keep must be >= 1 day"
+        )
+    arch = ScoreArchive(root)
+    doc = arch.index()
+    if not doc or not doc.get("plan"):
+        raise ArchiveError(f"{arch.directory}: no score archive to gc")
+    start_ns, span_ns = _chunk_geometry(doc["plan"])
+    wall = time.time() if now is None else float(now)
+    cutoff_ns = int((wall - keep_days * 86400.0) * 1e9)
+    victims: List[str] = []
+    pruned = {"chunks": 0, "periods": 0}
+
+    def mutate(idx: Dict[str, Any]) -> None:
+        chunks = idx.get("chunks") or {}
+        periods = idx.get("periods") or {}
+        for key in sorted(list(periods)):
+            rec = periods[key]
+            end_ns = start_ns + (max(rec["chunks"]) + 1) * span_ns
+            if end_ns > cutoff_ns:
+                continue
+            victims.append(rec["segment"])
+            retired = set(rec["chunks"])
+            for ck, crec in chunks.items():
+                if int(ck.split("/")[0]) in retired:
+                    crec["pruned"] = True
+            del periods[key]
+            pruned["periods"] += 1
+        for ck, crec in chunks.items():
+            c = int(ck.split("/")[0])
+            if (
+                crec.get("segment")
+                and start_ns + (c + 1) * span_ns <= cutoff_ns
+            ):
+                victims.append(crec["segment"])
+                crec["segment"] = None
+                crec["pruned"] = True
+                pruned["chunks"] += 1
+
+    _locked_index_update(arch.directory, mutate)
+
+    reclaimed = 0
+    deleted = 0
+    for fname in victims:
+        path = os.path.join(arch.directory, fname)
+        try:
+            size = os.path.getsize(path)
+            os.unlink(path)
+        except FileNotFoundError:
+            continue
+        reclaimed += size
+        deleted += 1
+    _GC_SEGMENTS.inc(float(deleted))
+    _GC_BYTES_RECLAIMED.inc(float(reclaimed))
+    import pandas as pd
+
+    return {
+        "directory": arch.directory,
+        "keep-days": keep_days,
+        "cutoff": pd.Timestamp(cutoff_ns, tz="UTC").isoformat(),
+        "segments-deleted": deleted,
+        "bytes-reclaimed": reclaimed,
+        "periods-pruned": pruned["periods"],
+        "chunks-pruned": pruned["chunks"],
+    }
+
+
+# ---------------------------------------------------------------------------
+# inspection (``gordo scores ls`` / ``gordo scores stat``)
+# ---------------------------------------------------------------------------
+
+def _file_bytes(directory: str, fname: str) -> Optional[int]:
+    try:
+        return os.path.getsize(os.path.join(directory, fname))
+    except OSError:
+        return None
+
+
+def ls_scores(root: str) -> Dict[str, Any]:
+    """Every data segment with its kind, window, rows and on-disk bytes
+    — what compaction and gc actually did, file by file."""
+    arch = ScoreArchive(root)
+    doc = arch.index()
+    if not doc:
+        raise ArchiveError(f"{arch.directory}: no score archive")
+    segments: List[Dict[str, Any]] = []
+    for ck in sorted(
+        doc.get("chunks") or {}, key=lambda k: tuple(map(int, k.split("/")))
+    ):
+        rec = (doc.get("chunks") or {})[ck]
+        if not rec.get("segment"):
+            continue
+        c, s = ck.split("/")
+        segments.append({
+            "segment": rec["segment"],
+            "kind": "chunk",
+            "chunk": int(c),
+            "shard": int(s),
+            "rows": int(rec.get("rows", 0)),
+            "bytes": _file_bytes(arch.directory, rec["segment"]),
+        })
+    for key in sorted(doc.get("periods") or {}):
+        rec = (doc.get("periods") or {})[key]
+        segments.append({
+            "segment": rec["segment"],
+            "kind": "period",
+            "period": key,
+            "chunks": list(rec.get("chunks") or ()),
+            "rows": int(rec.get("rows", 0)),
+            "bytes": _file_bytes(arch.directory, rec["segment"]),
+        })
+    return {"directory": arch.directory, "segments": segments}
+
+
+def stat_scores(
+    root: str, period: Optional[Any] = None
+) -> Dict[str, Any]:
+    """One-document archive state: the plan, segment/byte totals by
+    partition kind, period coverage, pruned-window count, and how many
+    partitions the next ``compact`` would merge."""
+    arch = ScoreArchive(root)
+    doc = arch.index()
+    if not doc or not doc.get("plan"):
+        raise ArchiveError(f"{arch.directory}: no score archive")
+    listing = ls_scores(root)["segments"]
+    by_kind: Dict[str, Dict[str, int]] = {}
+    for seg in listing:
+        slot = by_kind.setdefault(
+            seg["kind"], {"segments": 0, "bytes": 0, "rows": 0}
+        )
+        slot["segments"] += 1
+        slot["bytes"] += int(seg["bytes"] or 0)
+        slot["rows"] += seg["rows"]
+    chunks = doc.get("chunks") or {}
+    out = arch.summary()
+    out["by-kind"] = by_kind
+    out["chunks-pruned"] = sum(
+        1 for r in chunks.values() if r.get("pruned")
+    )
+    out["periods"] = sorted(doc.get("periods") or {})
+    out["pending-compaction"] = len(
+        plan_compaction(root, period)["eligible"]
+    )
+    return out

@@ -833,6 +833,96 @@ def artifacts_fsck(output_dir, repair):
 
 
 # ---------------------------------------------------------------------------
+# scores (score-archive lifecycle tooling)
+# ---------------------------------------------------------------------------
+
+@gordo.group("scores")
+def scores_group():
+    """Score-archive lifecycle: compact, gc, inspect (ls/stat)."""
+
+
+@scores_group.command("compact")
+@click.option("--dir", "archive_dir", required=True,
+              help="A backfill output dir (holds .gordo-scores/).")
+@click.option("--period", default=None, envvar="GORDO_SCORES_PERIOD",
+              help="Time-partition length to merge chunk segments into "
+                   "(any pandas Timedelta string). "
+                   "[default: GORDO_SCORES_PERIOD or 1d]")
+@click.option("--dry-run", is_flag=True,
+              help="Report what would merge without writing anything.")
+def scores_compact(archive_dir, period, dry_run):
+    """Merge small per-chunk score segments into one period file per
+    closed time partition.  Crash-safe (write-new-then-flip under the
+    index flock): a kill mid-compact never loses a completed period,
+    and reads are byte-identical before and after.  Re-run to resume."""
+    from gordo_tpu import batch
+
+    try:
+        summary = batch.compact_scores(
+            archive_dir, period=period, dry_run=dry_run
+        )
+    except (batch.ArchiveError, ValueError) as exc:
+        raise click.ClickException(str(exc))
+    click.echo(json.dumps(summary, indent=1))
+
+
+@scores_group.command("gc")
+@click.option("--dir", "archive_dir", required=True,
+              help="A backfill output dir (holds .gordo-scores/).")
+@click.option("--keep", default=None, type=float,
+              envvar="GORDO_SCORES_KEEP",
+              help="Days of score history to retain; segments whose "
+                   "entire window is older are deleted. Refuses "
+                   "--keep < 1. [default: GORDO_SCORES_KEEP or 90]")
+def scores_gc(archive_dir, keep):
+    """Prune score segments past the retention window, mirroring
+    ``gordo artifacts gc``: the index flips before any unlink (readers
+    never follow a record to a missing file) and completion records
+    survive as ``pruned`` so a backfill resume does not re-score —
+    and resurrect — retired windows."""
+    from gordo_tpu import batch
+
+    try:
+        summary = batch.gc_scores(archive_dir, keep_days=keep)
+    except (batch.ArchiveError, ValueError) as exc:
+        raise click.ClickException(str(exc))
+    click.echo(json.dumps(summary, indent=1))
+
+
+@scores_group.command("ls")
+@click.option("--dir", "archive_dir", required=True,
+              help="A backfill output dir (holds .gordo-scores/).")
+def scores_ls(archive_dir):
+    """List every data segment (chunk and compacted period files) with
+    rows and on-disk bytes — what compaction and gc actually did."""
+    from gordo_tpu import batch
+
+    try:
+        listing = batch.ls_scores(archive_dir)
+    except batch.ArchiveError as exc:
+        raise click.ClickException(str(exc))
+    click.echo(json.dumps(listing, indent=1))
+
+
+@scores_group.command("stat")
+@click.option("--dir", "archive_dir", required=True,
+              help="A backfill output dir (holds .gordo-scores/).")
+@click.option("--period", default=None, envvar="GORDO_SCORES_PERIOD",
+              help="Partition length used to compute pending-compaction."
+                   " [default: GORDO_SCORES_PERIOD or 1d]")
+def scores_stat(archive_dir, period):
+    """One-document archive state: plan, segment/byte totals by kind,
+    period coverage, pruned windows, pending compaction work."""
+    from gordo_tpu import batch
+
+    try:
+        doc = batch.stat_scores(archive_dir, period=period)
+    except (batch.ArchiveError, ValueError) as exc:
+        raise click.ClickException(str(exc))
+    click.echo(json.dumps(doc, indent=1))
+
+
+# ---------------------------------------------------------------------------
 # telemetry
 # ---------------------------------------------------------------------------
 

@@ -690,6 +690,76 @@ class Client:
     def fleet_health(self, top: Optional[int] = None) -> Dict[str, Any]:
         return _run(self._with_session(self.fleet_health_async, top))
 
+    async def score_summary_async(
+        self,
+        session: aiohttp.ClientSession,
+        machines: Optional[Sequence[str]] = None,
+        start: Any = None,
+        end: Any = None,
+        stats: Optional[Sequence[str]] = None,
+        period: Any = None,
+        threshold: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        """Per-machine, per-period score summaries from the server's
+        ``GET /scores/aggregate`` pushdown — the dashboard-query
+        counterpart of :meth:`score_history`: instead of shipping every
+        archived sample and aggregating client-side, the server scans
+        its mmap archive columns and returns kilobytes of summaries
+        (count / mean / max / threshold exceedance / half-octave sketch
+        percentiles like ``p99``), riding the GSB1 columnar wire when
+        the server speaks it (one contiguous block per stat; old
+        servers answer the msgpack fallback in the same Accept header).
+
+        Returns the server document: ``machines``, ``periods`` (UTC
+        period starts), and ``data[machine][stat]`` — one value per
+        period.  All parameters optional; server defaults are the full
+        roster, the archive plan's span, the standard stat set, ``1d``
+        periods and threshold 1.0."""
+        from urllib.parse import urlencode
+
+        from gordo_tpu.serve import codec
+
+        params = {}
+        if machines:
+            params["machines"] = ",".join(machines)
+        if start is not None:
+            params["start"] = str(start)
+        if end is not None:
+            params["end"] = str(end)
+        if stats:
+            params["stats"] = ",".join(stats)
+        if period is not None:
+            params["period"] = str(period)
+        if threshold is not None:
+            params["threshold"] = repr(float(threshold))
+        query = f"?{urlencode(params)}" if params else ""
+        accept = (
+            f"{codec.COLUMNAR_CONTENT_TYPE}, {codec.MSGPACK_CONTENT_TYPE}"
+            if self.use_columnar
+            else codec.MSGPACK_CONTENT_TYPE
+        )
+        return await get_json(
+            session,
+            f"{self._project_url()}scores/aggregate{query}",
+            retries=self.n_retries,
+            timeout=self.timeout,
+            headers={"Accept": accept},
+        )
+
+    def score_summary(
+        self,
+        machines: Optional[Sequence[str]] = None,
+        start: Any = None,
+        end: Any = None,
+        stats: Optional[Sequence[str]] = None,
+        period: Any = None,
+        threshold: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        return _run(self._with_session(
+            self.score_summary_async, machines, start, end, stats,
+            period, threshold,
+        ))
+
     async def machine_metadata_async(
         self, session: aiohttp.ClientSession, machine: str
     ) -> Dict[str, Any]:

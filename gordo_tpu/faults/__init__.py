@@ -34,6 +34,8 @@ point              modes
 pack.open          eio, corrupt, truncate
 pack.read          eio, corrupt
 artifact.write     enospc, crash  (crash = before the atomic rename)
+scores.compact     crash  (before the period flip — tmp is durable,
+                   the index still points at the chunk segments)
 http.request       latency, blackhole, reset, http_500, http_503
 server.request     latency, http_500, reset
 replica.scatter    dead

@@ -232,10 +232,22 @@ class _Bucket:
 
     def _init_stacking(self, chains: List[Dict[str, Any]]) -> None:
         """The v1 path: per-machine chain arrays stack leaf by leaf (one
-        host gather + implicit transfer per leaf)."""
-        stack = lambda trees: jax.tree.map(  # noqa: E731
-            lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *trees
-        )
+        host gather + implicit transfer per leaf).
+
+        With a mesh the stack/cast/pad all stay host-side (numpy) so the
+        sharded ``jax.device_put`` at the end is the ONLY host->device
+        copy per leaf; stacking through jnp would first place every leaf
+        on the default device, then copy it again for the sharded layout.
+        """
+        mesh = self.mesh
+        if mesh is None:
+            stack = lambda trees: jax.tree.map(  # noqa: E731
+                lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *trees
+            )
+        else:
+            stack = lambda trees: jax.tree.map(  # noqa: E731
+                lambda *xs: np.stack([np.asarray(x) for x in xs]), *trees
+            )
         self.params = stack([c["params"] for c in chains])
         self.scaler_stats = tuple(
             stack([c["scalers"][i][1] for c in chains])
@@ -246,13 +258,28 @@ class _Bucket:
             # reduced-precision serving stores the stacked float tensors
             # at the storage dtype (bf16): half the device residency, and
             # the in-program compute cast becomes an identity
-            self.params = precision.cast_storage(self.params, self.dtype)
-            self.scaler_stats = precision.cast_storage(
-                self.scaler_stats, self.dtype
-            )
-            self.det_stats = precision.cast_storage(
-                self.det_stats, self.dtype
-            )
+            if mesh is None:
+                self.params = precision.cast_storage(self.params, self.dtype)
+                self.scaler_stats = precision.cast_storage(
+                    self.scaler_stats, self.dtype
+                )
+                self.det_stats = precision.cast_storage(
+                    self.det_stats, self.dtype
+                )
+            else:
+                # host-side equivalent of cast_storage — casting through
+                # jnp here would defeat the single-transfer property
+                store = precision.storage_np_dtype(self.dtype)
+                cast = lambda tree: jax.tree.map(  # noqa: E731
+                    lambda a: (
+                        a.astype(store)
+                        if np.issubdtype(a.dtype, np.floating) else a
+                    ),
+                    tree,
+                )
+                self.params = cast(self.params)
+                self.scaler_stats = cast(self.scaler_stats)
+                self.det_stats = cast(self.det_stats)
         if self.with_thresholds:
             # host copies kept alongside the device arrays: per-machine
             # response assembly reads thresholds once per call per machine,
@@ -274,31 +301,34 @@ class _Bucket:
             )
             # only the aggregate goes to device (the program's confidence
             # divide); per-feature thresholds are response-assembly-only and
-            # a device copy would just pin unused memory
-            self.agg_thresholds = jnp.asarray(self.agg_thresholds_np)
+            # a device copy would just pin unused memory.  With a mesh the
+            # device copy happens sharded in the block below instead.
+            self.agg_thresholds = (
+                jnp.asarray(self.agg_thresholds_np) if mesh is None else None
+            )
         else:
             self.thresholds_np = None
             self.agg_thresholds_np = None
             self.agg_thresholds = None
-        if self.mesh is not None:
+        if mesh is not None:
             from gordo_tpu.parallel.mesh import (
                 MODEL_AXIS,
                 model_sharding,
                 pad_to_multiple,
             )
 
-            shards = self.mesh.shape[MODEL_AXIS]
+            shards = mesh.shape[MODEL_AXIS]
             self.m_pad = pad_to_multiple(len(self.names), shards)
             pad = self.m_pad - len(self.names)
 
             def shard(tree):
                 def one(a):
                     if pad:
-                        a = jnp.concatenate(
-                            [a, jnp.repeat(a[:1], pad, axis=0)]
+                        a = np.concatenate(
+                            [a, np.repeat(a[:1], pad, axis=0)]
                         )
                     return jax.device_put(
-                        a, model_sharding(self.mesh, a.ndim - 1)
+                        a, model_sharding(mesh, a.ndim - 1)
                     )
 
                 return jax.tree.map(one, tree)
@@ -306,8 +336,13 @@ class _Bucket:
             self.params = shard(self.params)
             self.scaler_stats = shard(self.scaler_stats)
             self.det_stats = shard(self.det_stats)
-            if self.agg_thresholds is not None:
-                self.agg_thresholds = shard(self.agg_thresholds)
+            if self.agg_thresholds_np is not None:
+                agg = np.asarray(self.agg_thresholds_np)
+                if pad:
+                    agg = np.concatenate([agg, np.repeat(agg[:1], pad)])
+                self.agg_thresholds = jax.device_put(
+                    agg, model_sharding(mesh, 0)
+                )
             self._x_sharding = model_sharding(self.mesh, 2)
 
     def _init_prestacked(self, prestacked: Dict[str, Any]) -> None:

@@ -1445,6 +1445,82 @@ async def fleet_health(request: web.Request) -> web.Response:
     return web.json_response(doc)
 
 
+async def scores_aggregate(request: web.Request) -> web.Response:
+    """Aggregation pushdown over the score archive: per-machine,
+    per-period summaries (count / mean / max / exceedance / sketch
+    percentiles) computed server-side by scanning the mmap columns of
+    ``.gordo-scores/`` under this collection's artifact dir — a
+    fleet-year dashboard query returns kilobytes of summaries instead
+    of the ~84M raw samples ``client.score_history`` would ship.
+
+    Query: ``?machines=a,b&start=...&end=...&stats=count,p99&period=7d
+    &threshold=1.0`` (all optional; defaults: full roster, the archive
+    plan's span, the standard stat set, 1d, 1.0).  The response rides
+    whatever the ``Accept`` header negotiates — the GSB1 columnar wire
+    ships each stat as ONE contiguous ``[n_machines, n_periods]`` block
+    (the bundled client's default); JSON/msgpack split per machine.
+    The scan runs in the executor: a fleet-year pass takes ~100ms-class
+    time that must not stall the accept loop."""
+    collection: ModelCollection = request.app[COLLECTION_KEY]
+    from gordo_tpu.batch import archive as score_archive
+
+    root = collection.source_dir
+    if root is None or not os.path.isdir(score_archive.archive_root(root)):
+        return web.json_response(
+            {"error": "no score archive under this server's artifact "
+                      "dir (run gordo backfill first)"},
+            status=404,
+        )
+    q = request.query
+    machines = [m for m in (q.get("machines") or "").split(",") if m]
+    stats = [s for s in (q.get("stats") or "").split(",") if s]
+    period = (
+        q.get("period")
+        or os.environ.get("GORDO_SCORES_AGG_PERIOD", "")
+        or "1d"
+    )
+    try:
+        threshold = float(q.get("threshold", "") or 1.0)
+    except ValueError:
+        return web.json_response(
+            {"error": "threshold must be a number"}, status=400
+        )
+    arch = score_archive.ScoreArchive(root)
+
+    def scan() -> Dict[str, Any]:
+        return arch.aggregate(
+            machines or None,
+            q.get("start") or None,
+            q.get("end") or None,
+            stats=stats or None,
+            period=period,
+            threshold=threshold,
+        )
+
+    try:
+        doc = await asyncio.get_running_loop().run_in_executor(None, scan)
+    except (ValueError, score_archive.ArchiveError) as exc:
+        return web.json_response({"error": str(exc)}, status=400)
+    # each stat matrix ships as one contiguous GSB1 block; the machine
+    # map hands every machine its row view, so the JSON/msgpack
+    # fallbacks split into per-machine dicts via the same one rule
+    stat_arrays = doc.pop("stats")
+    blocks = [np.ascontiguousarray(a) for a in stat_arrays.values()]
+    entry_map = {
+        name: {
+            stat: (bi, mi, None)
+            for bi, stat in enumerate(stat_arrays)
+        }
+        for mi, name in enumerate(doc["machines"])
+    }
+    envelope = dict(doc)
+    envelope["stats"] = list(stat_arrays)
+    envelope["data"] = codec.ColumnarResult(
+        blocks=blocks, machines=entry_map
+    )
+    return await _respond(request, envelope)
+
+
 async def project_index(request: web.Request) -> web.Response:
     collection: ModelCollection = request.app[COLLECTION_KEY]
     store = collection.pack_store
@@ -1771,6 +1847,9 @@ def build_app(
     # registered before the {machine} routes so "_bulk" never resolves as a
     # machine name
     app.router.add_post(f"{p}/_bulk/anomaly/prediction", bulk_anomaly_prediction)
+    # score-archive aggregation pushdown (r20): summaries over the
+    # backfill plane's archive, served from this collection's source dir
+    app.router.add_get(f"{p}/scores/aggregate", scores_aggregate)
     app.router.add_get(f"{p}/{{machine}}/healthcheck", healthcheck)
     app.router.add_get(f"{p}/{{machine}}/metadata", metadata)
     app.router.add_post(f"{p}/{{machine}}/prediction", prediction)

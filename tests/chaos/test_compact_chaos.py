@@ -1,0 +1,195 @@
+"""Kill-mid-compact chaos: the score-archive compaction's
+write-new-then-flip discipline under the r16 fault plane.
+
+``scores.compact=crash`` fires between a period file's tmp fsync and
+its rename — the worst instant a real kill can land (bytes durable,
+index still pointing at the chunk segments).  The contract under test:
+
+- a period whose index flip COMPLETED is never lost — its file exists
+  and every read that would touch it still answers;
+- a period whose flip had not happened leaves the archive exactly as
+  it was — reads byte-identical, no index damage;
+- a resumed compaction converges to the same archive an uninterrupted
+  run produces, byte for byte, file for file (the deterministic-merge
+  guarantee that makes crash recovery a non-event).
+
+Runs in the slow lane; CI replays it under the fixed 3-seed matrix
+(``GORDO_CHAOS_SEED`` selects one seed per job, locally all three run).
+"""
+
+import filecmp
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from gordo_tpu import faults
+from gordo_tpu.batch import ScoreArchive, compact_scores, stat_scores
+from gordo_tpu.faults import InjectedFault
+
+pytestmark = pytest.mark.slow
+
+SEEDS = (
+    [int(os.environ["GORDO_CHAOS_SEED"])]
+    if os.environ.get("GORDO_CHAOS_SEED")
+    else [7, 101, 9001]
+)
+
+MACHINES = ["cm-a", "cm-b", "cm-c"]
+N_CHUNKS = 6  # 2 days of 8h chunks -> 2 daily periods of 3 chunks each
+ROWS = 48
+STEP_NS = 600_000_000_000  # 10min
+
+
+def _build_archive(root) -> ScoreArchive:
+    """A 2-day, 3-machine archive whose bytes are a pure function of the
+    chunk index — so two builds (subject and control) are identical by
+    construction and byte-level convergence is a meaningful assert."""
+    arch = ScoreArchive.create(
+        str(root), project="chaos", start="2020-01-01", end="2020-01-03",
+        resolution="10min", chunk_rows=ROWS, n_chunks=N_CHUNKS,
+        dtype="float32", machines=MACHINES,
+    )
+    t0 = int(
+        np.datetime64("2020-01-01").astype("datetime64[ns]").astype(np.int64)
+    )
+    span = ROWS * STEP_NS
+    for c in range(N_CHUNKS):
+        rng = np.random.default_rng(c)
+        arch.write_chunk(c, {
+            m: {
+                "index-ns": (
+                    t0 + c * span
+                    + STEP_NS * np.arange(ROWS, dtype=np.int64)
+                ),
+                "total-anomaly-score": rng.random(ROWS, dtype=np.float32),
+                "tag-anomaly-scores": rng.random((ROWS, 2), dtype=np.float32),
+                "tags": ["t0", "t1"],
+            }
+            for m in MACHINES
+        })
+    return arch
+
+
+def _segment_files(arch: ScoreArchive):
+    return sorted(
+        os.path.basename(p)
+        for p in glob.glob(os.path.join(arch.directory, "*.seg"))
+    )
+
+
+def _reads(arch: ScoreArchive):
+    return {
+        m: tuple(
+            arch.read_machine(m)[k].tobytes()
+            for k in ("index-ns", "total-anomaly-score",
+                      "tag-anomaly-scores")
+        )
+        for m in MACHINES
+    }
+
+
+class TestKillMidCompact:
+    @pytest.mark.parametrize("seed", SEEDS)
+    @pytest.mark.parametrize("after", [0, 1])
+    def test_completed_periods_survive_and_resume_converges(
+        self, tmp_path, seed, after
+    ):
+        """Crash before the first flip (``after=0``: nothing committed)
+        and between the two flips (``after=1``: one period committed).
+        Either way: no completed period lost, reads byte-identical
+        through the crash, and the resumed run converges to the
+        uninterrupted control archive byte for byte."""
+        control_root = str(tmp_path / "control")
+        control = _build_archive(control_root)
+        compact_scores(control_root)
+
+        subject_root = str(tmp_path / "subject")
+        arch = _build_archive(subject_root)
+        pre = _reads(arch)
+
+        spec = f"seed={seed};scores.compact=crash:1:after={after}"
+        with faults.injected(spec):
+            with pytest.raises(InjectedFault):
+                compact_scores(subject_root)
+
+        # exactly the periods flipped BEFORE the crash are committed,
+        # and each committed period's segment file is durably present
+        periods = (arch.index() or {}).get("periods") or {}
+        assert len(periods) == after
+        for rec in periods.values():
+            assert os.path.exists(
+                os.path.join(arch.directory, rec["segment"])
+            ), rec["segment"]
+        # every read is byte-identical through the crash
+        assert _reads(arch) == pre
+
+        # resume: the remaining periods compact, and the archive
+        # converges to the uninterrupted control — same file set, same
+        # bytes (deterministic merge), same reads
+        summary = compact_scores(subject_root)
+        assert summary["periods-compacted"] == 2 - after
+        names = _segment_files(arch)
+        assert names == _segment_files(control)
+        for name in names:
+            assert filecmp.cmp(
+                os.path.join(arch.directory, name),
+                os.path.join(control.directory, name),
+                shallow=False,
+            ), f"{name} diverged from the uninterrupted control"
+        assert _reads(arch) == pre
+
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_crashed_attempt_leaves_no_index_damage(self, tmp_path, seed):
+        """After a crash with nothing committed, the archive answers the
+        full inspection surface (stat, aggregate) exactly as before —
+        the crashed attempt is invisible to every reader."""
+        root = str(tmp_path / "arch")
+        arch = _build_archive(root)
+        stat_pre = stat_scores(root)
+        agg_pre = arch.aggregate(period="1d")
+
+        with faults.injected(f"seed={seed};scores.compact=crash"):
+            with pytest.raises(InjectedFault):
+                compact_scores(root)
+
+        stat_post = stat_scores(root)
+        assert stat_post["periods-compacted"] == 0
+        assert stat_post["pending-compaction"] == stat_pre[
+            "pending-compaction"
+        ]
+        agg_post = arch.aggregate(period="1d")
+        for key in agg_pre["stats"]:
+            assert (
+                agg_pre["stats"][key].tobytes()
+                == agg_post["stats"][key].tobytes()
+            ), key
+
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_repeated_crashes_then_resume(self, tmp_path, seed):
+        """A compactor that dies on EVERY attempt makes no progress but
+        corrupts nothing; the first clean run converges as if none of
+        the crashes happened."""
+        control_root = str(tmp_path / "control")
+        control = _build_archive(control_root)
+        compact_scores(control_root)
+
+        root = str(tmp_path / "arch")
+        arch = _build_archive(root)
+        pre = _reads(arch)
+        for _ in range(3):
+            with faults.injected(f"seed={seed};scores.compact=crash"):
+                with pytest.raises(InjectedFault):
+                    compact_scores(root)
+            assert _reads(arch) == pre
+
+        summary = compact_scores(root)
+        assert summary["periods-compacted"] == 2
+        assert _segment_files(arch) == _segment_files(control)
+        for name in _segment_files(arch):
+            assert filecmp.cmp(
+                os.path.join(arch.directory, name),
+                os.path.join(control.directory, name),
+                shallow=False,
+            ), name

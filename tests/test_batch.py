@@ -320,7 +320,7 @@ class TestBatchLintGate:
 
     def test_batch_plane_is_clean_under_the_gate(self):
         repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        for rel in ("archive.py", "runner.py", "__init__.py"):
+        for rel in ("archive.py", "compact.py", "runner.py", "__init__.py"):
             path = os.path.join(repo, "gordo_tpu", "batch", rel)
             assert self._lint(path) == [], rel
 

@@ -15,7 +15,8 @@ def test_default_runs_every_stage_in_priority_order():
         "build", "build_pipeline", "artifact_io", "hot_reload", "serving",
         "serving_precision", "serving_sharded", "serving_wire",
         "serving_openloop", "telemetry_overhead", "health_overhead",
-        "cold_start", "refresh", "backfill", "lstm",
+        "cold_start", "multi_device", "refresh", "backfill",
+        "scores_lifecycle", "lstm",
     ]
 
 
@@ -39,6 +40,18 @@ def test_serving_wire_stage_selectable():
 
 def test_artifact_io_stage_selectable():
     assert bench.parse_stages(["--stage", "artifact_io"]) == ["artifact_io"]
+
+
+def test_multi_device_stage_selectable():
+    assert bench.parse_stages(["--stage", "multi_device"]) == [
+        "multi_device"
+    ]
+
+
+def test_scores_lifecycle_stage_selectable():
+    assert bench.parse_stages(["--stage", "scores_lifecycle"]) == [
+        "scores_lifecycle"
+    ]
 
 
 def test_single_stage_selection():
@@ -148,3 +161,54 @@ def test_serving_wire_stage_smoke(monkeypatch):
     )
     assert out["serving_wire_value_identity_ok"] is True
     assert "serving_wire_ge_3x_r18_ok" in out
+
+
+@pytest.mark.slow
+def test_multi_device_stage_smoke(monkeypatch):
+    """The CI slow-lane multi_device smoke (ISSUE 16 satellite): forked
+    children over a tiny {1,2} device sweep must report the per-count
+    throughput curve, the speedup map, and the honesty note when the
+    host has fewer cores than forced devices. The >=1.6x-at-2 gate
+    field exists but is only meaningful on real multi-core/multi-chip
+    hosts."""
+    monkeypatch.setenv("BENCH_MULTI_DEVICE_COUNTS", "1,2")
+    monkeypatch.setenv("BENCH_MULTI_DEVICE_MACHINES", "8")
+    monkeypatch.setenv("BENCH_MULTI_DEVICE_ROWS", "256")
+    monkeypatch.setenv("BENCH_MULTI_DEVICE_ROUNDS", "2")
+    out = {}
+    bench.bench_multi_device(out)
+    assert out["multi_device_counts"] == [1, 2]
+    assert out["multi_device_samples_per_sec"]["1"] > 0
+    assert out["multi_device_samples_per_sec"]["2"] > 0
+    assert out["multi_device_speedup_at_2"] == pytest.approx(
+        out["multi_device_samples_per_sec"]["2"]
+        / out["multi_device_samples_per_sec"]["1"],
+        rel=5e-3,
+    )
+    assert "multi_device_ge_1_6x_at_2_ok" in out
+
+
+@pytest.mark.slow
+def test_scores_lifecycle_stage_smoke(monkeypatch, tmp_path):
+    """The CI slow-lane scores_lifecycle smoke (ISSUE 16 tentpole): a
+    tiny fleet-archive run of the full stage — build, scan, compact,
+    aggregate byte-identity, server pushdown vs fetch-and-aggregate,
+    gc — must produce every acceptance field with the CORRECTNESS
+    attestations holding. The perf-ratio gates exist but are only
+    ENFORCED at full scale (--round)."""
+    monkeypatch.setenv("BENCH_SCORES_MACHINES", "8")
+    monkeypatch.setenv("BENCH_SCORES_CHUNK_ROWS", "256")
+    monkeypatch.setenv("BENCH_SCORES_CHUNKS", "4")
+    monkeypatch.setenv("BENCH_SCORES_TAGS", "3")
+    monkeypatch.setenv("BENCH_SCORES_DIR", str(tmp_path))
+    out = {}
+    bench.bench_scores_lifecycle(out)
+    assert out["scores_machines"] == 8
+    assert out["scores_compact_segments_merged"] >= 2
+    assert out["scores_aggregate_bytes_identical_ok"] is True
+    assert out["scores_pushdown_parity_ok"] is True
+    assert out["scores_pushdown_speedup"] > 0
+    assert "scores_compact_ge_half_scan_ok" in out
+    assert "scores_pushdown_ge_10x_ok" in out
+    assert out["scores_scan_mb_per_s"] > 0
+    assert out["scores_compact_mb_per_s"] > 0

@@ -1,0 +1,477 @@
+"""Score-archive lifecycle (r20): compaction, retention, aggregation
+pushdown.
+
+Fast lane: the batch-plane surfaces — plan/compact byte-consistency,
+aggregate correctness against a numpy reference and byte-identity
+across compaction, gc retention semantics, the ls/stat inspection
+documents, and the ``gordo scores`` CLI (pure host-side I/O, no model
+build).  Slow lane (``TestScoresAggregateRoute``): the
+``/scores/aggregate`` server route over a real built project — GSB1
+columnar parity with the local aggregate, ``client.score_summary``
+end-to-end, input validation, and the no-archive 404.
+"""
+
+import json
+import os
+import shutil
+
+import numpy as np
+import pandas as pd
+import pytest
+from click.testing import CliRunner
+
+from gordo_tpu.batch import (
+    AGGREGATE_STATS,
+    ArchiveError,
+    ScoreArchive,
+    compact_scores,
+    gc_scores,
+    ls_scores,
+    plan_compaction,
+    stat_scores,
+)
+from gordo_tpu.cli.cli import gordo
+
+MACHINES = ["m-a", "m-b"]
+N_TAGS = 3
+ROWS = 48  # x 10min = one 8h chunk
+N_CHUNKS = 6  # 2 days -> 2 daily periods of 3 chunks
+STEP_NS = 600_000_000_000
+T0_NS = int(
+    np.datetime64("2020-01-01").astype("datetime64[ns]").astype(np.int64)
+)
+SPAN_NS = ROWS * STEP_NS
+
+
+def _build(root) -> ScoreArchive:
+    arch = ScoreArchive.create(
+        str(root), project="lc", start="2020-01-01", end="2020-01-03",
+        resolution="10min", chunk_rows=ROWS, n_chunks=N_CHUNKS,
+        dtype="float32", machines=MACHINES,
+    )
+    for c in range(N_CHUNKS):
+        per = {}
+        for i, m in enumerate(MACHINES):
+            rng = np.random.default_rng(100 * c + i)
+            per[m] = {
+                "index-ns": (
+                    T0_NS + c * SPAN_NS
+                    + STEP_NS * np.arange(ROWS, dtype=np.int64)
+                ),
+                "total-anomaly-score": rng.random(ROWS, np.float32) * 3,
+                "tag-anomaly-scores": rng.random((ROWS, N_TAGS), np.float32),
+                "tags": [f"t{j}" for j in range(N_TAGS)],
+            }
+        arch.write_chunk(c, per)
+    return arch
+
+
+def _reads(arch):
+    return {
+        m: tuple(
+            arch.read_machine(m)[k].tobytes()
+            for k in ("index-ns", "total-anomaly-score",
+                      "tag-anomaly-scores")
+        )
+        for m in MACHINES
+    }
+
+
+@pytest.fixture()
+def archive(tmp_path):
+    return _build(tmp_path)
+
+
+class TestCompaction:
+    def test_plan_names_closed_daily_partitions(self, archive):
+        cp = plan_compaction(archive.directory.rsplit("/.gordo", 1)[0])
+        keys = sorted(cp["eligible"])
+        assert keys == ["20200101T000000", "20200102T000000"]
+        for info in cp["eligible"].values():
+            assert len(info["segments"]) == 3
+
+    def test_reads_byte_identical_across_compaction(self, tmp_path):
+        arch = _build(tmp_path)
+        pre = _reads(arch)
+        summary = compact_scores(str(tmp_path))
+        assert summary["periods-compacted"] == 2
+        assert summary["segments-merged"] == 6
+        assert _reads(arch) == pre
+        kinds = [s["kind"] for s in ls_scores(str(tmp_path))["segments"]]
+        assert kinds == ["period", "period"]
+
+    def test_aggregate_byte_identical_across_compaction(self, tmp_path):
+        arch = _build(tmp_path)
+        pre = arch.aggregate(stats=list(AGGREGATE_STATS), period="12h")
+        compact_scores(str(tmp_path))
+        post = arch.aggregate(stats=list(AGGREGATE_STATS), period="12h")
+        assert pre["periods"] == post["periods"]
+        for key in pre["stats"]:
+            assert (
+                pre["stats"][key].tobytes() == post["stats"][key].tobytes()
+            ), key
+
+    def test_second_run_is_a_no_op(self, tmp_path):
+        _build(tmp_path)
+        compact_scores(str(tmp_path))
+        again = compact_scores(str(tmp_path))
+        assert again["periods-compacted"] == 0
+        assert again["segments-merged"] == 0
+
+    def test_single_segment_partitions_are_not_churned(self, tmp_path):
+        _build(tmp_path)
+        # at an 8h partition every period holds exactly one segment;
+        # rewriting those is churn, not compaction
+        summary = compact_scores(str(tmp_path), period="8h")
+        assert summary["periods-compacted"] == 0
+
+    def test_dry_run_reports_without_writing(self, tmp_path):
+        arch = _build(tmp_path)
+        before = sorted(os.listdir(arch.directory))
+        summary = compact_scores(str(tmp_path), dry_run=True)
+        assert summary["dry-run"] is True
+        assert sorted(summary["eligible"]) == [
+            "20200101T000000", "20200102T000000"
+        ]
+        assert sorted(os.listdir(arch.directory)) == before
+
+
+class TestAggregate:
+    def test_matches_numpy_reference(self, archive):
+        agg = archive.aggregate(period="12h", threshold=1.0)
+        ns, tot = archive._machine_series(MACHINES[0])
+        pid = ns // int(pd.Timedelta("12h").value)
+        for j, p in enumerate(np.unique(pid)):
+            rows = tot[pid == p]
+            assert agg["stats"]["count"][0, j] == rows.size
+            assert agg["stats"]["max"][0, j] == rows.max()
+            assert abs(
+                agg["stats"]["mean"][0, j]
+                - rows.astype(np.float64).mean()
+            ) < 1e-12
+            assert agg["stats"]["exceed"][0, j] == int((rows > 1.0).sum())
+
+    def test_percentiles_are_sketch_upper_bounds(self, archive):
+        agg = archive.aggregate(period="12h", stats=["p50", "p99"])
+        ns, tot = archive._machine_series(MACHINES[0])
+        pid = ns // int(pd.Timedelta("12h").value)
+        rows = tot[pid == pid.min()]
+        for stat, q in (("p50", 0.5), ("p99", 0.99)):
+            got = agg["stats"][stat][0, 0]
+            exact = np.quantile(rows, q)
+            # half-octave histogram: the reported value is the upper
+            # edge of the bucket holding the exact percentile
+            assert exact <= got <= exact * np.sqrt(2) * 1.01, (stat, got)
+
+    def test_machine_subset_and_window(self, archive):
+        agg = archive.aggregate(
+            machines=["m-b"], start="2020-01-01", end="2020-01-02",
+            period="12h",
+        )
+        assert agg["machines"] == ["m-b"]
+        assert len(agg["periods"]) == 2
+        assert agg["stats"]["count"].shape == (1, 2)
+        assert (agg["stats"]["count"] == 3 * ROWS // 2).all()
+
+    def test_unknown_machine_reads_empty(self, archive):
+        agg = archive.aggregate(machines=["nope"], period="12h")
+        assert (agg["stats"]["count"] == 0).all()
+        assert np.isnan(agg["stats"]["mean"]).all()
+
+    def test_bad_stat_and_period_refused(self, archive):
+        with pytest.raises(ValueError, match="unknown aggregate stat"):
+            archive.aggregate(stats=["p0"])
+        with pytest.raises(ValueError, match="positive"):
+            archive.aggregate(period="0h")
+
+
+class TestRetention:
+    NOW = pd.Timestamp("2020-01-05", tz="UTC").timestamp()
+
+    def test_gc_prunes_aged_out_periods(self, tmp_path):
+        arch = _build(tmp_path)
+        compact_scores(str(tmp_path))
+        g = gc_scores(str(tmp_path), keep_days=3, now=self.NOW)
+        assert g["segments-deleted"] == 1
+        assert g["periods-pruned"] == 1
+        kept = arch.read_machine(MACHINES[0])
+        assert kept["index-ns"].min() >= pd.Timestamp(
+            "2020-01-02", tz="UTC"
+        ).value
+        # the completion ledger survives: a backfill resume must not
+        # re-score (and resurrect) the retired window
+        assert arch.completed_chunks(0) == set(range(N_CHUNKS))
+
+    def test_gc_prunes_uncompacted_chunk_segments(self, tmp_path):
+        arch = _build(tmp_path)
+        g = gc_scores(str(tmp_path), keep_days=3, now=self.NOW)
+        assert g["chunks-pruned"] == 3
+        assert g["segments-deleted"] == 3
+        assert stat_scores(str(tmp_path))["chunks-pruned"] == 3
+        assert arch.read_machine(MACHINES[0])["index-ns"].size == 3 * ROWS
+
+    def test_gc_refuses_keep_below_one_day(self, tmp_path):
+        _build(tmp_path)
+        with pytest.raises(ValueError, match="keep"):
+            gc_scores(str(tmp_path), keep_days=0.5)
+
+    def test_gc_noop_inside_retention_window(self, tmp_path):
+        arch = _build(tmp_path)
+        pre = _reads(arch)
+        g = gc_scores(str(tmp_path), keep_days=365, now=self.NOW)
+        assert g["segments-deleted"] == 0
+        assert _reads(arch) == pre
+
+
+class TestInspection:
+    def test_ls_reports_kind_rows_bytes(self, tmp_path):
+        arch = _build(tmp_path)
+        listing = ls_scores(str(tmp_path))["segments"]
+        assert len(listing) == N_CHUNKS
+        assert {s["kind"] for s in listing} == {"chunk"}
+        assert all(s["bytes"] > 0 for s in listing)
+        compact_scores(str(tmp_path))
+        listing = ls_scores(str(tmp_path))["segments"]
+        assert [s["kind"] for s in listing] == ["period", "period"]
+        assert all(
+            s["rows"] == 3 * ROWS * len(MACHINES) for s in listing
+        )
+        assert arch.read_machine(MACHINES[0]) is not None
+
+    def test_stat_tracks_lifecycle_state(self, tmp_path):
+        _build(tmp_path)
+        st = stat_scores(str(tmp_path))
+        assert st["pending-compaction"] == 2
+        assert st["by-kind"]["chunk"]["segments"] == N_CHUNKS
+        compact_scores(str(tmp_path))
+        st = stat_scores(str(tmp_path))
+        assert st["pending-compaction"] == 0
+        assert st["periods"] == ["20200101T000000", "20200102T000000"]
+        assert st["by-kind"]["period"]["segments"] == 2
+
+    def test_no_archive_refused(self, tmp_path):
+        with pytest.raises(ArchiveError):
+            ls_scores(str(tmp_path))
+
+
+class TestScoresCli:
+    def test_compact_stat_ls_gc_round_trip(self, tmp_path):
+        _build(tmp_path)
+        runner = CliRunner()
+        root = str(tmp_path)
+
+        r = runner.invoke(
+            gordo, ["scores", "compact", "--dir", root, "--dry-run"]
+        )
+        assert r.exit_code == 0, r.output
+        assert sorted(json.loads(r.output)["eligible"]) == [
+            "20200101T000000", "20200102T000000"
+        ]
+
+        r = runner.invoke(gordo, ["scores", "compact", "--dir", root])
+        assert r.exit_code == 0, r.output
+        assert json.loads(r.output)["periods-compacted"] == 2
+
+        r = runner.invoke(gordo, ["scores", "stat", "--dir", root])
+        assert r.exit_code == 0, r.output
+        assert json.loads(r.output)["pending-compaction"] == 0
+
+        r = runner.invoke(gordo, ["scores", "ls", "--dir", root])
+        assert r.exit_code == 0, r.output
+        assert len(json.loads(r.output)["segments"]) == 2
+
+        r = runner.invoke(
+            gordo, ["scores", "gc", "--dir", root, "--keep", "0.5"]
+        )
+        assert r.exit_code != 0
+        assert "keep" in r.output
+
+    def test_missing_archive_is_a_clean_error(self, tmp_path):
+        runner = CliRunner()
+        for cmd in ("compact", "gc", "ls", "stat"):
+            r = runner.invoke(
+                gordo, ["scores", cmd, "--dir", str(tmp_path)]
+            )
+            assert r.exit_code != 0
+            assert "no score archive" in r.output
+
+
+# ---------------------------------------------------------------------------
+# slow lane: the /scores/aggregate route over a real built project
+# ---------------------------------------------------------------------------
+
+PROJECT = {
+    "machines": [{
+        "name": "machine-a",
+        "dataset": {
+            "type": "RandomDataset",
+            "tags": ["tag-1", "tag-2"],
+            "train_start_date": "2017-12-25T06:00:00Z",
+            "train_end_date": "2017-12-27T06:00:00Z",
+        },
+    }],
+    "globals": {
+        "model": {
+            "gordo_tpu.anomaly.diff.DiffBasedAnomalyDetector": {
+                "base_estimator": {
+                    "gordo_tpu.pipeline.Pipeline": {
+                        "steps": [
+                            "gordo_tpu.ops.scalers.MinMaxScaler",
+                            {"gordo_tpu.models.estimator.AutoEncoder": {
+                                "kind": "feedforward_hourglass",
+                                "epochs": 1,
+                                "batch_size": 64,
+                            }},
+                        ]
+                    }
+                }
+            }
+        }
+    },
+}
+
+
+@pytest.fixture(scope="module")
+def served_archive(tmp_path_factory):
+    """A built 1-machine project whose model dir also holds a score
+    archive — the layout ``run-server --model-dir`` discovers, with the
+    archive riding along as the aggregate route's source."""
+    from gordo_tpu.builder import build_project
+    from gordo_tpu.workflow import NormalizedConfig
+
+    out = str(tmp_path_factory.mktemp("scores-served"))
+    result = build_project(NormalizedConfig(PROJECT, "testproj").machines, out)
+    assert not result.failed
+    arch = _build(out)
+    return out, arch.aggregate(period="12h")
+
+
+def _call(model_dir, fn):
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from gordo_tpu.serve import ModelCollection, build_app
+
+    async def runner():
+        collection = ModelCollection.from_directory(
+            model_dir, project="testproj"
+        )
+        client = TestClient(TestServer(build_app(collection)))
+        await client.start_server()
+        try:
+            return await fn(client)
+        finally:
+            await client.close()
+
+    return asyncio.run(runner())
+
+
+@pytest.mark.slow
+class TestScoresAggregateRoute:
+    URL = "/gordo/v0/testproj/scores/aggregate"
+
+    def test_columnar_parity_with_local_aggregate(self, served_archive):
+        model_dir, local = served_archive
+        from gordo_tpu.serve import codec
+
+        async def fetch(client):
+            resp = await client.get(
+                f"{self.URL}?period=12h",
+                headers={"Accept": "application/x-gordo-columnar"},
+            )
+            assert resp.status == 200, await resp.text()
+            return codec.decode_columnar(await resp.read())
+
+        doc = _call(model_dir, fetch)
+        assert doc["machines"] == local["machines"]
+        assert doc["periods"] == local["periods"]
+        for mi, name in enumerate(local["machines"]):
+            for stat in local["stats"]:
+                got = np.asarray(doc["data"][name][stat])
+                assert (
+                    got.tobytes() == local["stats"][stat][mi].tobytes()
+                ), (name, stat)
+
+    def test_content_negotiation(self, served_archive):
+        model_dir, _ = served_archive
+
+        async def fetch(client):
+            statuses = {}
+            for accept in ("application/json", "application/x-msgpack",
+                           "application/x-gordo-columnar"):
+                resp = await client.get(
+                    f"{self.URL}?period=12h",
+                    headers={"Accept": accept},
+                )
+                statuses[accept] = resp.status
+            return statuses
+
+        assert set(_call(model_dir, fetch).values()) == {200}
+
+    def test_bad_inputs_are_400(self, served_archive):
+        model_dir, _ = served_archive
+
+        async def fetch(client):
+            out = []
+            for query in ("?period=0d", "?stats=bogus", "?threshold=x"):
+                resp = await client.get(self.URL + query)
+                out.append(resp.status)
+            return out
+
+        assert _call(model_dir, fetch) == [400, 400, 400]
+
+    def test_client_score_summary_end_to_end(self, served_archive):
+        import asyncio
+
+        from aiohttp import web as aioweb
+
+        from gordo_tpu.client.client import Client
+        from gordo_tpu.serve import ModelCollection, build_app
+
+        model_dir, local = served_archive
+
+        async def run():
+            collection = ModelCollection.from_directory(
+                model_dir, project="testproj"
+            )
+            runner = aioweb.AppRunner(build_app(collection))
+            await runner.setup()
+            site = aioweb.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            port = site._server.sockets[0].getsockname()[1]
+            try:
+                client = Client(
+                    project="testproj", host="127.0.0.1", port=port,
+                    scheme="http",
+                )
+                return await client._with_session(
+                    client.score_summary_async, ["m-a"], None, None,
+                    ["count", "p99"], "12h", 1.0,
+                )
+            finally:
+                await runner.cleanup()
+
+        doc = asyncio.run(run())
+        assert doc["machines"] == ["m-a"]
+        got = np.asarray(doc["data"]["m-a"]["p99"])
+        assert got.tobytes() == local["stats"]["p99"][0].tobytes()
+
+    def test_404_without_archive(self, served_archive, tmp_path_factory):
+        model_dir, _ = served_archive
+        bare = str(tmp_path_factory.mktemp("scores-bare"))
+        for entry in os.listdir(model_dir):
+            if entry == ".gordo-scores":
+                continue
+            src = os.path.join(model_dir, entry)
+            dst = os.path.join(bare, entry)
+            if os.path.isdir(src):
+                shutil.copytree(src, dst)
+            else:
+                shutil.copy2(src, dst)
+
+        async def fetch(client):
+            resp = await client.get(self.URL)
+            return resp.status
+
+        assert _call(bare, fetch) == 404
