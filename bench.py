@@ -3060,6 +3060,253 @@ def bench_scores_lifecycle(out: dict) -> None:
         shutil.rmtree(art_dir, ignore_errors=True)
 
 
+def bench_streaming(out: dict) -> None:
+    """ISSUE 17 acceptance: the streaming plane vs 1-row bulk polling,
+    end-to-end through a real server, plus detection-to-push latency
+    over a live SSE subscriber.
+
+    Protocol (docs/perf.md "Streaming plane"):
+
+    - arrival schedule: a GSA1 archive window written through the real
+      ``write_chunk`` path and replayed in ``index-ns`` order — the
+      stream is driven by the same clock a backfilled fleet replays;
+    - in-process step rate (diagnostic): ``StreamHub.ingest_rows`` once
+      per arrival — the fixed-shape incremental step over the
+      device-resident ring, dispatched through the compile plane's
+      ``bind`` fast path (per-arrival cost is O(window), independent of
+      history length) — against the bulk device path re-scoring the
+      trailing lookback padded to its 256-row compile bucket;
+    - the GATE is end-to-end: a 1-row poller pays one full HTTP bulk
+      request per sample (that is the ONLY way the request path yields
+      one new verdict), while the streaming plane ingests arrivals in
+      transport batches and delivers per-row verdicts through the
+      event ring (drained here via the documented long-poll fallback,
+      whose batched frames are also how a thin consumer would read).
+      Gate: streaming >= 5x polling samples/s/core, both sides
+      single-threaded against the same single-core server;
+    - detection-to-push p99: a live SSE subscriber over the wire;
+      per-event latency = frame receipt minus the verdict's ``time``
+      field (stamped by the hub at detection).
+    """
+    import asyncio
+    import threading as _threading
+    import urllib.request
+
+    import pandas as pd
+    from aiohttp import web
+
+    from gordo_tpu.batch import ScoreArchive
+    from gordo_tpu.client import Client
+    from gordo_tpu.serve import ModelCollection, build_app
+    from gordo_tpu.serve.scorer import CompiledScorer
+    from gordo_tpu.serve.stream import StreamHub
+
+    n_replay = int(os.environ.get("BENCH_STREAM_ROWS", "2048"))
+    n_poll = int(os.environ.get("BENCH_STREAM_POLLS", "96"))
+    n_e2e = int(os.environ.get("BENCH_STREAM_E2E_ROWS", "1024"))
+    n_push = int(os.environ.get("BENCH_STREAM_PUSH_EVENTS", "384"))
+    ingest_batch = int(os.environ.get("BENCH_STREAM_BATCH", "32"))
+    out["cpu_cores"] = os.cpu_count()
+
+    model, metadata = _build_serving_model()
+    scorer = CompiledScorer(model)
+    name = "stream-m-000"
+
+    # -- arrival schedule: one GSA1 chunk replayed in index order -----------
+    arch_dir = tempfile.mkdtemp(prefix="gordo-bench-stream-")
+    try:
+        step = pd.Timedelta("30min")
+        start = pd.Timestamp("2024-01-01T00:00:00Z")
+        arch = ScoreArchive.create(
+            arch_dir, project="bench", start=str(start),
+            end=str(start + step * n_replay), resolution="30min",
+            chunk_rows=n_replay, n_chunks=1, dtype="float32",
+            machines=[name],
+        )
+        rng = np.random.default_rng(17)
+        idx = (
+            int(start.value)
+            + int(step.value) * np.arange(n_replay, dtype=np.int64)
+        )
+        arch.write_chunk(0, {name: {
+            "index-ns": idx,
+            "total-anomaly-score":
+                rng.random(n_replay, dtype=np.float32),
+            "tag-anomaly-scores":
+                rng.random((n_replay, N_TAGS), dtype=np.float32),
+            "tags": [f"tag-{j}" for j in range(N_TAGS)],
+        }})
+        hist = arch.read_machine(name)
+        order = np.argsort(hist["index-ns"], kind="stable")
+        X = rng.standard_normal((n_replay, N_TAGS)).astype(np.float32)
+        X = X[order]
+
+        # -- in-process device-path diagnostic ------------------------------
+        hub = StreamHub()
+        warm = 8
+        for i in range(warm):  # includes the stream-step compile
+            hub.ingest_rows(name, scorer, X[i])
+        t0 = time.perf_counter()
+        for i in range(warm, n_replay):
+            hub.ingest_rows(name, scorer, X[i])
+        step_rate = (n_replay - warm) / (time.perf_counter() - t0)
+        h = hub.streams[name].state_rows
+
+        scorer.anomaly_arrays(X[:h], None)  # compile the polled bucket
+        t0 = time.perf_counter()
+        for i in range(h, h + n_poll):
+            scorer.anomaly_arrays(X[i - h: i], None)
+        device_poll_rate = n_poll / (time.perf_counter() - t0)
+        out["stream_step_samples_per_s"] = round(step_rate, 1)
+        out["stream_device_polling_samples_per_s"] = round(
+            device_poll_rate, 1
+        )
+        out["stream_state_rows"] = h
+        log(
+            f"streaming step (in-process): {step_rate:,.0f}/s vs "
+            f"{device_poll_rate:,.0f}/s bulk device path"
+        )
+
+        # -- end-to-end: real server, 1-row polling vs ingest+drain ---------
+        art_dir = _backfill_fleet_dir(model, metadata, [name])
+        try:
+
+            async def runner():
+                coll = ModelCollection.from_directory(
+                    art_dir, project="bench"
+                )
+                app_runner = web.AppRunner(build_app(coll))
+                await app_runner.setup()
+                site = web.TCPSite(app_runner, "127.0.0.1", 0)
+                await site.start()
+                base = f"http://127.0.0.1:{app_runner.addresses[0][1]}"
+
+                def post(url, doc):
+                    req = urllib.request.Request(
+                        url, data=json.dumps(doc).encode(),
+                        headers={"Content-Type": "application/json"},
+                    )
+                    with urllib.request.urlopen(req, timeout=60) as resp:
+                        return json.load(resp)
+
+                def drive():
+                    # 1-row bulk polling: one request per sample is the
+                    # request path's only route to one new verdict
+                    url = (
+                        f"{base}/gordo/v0/bench/{name}/anomaly/prediction"
+                    )
+                    post(url, {"X": X[:1].tolist()})  # warm
+                    t0 = time.perf_counter()
+                    for i in range(n_poll):
+                        post(url, {"X": X[i: i + 1].tolist()})
+                    poll_rate = n_poll / (time.perf_counter() - t0)
+
+                    # streaming: transport-batched ingest + the consumer
+                    # draining the event ring via long-poll frames
+                    feeder = Client("bench", base_url=base)
+                    feeder.stream_ingest({name: X[:warm].tolist()})
+                    stream_url = f"{base}/gordo/v0/bench/stream"
+                    got, cursor = 0, 0
+
+                    def feed():
+                        j = warm
+                        while j < warm + n_e2e:
+                            feeder.stream_ingest({name: X[
+                                j % (n_replay - ingest_batch):
+                                j % (n_replay - ingest_batch)
+                                + ingest_batch
+                            ].tolist()})
+                            j += ingest_batch
+
+                    th = _threading.Thread(target=feed, daemon=True)
+                    t0 = time.perf_counter()
+                    th.start()
+                    while got < n_e2e:
+                        status = urllib.request.urlopen(
+                            f"{stream_url}?mode=poll&after={cursor}"
+                            "&timeout=10", timeout=60,
+                        )
+                        doc = json.load(status)
+                        got += sum(
+                            1 for ev in doc["events"]
+                            if ev["type"] == "verdict"
+                        )
+                        cursor = doc["last-event-id"]
+                    stream_rate = got / (time.perf_counter() - t0)
+                    th.join(timeout=30)
+
+                    # detection-to-push p99 over a live SSE subscriber
+                    lats: "list[float]" = []
+                    consumer = Client("bench", base_url=base)
+                    stop = _threading.Event()
+
+                    def feed_paced():
+                        j = 0
+                        while not stop.is_set():
+                            feeder.stream_ingest(
+                                {name: [X[j % n_replay].tolist()]}
+                            )
+                            j += 1
+                            time.sleep(0.003)
+
+                    th2 = _threading.Thread(target=feed_paced, daemon=True)
+                    th2.start()
+                    try:
+                        for ev in consumer.stream(
+                            machines=[name], max_events=n_push
+                        ):
+                            if ev["type"] != "verdict":
+                                continue
+                            lats.append(
+                                time.time() - ev["data"]["time"]
+                            )
+                    finally:
+                        stop.set()
+                        th2.join(timeout=10)
+                    return poll_rate, stream_rate, lats
+
+                try:
+                    return await asyncio.get_running_loop().run_in_executor(
+                        None, drive
+                    )
+                finally:
+                    await app_runner.cleanup()
+
+            poll_rate, stream_rate, lats = asyncio.run(runner())
+            ratio = stream_rate / poll_rate
+            out["stream_samples_per_s_per_core"] = round(stream_rate, 1)
+            out["stream_polling_samples_per_s_per_core"] = round(
+                poll_rate, 1
+            )
+            out["stream_vs_polling"] = round(ratio, 2)
+            log(
+                f"streaming e2e: {stream_rate:,.0f} samples/s/core vs "
+                f"{poll_rate:,.0f} polling ({ratio:.1f}x; gate >= 5x)"
+            )
+            if ratio < 5.0:
+                out["stream_gate_miss"] = (
+                    f"streaming {ratio:.2f}x polling, gate >= 5x"
+                )
+
+            lats_ms = np.asarray(lats) * 1e3
+            out["stream_push_p50_ms"] = round(
+                float(np.percentile(lats_ms, 50)), 2
+            )
+            out["stream_push_p99_ms"] = round(
+                float(np.percentile(lats_ms, 99)), 2
+            )
+            out["stream_push_events"] = len(lats)
+            log(
+                f"streaming push latency over SSE: p50 "
+                f"{out['stream_push_p50_ms']}ms p99 "
+                f"{out['stream_push_p99_ms']}ms ({len(lats)} events)"
+            )
+        finally:
+            shutil.rmtree(art_dir, ignore_errors=True)
+    finally:
+        shutil.rmtree(arch_dir, ignore_errors=True)
+
+
 def bench_serving_wire(out: dict) -> None:
     """ISSUE 15 acceptance: the GSB1 columnar bulk wire vs the r18
     msgpack bulk wire, end-to-end through the real ``Client`` against a
@@ -3466,7 +3713,7 @@ STAGES = ("build", "build_pipeline", "artifact_io", "hot_reload",
           "serving", "serving_precision", "serving_sharded",
           "serving_wire", "serving_openloop", "telemetry_overhead",
           "health_overhead", "cold_start", "multi_device", "refresh",
-          "backfill", "scores_lifecycle", "lstm")
+          "backfill", "scores_lifecycle", "streaming", "lstm")
 
 
 def parse_cli(argv: "list[str]") -> "tuple[list[str], int | None]":
@@ -3639,6 +3886,10 @@ def main(argv: "list[str] | None" = None) -> None:
         "scores_lifecycle": (
             lambda: bench_scores_lifecycle(out),
             lambda: min(remaining() * 0.8, 900),
+        ),
+        "streaming": (
+            lambda: bench_streaming(out),
+            lambda: min(remaining() * 0.7, 480),
         ),
         "lstm": (
             lambda: bench_lstm_build(mesh, out),

@@ -816,6 +816,11 @@ def build_project(
                 "lookback": int(
                     getattr(spec.estimator_proto, "lookback_window", 1) or 1
                 ),
+                # sizes the streaming plane's carried ring
+                # (offset + max(smooth_window, 1) rows)
+                "smooth_window": int(
+                    getattr(spec.detector_proto, "window", 0) or 0
+                ),
             }
         )
 

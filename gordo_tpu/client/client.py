@@ -1127,6 +1127,161 @@ class Client:
         await self._forward(predictions, machine, meta, errors)
         return PredictionResult(machine, predictions, errors)
 
+    # -- streaming (push-based verdicts; serve/stream.py) --------------------
+    def _stream_groups(
+        self, machines: Optional[Sequence[str]]
+    ) -> Dict[str, Optional[List[str]]]:
+        """Shard-aware subscription routing: machine verdicts originate
+        on the replica that OWNS the machine, so subscriptions split by
+        the same shard function requests route with — one upstream
+        connection per owning replica, never a fan-in hop through a
+        replica that would just 421."""
+        if machines:
+            groups: Dict[str, Optional[List[str]]] = {}
+            for name in machines:
+                base = self.base_url
+                if self._router is not None:
+                    try:
+                        base = self._router.url_for(name)
+                    except KeyError:
+                        pass
+                groups.setdefault(base, []).append(name)  # type: ignore[union-attr]
+            return groups
+        bases = (
+            self.replica_urls
+            if self.replica_urls and len(self.replica_urls) > 1
+            else [self.base_url]
+        )
+        return {base: None for base in bases}
+
+    def _stream_url(self, base: str, members: Optional[List[str]]) -> str:
+        url = f"{base}{API_PREFIX}/{self.project}/stream"
+        if members:
+            from urllib.parse import urlencode
+
+            url += "?" + urlencode({"machines": ",".join(members)})
+        return url
+
+    async def stream_events_async(
+        self,
+        session: aiohttp.ClientSession,
+        machines: Optional[Sequence[str]] = None,
+        after: Optional[int] = None,
+    ):
+        """Async iterator over pushed stream events (``verdict`` /
+        ``threshold`` / ``drift``) for ``machines`` (None = the whole
+        fleet).  Rides :func:`gordo_tpu.client.io.sse_events`: reconnect
+        with ``Last-Event-ID`` resume is automatic, so a dropped
+        connection loses and duplicates nothing the server still holds
+        in its replay ring.  Against a sharded tier one SSE connection
+        runs per owning replica and events merge in arrival order; event
+        ids are then per-replica cursors, and ``after`` (which seeds
+        every connection) is only meaningful single-replica."""
+        from gordo_tpu.client.io import sse_events
+
+        await self._ensure_router(session)
+        groups = self._stream_groups(machines)
+        if len(groups) == 1:
+            ((base, members),) = groups.items()
+            async for ev in sse_events(
+                session, self._stream_url(base, members),
+                last_event_id=after, retries=self.n_retries,
+            ):
+                yield ev
+            return
+
+        queue: "asyncio.Queue" = asyncio.Queue()
+
+        async def pump(base: str, members: Optional[List[str]]):
+            try:
+                async for ev in sse_events(
+                    session, self._stream_url(base, members),
+                    last_event_id=after, retries=self.n_retries,
+                ):
+                    await queue.put(ev)
+            except BaseException as exc:  # surfaced on the consumer side
+                await queue.put(exc)
+                raise
+
+        tasks = [
+            asyncio.ensure_future(pump(b, m)) for b, m in groups.items()
+        ]
+        try:
+            while True:
+                item = await queue.get()
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            for task in tasks:
+                task.cancel()
+
+    def stream(
+        self,
+        machines: Optional[Sequence[str]] = None,
+        after: Optional[int] = None,
+        max_events: Optional[int] = None,
+    ):
+        """Sync generator over pushed stream events — the reference-shaped
+        surface (``for ev in client.stream([...])``) around
+        :meth:`stream_events_async`.  ``max_events`` bounds the iteration
+        (None streams until the consumer breaks or the server goes
+        unreachable past the retry budget)."""
+        loop = asyncio.new_event_loop()
+        session = loop.run_until_complete(self._open_session())
+        gen = self.stream_events_async(session, machines, after)
+        try:
+            n = 0
+            while max_events is None or n < max_events:
+                try:
+                    ev = loop.run_until_complete(gen.__anext__())
+                except StopAsyncIteration:
+                    break
+                yield ev
+                n += 1
+        finally:
+            loop.run_until_complete(gen.aclose())
+            loop.run_until_complete(session.close())
+            loop.close()
+
+    async def _open_session(self) -> aiohttp.ClientSession:
+        return aiohttp.ClientSession()
+
+    async def stream_ingest_async(
+        self,
+        session: aiohttp.ClientSession,
+        X: Dict[str, Any],
+    ) -> Dict[str, Any]:
+        """POST arriving rows to the streaming ingest route, shard-routed:
+        ``X`` maps machine name -> rows (list or ndarray).  Returns the
+        merged ``{"accepted", "events"}`` accounting."""
+        await self._ensure_router(session)
+        plan: Dict[str, Dict[str, Any]] = {}
+        for name, rows in X.items():
+            base = self.base_url
+            if self._router is not None:
+                try:
+                    base = self._router.url_for(name)
+                except KeyError:
+                    pass
+            rows = np.asarray(rows, np.float32)
+            plan.setdefault(base, {})[name] = rows.tolist()
+        accepted = 0
+        events = 0
+        for base, sub in plan.items():
+            body = await post_json(
+                session,
+                f"{base}{API_PREFIX}/{self.project}/stream/ingest",
+                {"X": sub},
+                retries=self.n_retries, timeout=self.timeout,
+            )
+            accepted += int(body.get("accepted", 0))
+            events += int(body.get("events", 0))
+        return {"accepted": accepted, "events": events}
+
+    def stream_ingest(self, X: Dict[str, Any]) -> Dict[str, Any]:
+        return _run(self._with_session(self.stream_ingest_async, X))
+
     # -- data fetch (host-side, reference behavior: client refetches raw) ----
     def _fetch_data(
         self, dataset_meta: Dict[str, Any], start: Any, end: Any

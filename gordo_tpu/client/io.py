@@ -207,6 +207,122 @@ async def request_json(
     raise BadGordoResponse(f"{method} {url} failed after {retries + 1} attempts") from last_exc
 
 
+async def sse_events(
+    session: aiohttp.ClientSession,
+    url: str,
+    *,
+    headers: Optional[Dict[str, str]] = None,
+    last_event_id: Optional[int] = None,
+    retries: int = 5,
+    backoff: float = 0.5,
+    deadline: Optional[float] = None,
+    read_timeout: float = 60.0,
+):
+    """Consume a server-sent-event stream, yielding parsed
+    ``{"id", "type", "data"}`` events with automatic reconnect.
+
+    The resume contract: the yielded id becomes the cursor, every
+    (re)connect carries it as ``Last-Event-ID``, and the server replays
+    what the ring still holds past it — so a dropped connection (or a
+    slow-consumer disconnect) loses nothing, and the ``id > cursor``
+    guard below drops any overlap, so nothing duplicates either.  A
+    torn frame (disconnect mid-event) never reaches the blank-line
+    dispatch and is discarded wholesale on reconnect.
+
+    Retry accounting matches :func:`request_json` in spirit: full-jitter
+    exponential backoff between connect attempts, ``retries`` bounding
+    CONSECUTIVE failed connects (any delivered event resets the count —
+    an SSE session is long-lived, so a per-session cap would just decide
+    when a healthy stream is eventually killed), permanent 4xx raising
+    immediately, and ``deadline`` bounding the whole session.
+    ``read_timeout`` bounds the gap between frames; the server's
+    keepalive comments (default 15s) tick well inside it.
+    """
+    headers = dict(headers or {})
+    headers.setdefault(telemetry.TRACE_HEADER, telemetry.ensure_trace_id())
+    cursor = last_event_id
+    attempt = 0
+    while True:
+        if deadline is not None and deadline - time.monotonic() <= 0:
+            raise DeadlineExceeded(f"GET {url}: stream deadline exhausted")
+        hdrs = dict(headers)
+        if cursor is not None:
+            hdrs["Last-Event-ID"] = str(cursor)
+        try:
+            _check_http_fault("GET", url)
+            async with session.get(
+                url,
+                headers=hdrs,
+                timeout=aiohttp.ClientTimeout(
+                    total=None, sock_read=read_timeout
+                ),
+            ) as resp:
+                if resp.status == 422:
+                    raise HttpUnprocessableEntity(await resp.text())
+                if (
+                    400 <= resp.status < 500
+                    and resp.status not in _RETRYABLE_STATUSES
+                ):
+                    raise BadGordoRequest(
+                        f"GET {url} -> {resp.status}: {await resp.text()}"
+                    )
+                if resp.status >= 400:
+                    raise BadGordoResponse(
+                        f"GET {url} -> {resp.status}: {await resp.text()}"
+                    )
+                import json as _json
+
+                fields: Dict[str, Any] = {}
+                data_lines: list = []
+                async for raw in resp.content:
+                    line = raw.decode("utf-8", "replace").rstrip("\r\n")
+                    if not line:
+                        if (
+                            fields.get("id") is not None
+                            and fields.get("type")
+                            and data_lines
+                        ):
+                            eid = fields["id"]
+                            if cursor is None or eid > cursor:
+                                cursor = eid
+                                attempt = 0
+                                yield {
+                                    "id": eid,
+                                    "type": fields["type"],
+                                    "data": _json.loads("\n".join(data_lines)),
+                                }
+                        fields, data_lines = {}, []
+                    elif line.startswith(":"):
+                        continue  # keepalive / replay-gap comment
+                    elif line.startswith("id:"):
+                        fields["id"] = int(line[3:].strip())
+                    elif line.startswith("event:"):
+                        fields["type"] = line[6:].strip()
+                    elif line.startswith("data:"):
+                        data_lines.append(line[5:].strip())
+            # server closed the stream (slow-consumer disconnect, replica
+            # restart): fall through to the reconnect accounting below —
+            # a clean close that never delivers still can't loop forever
+            raise aiohttp.ClientConnectionError(f"GET {url}: stream closed")
+        except (HttpUnprocessableEntity, BadGordoRequest, DeadlineExceeded):
+            raise
+        except (
+            aiohttp.ClientError, asyncio.TimeoutError, BadGordoResponse
+        ) as exc:
+            if attempt >= retries:
+                raise BadGordoResponse(
+                    f"GET {url}: stream failed after {retries + 1} "
+                    "consecutive connect attempts"
+                ) from exc
+            delay = random.uniform(0.0, backoff * (2 ** attempt))
+            attempt += 1
+            if deadline is not None and deadline - time.monotonic() <= delay:
+                raise DeadlineExceeded(
+                    f"GET {url}: stream deadline exhausted"
+                ) from exc
+            await asyncio.sleep(delay)
+
+
 def _check_http_fault(method: str, url: str) -> None:
     """``http.request`` injection seam, translated to the wire-level
     failures this module's retry loop already classifies."""

@@ -123,6 +123,22 @@ def _leaf_sig(leaf: Any) -> Tuple:
             _sharding_token(leaf))
 
 
+class BoundProgram:
+    """A program pre-resolved to one compiled executable (see
+    :meth:`Program.bind`): call with the FULL positional argument list
+    (statics included, in signature order) — the statics are already
+    baked into the executable and are dropped here by position."""
+
+    __slots__ = ("_exe", "_dyn_idx")
+
+    def __init__(self, exe: Any, dyn_idx: Tuple[int, ...]):
+        self._exe = exe
+        self._dyn_idx = dyn_idx
+
+    def __call__(self, *ordered):
+        return self._exe(*[ordered[i] for i in self._dyn_idx])
+
+
 class Program:
     """One explicitly registered jitted program with an AOT executable
     cache.
@@ -227,6 +243,42 @@ class Program:
         _COMPILE_SECONDS.observe(time.perf_counter() - t0, self.name)
         self._registry._put_executable(key, exe)
         return exe
+
+    def bind(self, *args, **kwargs) -> Optional["BoundProgram"]:
+        """Resolve THIS call signature to its compiled executable once
+        and return a :class:`BoundProgram` — the fixed-shape hot-loop
+        fast path (the streaming step dispatches through one of these
+        per arrival), skipping the per-call normalize/split/key work
+        that dominates sub-millisecond dispatches.
+
+        The binding is only valid while every subsequent call repeats
+        the SAME static values and dynamic shapes/dtypes; callers must
+        re-bind when either changes (a mismatched call raises from the
+        executable rather than miscomputing).  Returns None when the
+        AOT plane is off or cannot express the call — fall back to
+        normal ``__call__`` dispatch then.
+        """
+        if self._aot_broken or not _plane_enabled():
+            return None
+        try:
+            ordered = self._normalize(args, kwargs)
+            statics, dynamics = self._split(ordered)
+            key, _ = self._key(statics, dynamics)
+        except Exception:
+            return None
+        if key is None:
+            return None
+        exe = self._registry._get_executable(key)
+        if exe is None:
+            _CACHE_MISSES.inc(1.0, "programs")
+            exe = self._compile(key, ordered)
+            if exe is None:
+                return None
+        dyn_idx = tuple(
+            i for i, pname in enumerate(self._signature.parameters)
+            if pname not in self._static
+        )
+        return BoundProgram(exe, dyn_idx)
 
     def warm(self, *args, **kwargs) -> float:
         """Pre-compile this program for the given argument shapes without

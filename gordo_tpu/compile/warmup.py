@@ -295,6 +295,24 @@ def warmup_collection(
                     )
                     stats["errors"] += 1
                     ok = False
+            # the streaming plane's incremental step program (one per
+            # chain signature — every machine in the bucket shares it);
+            # [] when the model can't stream, which is not an error
+            try:
+                from gordo_tpu.serve import stream as stream_mod
+
+                for label, secs in stream_mod.warm_stream_program(
+                    entry.scorer, n_feat
+                ):
+                    stats["programs"].append(
+                        {"program": label, "rows": 1, "seconds": round(secs, 3)}
+                    )
+            except Exception:
+                logger.exception(
+                    "Warmup failed for stream program %s", bucket.names[0]
+                )
+                stats["errors"] += 1
+                ok = False
             # one EXECUTED dispatch at the smallest row bucket: the AOT
             # compiles above land the executables, but the first real
             # dispatch still pays one-time runtime costs (backend thread

@@ -41,6 +41,11 @@ server.request     latency, http_500, reset
 replica.scatter    dead
 watchman.scrape    blackhole
 barrier.wait       peer_loss
+stream.ingest      latency, reset, http_503  (fires BEFORE state
+                   mutation — a failed ingest never half-applies)
+stream.push        disconnect  (transport killed mid-frame),
+                   slow_consumer  (writer stalls until its queue
+                   overflows and the hub disconnects it)
 =================  =============================================
 
 Determinism: every rule draws from its own ``random.Random`` seeded

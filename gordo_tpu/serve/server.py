@@ -35,6 +35,7 @@ from gordo_tpu import artifacts, faults, serializer, telemetry
 from gordo_tpu.telemetry.fleet_health import drift_top_k
 from gordo_tpu.serve import codec
 from gordo_tpu.serve import coalesce as coalesce_mod
+from gordo_tpu.serve import stream as stream_mod
 from gordo_tpu.serve.scorer import CompiledScorer
 
 logger = logging.getLogger(__name__)
@@ -185,6 +186,7 @@ COLLECTION_KEY: "web.AppKey[ModelCollection]" = web.AppKey(
 )
 COALESCER_KEY: "web.AppKey[object]" = web.AppKey("coalescer", object)
 WARMUP_TASK_KEY: "web.AppKey[object]" = web.AppKey("warmup_task", object)
+STREAM_HUB_KEY: "web.AppKey[object]" = web.AppKey("stream_hub", object)
 
 
 class ModelEntry:
@@ -965,8 +967,15 @@ def _misdirected(collection: "ModelCollection", name: str) -> Optional[str]:
 
 
 def _entry_or_404(request: web.Request) -> ModelEntry:
-    collection: ModelCollection = request.app[COLLECTION_KEY]
-    name = request.match_info["machine"]
+    return _resolve_entry(
+        request.app[COLLECTION_KEY], request.match_info["machine"]
+    )
+
+
+def _resolve_entry(collection: "ModelCollection", name: str) -> ModelEntry:
+    """``name`` -> entry, with the one quarantine/misroute/404 contract
+    shared by the path-routed handlers and the streaming plane (whose
+    machine names arrive in payloads and query strings, not the path)."""
     entry = collection.get(name)
     if entry is None:
         info = collection.quarantined.get(name)
@@ -1326,6 +1335,155 @@ async def download_model(request: web.Request) -> web.Response:
     return web.Response(body=body, content_type="application/octet-stream")
 
 
+# -- streaming plane (serve/stream.py) --------------------------------------
+
+def _stream_after(request: web.Request, hub) -> int:
+    """The resume cursor: ``Last-Event-ID`` header (SSE reconnect), then
+    ``?after=`` (long-poll / explicit replay), else the ring head — a
+    fresh subscriber tails live events only."""
+    raw = request.headers.get("Last-Event-ID") or request.query.get("after")
+    if raw is None:
+        return hub.ring.last_id
+    try:
+        return int(raw)
+    except ValueError:
+        raise web.HTTPBadRequest(
+            text=json.dumps({"error": f"bad event id {raw!r}"}),
+            content_type="application/json",
+        )
+
+
+async def stream_ingest(request: web.Request) -> web.Response:
+    """``POST {project}/stream/ingest``: feed arriving rows into the
+    per-machine streams; verdicts/crossings push to subscribers.
+
+    Body forms: ``{"machine": m, "x": row-or-rows}`` or the bulk-shaped
+    ``{"X": {machine: rows}}``.  Scoring BYPASSES the coalescer — a
+    streamed row is one O(1) fixed-shape dispatch already, and queueing
+    it behind a micro-batch window would tax exactly the latency the
+    push model exists to minimize.  Returns the accepted row count and
+    the hub's event cursor (a poller can resume from it directly).
+    """
+    collection: ModelCollection = request.app[COLLECTION_KEY]
+    hub = request.app[STREAM_HUB_KEY]
+    payload = await _read_payload(request)
+    if not isinstance(payload, dict):
+        raise web.HTTPBadRequest(
+            text=json.dumps({"error": "body must be a JSON object"}),
+            content_type="application/json",
+        )
+    try:
+        if isinstance(payload.get("X"), dict):
+            batches = [
+                (name, rows) for name, rows in payload["X"].items()
+            ]
+        elif payload.get("machine"):
+            batches = [(payload["machine"], payload.get("x"))]
+        else:
+            raise ValueError(
+                'need {"machine": ..., "x": ...} or {"X": {machine: rows}}'
+            )
+        parsed = []
+        for name, rows in batches:
+            entry = _resolve_entry(collection, name)
+            X = parse_X({"X": rows}, entry.tags)
+            _validate_width(X, entry)
+            parsed.append((name, entry, X))
+    except ValueError as exc:
+        raise web.HTTPBadRequest(
+            text=json.dumps({"error": str(exc)}),
+            content_type="application/json",
+        )
+    accepted = 0
+    published = 0
+    for name, entry, X in parsed:
+        try:
+            events = hub.ingest_rows(
+                name, entry.scorer, X, dtype=collection.serve_dtype
+            )
+        except stream_mod.StreamUnsupported as exc:
+            raise web.HTTPUnprocessableEntity(
+                text=json.dumps({"error": str(exc)}),
+                content_type="application/json",
+            )
+        except faults.InjectedFault as exc:
+            # the stream.ingest seam fires BEFORE state mutation, so
+            # the client may retry without double-applying the row
+            if exc.mode == "reset":
+                if request.transport is not None:
+                    request.transport.close()
+                raise web.HTTPInternalServerError(text=str(exc))
+            status = 503 if exc.mode == "http_503" else 500
+            return web.json_response({"error": str(exc)}, status=status)
+        accepted += int(X.shape[0])
+        published += len(events)
+    return await _respond(request, {
+        "accepted": accepted,
+        "events": published,
+        "last-event-id": hub.ring.last_id,
+    })
+
+
+async def stream_subscribe(request: web.Request) -> web.StreamResponse:
+    """``GET {project}/stream``: the push surface.
+
+    Default is SSE (``text/event-stream`` frames with hub-global
+    monotonic ids; reconnect with ``Last-Event-ID`` to replay what was
+    missed).  ``?mode=poll&after=N`` is the chunked long-poll fallback
+    for clients that can't hold SSE: it waits up to ``?timeout=`` (capped
+    at the server's poll budget) for events past ``N`` and returns them
+    as one JSON batch with the next cursor.  ``?machines=a,b`` filters;
+    every named machine is resolved through the quarantine/shard
+    contract first, so a subscription for a foreign machine 421s with
+    the owner shard identified (clients split subscriptions per shard).
+    """
+    collection: ModelCollection = request.app[COLLECTION_KEY]
+    hub = request.app[STREAM_HUB_KEY]
+    machines = None
+    if request.query.get("machines"):
+        machines = [
+            m for m in request.query["machines"].split(",") if m
+        ]
+        for name in machines:
+            _resolve_entry(collection, name)
+    after = _stream_after(request, hub)
+
+    if request.query.get("mode") == "poll":
+        try:
+            timeout = min(
+                float(request.query.get("timeout", "1e9")),
+                stream_mod.poll_timeout_seconds(),
+            )
+        except ValueError:
+            timeout = stream_mod.poll_timeout_seconds()
+        doc = await stream_mod.poll_events(
+            hub, set(machines) if machines else None, after, timeout
+        )
+        return await _respond(request, doc)
+
+    sub = hub.subscribe(machines)
+    response = web.StreamResponse(
+        status=200,
+        headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+            # tells nginx-style proxies not to buffer the event stream
+            "X-Accel-Buffering": "no",
+        },
+    )
+    response.enable_chunked_encoding()
+    await response.prepare(request)
+    try:
+        await stream_mod.run_sse(response, hub, sub, after)
+    except faults.InjectedFault:
+        # mid-event disconnect: kill the transport with the frame torn
+        if request.transport is not None:
+            request.transport.close()
+    except (ConnectionResetError, ConnectionError, asyncio.CancelledError):
+        pass  # peer went away / server shutdown — run_sse unsubscribed
+    return response
+
+
 async def readiness(request: web.Request) -> web.Response:
     """Readiness endpoint for orchestrators: 503 while a startup warmup is
     still compiling, 200 once it finishes (or when warmup is off).  The
@@ -1644,6 +1802,7 @@ def build_app(
         middlewares=[telemetry_middleware, deadline_middleware],
     )
     app[COLLECTION_KEY] = collection
+    app[STREAM_HUB_KEY] = stream_mod.StreamHub(collection)
 
     if warmup:
         from gordo_tpu import compile as compile_plane
@@ -1850,6 +2009,10 @@ def build_app(
     # score-archive aggregation pushdown (r20): summaries over the
     # backfill plane's archive, served from this collection's source dir
     app.router.add_get(f"{p}/scores/aggregate", scores_aggregate)
+    # streaming plane: also before {machine} ("stream" is a path segment,
+    # not a machine name)
+    app.router.add_post(f"{p}/stream/ingest", stream_ingest)
+    app.router.add_get(f"{p}/stream", stream_subscribe)
     app.router.add_get(f"{p}/{{machine}}/healthcheck", healthcheck)
     app.router.add_get(f"{p}/{{machine}}/metadata", metadata)
     app.router.add_post(f"{p}/{{machine}}/prediction", prediction)

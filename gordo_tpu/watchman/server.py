@@ -410,14 +410,151 @@ async def _fleet_health(request: web.Request) -> web.Response:
     return web.json_response(merged)
 
 
+class StreamRelay:
+    """Re-fan the fleet's streams as ONE merged alert surface.
+
+    Watchman subscribes to every target replica's ``/stream`` (lazily —
+    the upstream SSE connections start on the first local subscriber)
+    and republishes the events through its own relay hub
+    (``StreamHub(collection=None)``), so a consumer watching the whole
+    sharded fleet holds one connection HERE instead of one per replica.
+    Relay events keep the upstream payload and gain ``target`` (which
+    replica) and ``origin-id`` (the upstream event id); the ``id`` the
+    relay stamps is its own — ``Last-Event-ID`` resume against watchman
+    works the same as against a replica, while each upstream connection
+    resumes independently with its per-target cursor, so a replica
+    bounce loses nothing its ring still holds."""
+
+    def __init__(self, watchman: Watchman):
+        from gordo_tpu.serve import stream as stream_mod
+
+        self.watchman = watchman
+        self.hub = stream_mod.StreamHub()
+        self._tasks: Dict[str, asyncio.Task] = {}
+        self._cursors: Dict[str, int] = {}
+        self._session: Optional[Any] = None
+
+    async def ensure_started(self) -> None:
+        """(Re)start one upstream pump per current target."""
+        import aiohttp
+
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession()
+        loop = asyncio.get_running_loop()
+        for base in await self.watchman._current_targets():
+            task = self._tasks.get(base)
+            if task is None or task.done():
+                self._tasks[base] = loop.create_task(self._pump(base))
+
+    async def _pump(self, base: str) -> None:
+        from gordo_tpu.client.io import sse_events
+
+        url = f"{base}/gordo/v0/{self.watchman.project}/stream"
+        while True:
+            try:
+                async for ev in sse_events(
+                    self._session, url,
+                    last_event_id=self._cursors.get(base),
+                ):
+                    self._cursors[base] = ev["id"]
+                    data = dict(ev["data"])
+                    data["target"] = base
+                    data["origin-id"] = ev["id"]
+                    self.hub.publish(ev["type"], data)
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:
+                # sse_events already burned its reconnect budget — the
+                # target is properly down; keep trying at poll cadence so
+                # the relay heals itself when the replica comes back
+                logger.warning("Stream relay to %s failed: %s", base, exc)
+                await asyncio.sleep(
+                    min(self.watchman.poll_interval, 10.0) or 5.0
+                )
+
+    async def close(self) -> None:
+        for task in self._tasks.values():
+            task.cancel()
+        for task in self._tasks.values():
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._tasks.clear()
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+
+STREAM_RELAY_KEY: "web.AppKey[StreamRelay]" = web.AppKey(
+    "stream_relay", object
+)
+
+
+async def _stream(request: web.Request) -> web.StreamResponse:
+    """``GET /stream``: the merged fleet alert stream (see
+    :class:`StreamRelay`).  Same wire contract as a replica's stream
+    route — SSE by default with ``Last-Event-ID`` resume,
+    ``?mode=poll&after=N`` long-poll fallback, ``?machines=a,b``
+    filter — but machine names here are NOT validated against a shard
+    table: the relay fans in from every target, so any filter is just a
+    filter."""
+    from gordo_tpu.serve import stream as stream_mod
+
+    relay: StreamRelay = request.app[STREAM_RELAY_KEY]
+    await relay.ensure_started()
+    hub = relay.hub
+    machines = None
+    if request.query.get("machines"):
+        machines = {
+            m for m in request.query["machines"].split(",") if m
+        }
+    raw = request.headers.get("Last-Event-ID") or request.query.get("after")
+    try:
+        after = int(raw) if raw is not None else hub.ring.last_id
+    except ValueError:
+        return web.json_response(
+            {"error": f"bad event id {raw!r}"}, status=400
+        )
+
+    if request.query.get("mode") == "poll":
+        try:
+            timeout = min(
+                float(request.query.get("timeout", "1e9")),
+                stream_mod.poll_timeout_seconds(),
+            )
+        except ValueError:
+            timeout = stream_mod.poll_timeout_seconds()
+        doc = await stream_mod.poll_events(hub, machines, after, timeout)
+        return web.json_response(doc)
+
+    sub = hub.subscribe(machines)
+    response = web.StreamResponse(
+        status=200,
+        headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+            "X-Accel-Buffering": "no",
+        },
+    )
+    response.enable_chunked_encoding()
+    await response.prepare(request)
+    try:
+        await stream_mod.run_sse(response, hub, sub, after)
+    except (ConnectionResetError, ConnectionError, asyncio.CancelledError):
+        pass  # peer went away — run_sse unsubscribed
+    return response
+
+
 def build_watchman_app(watchman: Watchman) -> web.Application:
     app = web.Application()
     app[WATCHMAN_KEY] = watchman
+    app[STREAM_RELAY_KEY] = StreamRelay(watchman)
 
     async def _start(app):
         watchman.start()
 
     async def _stop(app):
+        await app[STREAM_RELAY_KEY].close()
         await watchman.stop()
 
     app.on_startup.append(_start)
@@ -426,6 +563,7 @@ def build_watchman_app(watchman: Watchman) -> web.Application:
     app.router.add_get("/healthcheck", _healthcheck)
     app.router.add_get("/metrics", _metrics)
     app.router.add_get("/fleet-health", _fleet_health)
+    app.router.add_get("/stream", _stream)
     return app
 
 

@@ -688,14 +688,33 @@ def _server_deployment(
     }
 
 
-def _service(project: str, component: str, port: int) -> Dict:
+#: Service-level idle-timeout annotation for components that carry
+#: long-lived SSE connections (the streaming plane): cloud LB defaults
+#: (AWS ELB: 60s) would sever a healthy stream between events; an hour
+#: keeps the connection while the server's keepalive comments (default
+#: every 15s) prove liveness far inside it.
+_SSE_SERVICE_ANNOTATIONS = {
+    "service.beta.kubernetes.io/aws-load-balancer-connection-idle-timeout":
+        "3600",
+}
+
+
+def _service(
+    project: str,
+    component: str,
+    port: int,
+    annotations: Optional[Dict[str, str]] = None,
+) -> Dict:
+    metadata: Dict[str, Any] = {
+        "name": f"gordo-{component}-{project}",
+        "labels": _labels(project, component),
+    }
+    if annotations:
+        metadata["annotations"] = dict(annotations)
     return {
         "apiVersion": "v1",
         "kind": "Service",
-        "metadata": {
-            "name": f"gordo-{component}-{project}",
-            "labels": _labels(project, component),
-        },
+        "metadata": metadata,
         "spec": {
             "selector": _labels(project, component),
             "ports": [{"port": port, "targetPort": port}],
@@ -723,6 +742,40 @@ def _machine_mapping(
             "prefix": f"{API_PREFIX}/{project}/{machine}/",
             "rewrite": f"{API_PREFIX}/{project}/{machine}/",
             "service": f"gordo-{component}-{project}:{DEFAULT_SERVER_PORT}",
+        },
+    }
+
+
+def _stream_mapping(
+    project: str,
+    name: str,
+    prefix: str,
+    rewrite: str,
+    component: str,
+    port: int = DEFAULT_SERVER_PORT,
+) -> Dict:
+    """Route Mapping for the streaming plane (``serve/stream.py``).
+
+    SSE subscriptions are long-lived by design; Ambassador's default
+    per-request timeout (3s) and Envoy's idle timeout would sever a
+    healthy stream between events.  The stream routes pin
+    ``timeout_ms: 0`` (no request ceiling) and a day-long
+    ``idle_timeout_ms`` — the server's keepalive comments
+    (``GORDO_STREAM_KEEPALIVE``, default 15s) tick far inside it, so a
+    dead peer is still reaped by TCP, not by a proxy guessing."""
+    return {
+        "apiVersion": "getambassador.io/v2",
+        "kind": "Mapping",
+        "metadata": {
+            "name": name,
+            "labels": _labels(project, "route"),
+        },
+        "spec": {
+            "prefix": prefix,
+            "rewrite": rewrite,
+            "service": f"gordo-{component}-{project}:{port}",
+            "timeout_ms": 0,
+            "idle_timeout_ms": 86400000,
         },
     }
 
@@ -1002,6 +1055,7 @@ def generate_workflow(
                 _service(
                     project, f"ml-server-shard-{spec.index}",
                     DEFAULT_SERVER_PORT,
+                    annotations=_SSE_SERVICE_ANNOTATIONS,
                 )
             )
             server_docs.append(
@@ -1023,7 +1077,10 @@ def generate_workflow(
                 scrape_annotations=scrape_annotations,
                 serve_dtype=serve_dtype,
             ),
-            _service(project, "ml-server", DEFAULT_SERVER_PORT),
+            _service(
+                project, "ml-server", DEFAULT_SERVER_PORT,
+                annotations=_SSE_SERVICE_ANNOTATIONS,
+            ),
         ]
         watchman_targets = [
             f"http://gordo-ml-server-{project}:{DEFAULT_SERVER_PORT}"
@@ -1037,11 +1094,44 @@ def generate_workflow(
             scrape_annotations=scrape_annotations,
             targets=watchman_targets,
         ),
-        _service(project, "watchman", DEFAULT_WATCHMAN_PORT),
+        _service(
+            project, "watchman", DEFAULT_WATCHMAN_PORT,
+            annotations=_SSE_SERVICE_ANNOTATIONS,
+        ),
     ]
     docs.extend(
         _machine_mapping(project, m, mapping_component[m]) for m in machines
     )
+    # streaming-plane routes (docs/serving.md "Streaming"): SSE-safe
+    # Mappings with the per-request timeout disabled.  Sharded tiers get
+    # one route per shard (ingest + subscribe against the replica that
+    # OWNS the machines — streams are per-replica state) plus a merged
+    # read-only route through the watchman relay's fan-in.
+    if sharded:
+        for spec in specs:
+            docs.append(_stream_mapping(
+                project,
+                name=f"gordo-mapping-{project}-stream-shard-{spec.index}",
+                prefix=f"{API_PREFIX}/{project}/shard-{spec.index}/stream",
+                rewrite=f"{API_PREFIX}/{project}/stream",
+                component=f"ml-server-shard-{spec.index}",
+            ))
+        docs.append(_stream_mapping(
+            project,
+            name=f"gordo-mapping-{project}-stream-merged",
+            prefix=f"{API_PREFIX}/{project}/stream/merged",
+            rewrite="/stream",
+            component="watchman",
+            port=DEFAULT_WATCHMAN_PORT,
+        ))
+    else:
+        docs.append(_stream_mapping(
+            project,
+            name=f"gordo-mapping-{project}-stream",
+            prefix=f"{API_PREFIX}/{project}/stream",
+            rewrite=f"{API_PREFIX}/{project}/stream",
+            component="ml-server",
+        ))
     if include_plan:
         docs.append(
             {

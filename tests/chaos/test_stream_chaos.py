@@ -1,0 +1,232 @@
+"""Streaming-plane chaos: seeded ``stream.push`` / ``stream.ingest``
+faults driven through a real server + the sync client iterator,
+asserting the exactly-once contract — across mid-frame disconnects and
+slow-consumer evictions, every published verdict reaches the consumer
+exactly once (Last-Event-ID resume + the client's id > cursor guard),
+and ingest retries never double-apply a row (the seam fires before any
+state mutation).
+
+Runs in the slow lane; CI replays it under the same fixed 3-seed
+matrix as ``test_chaos.py``.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gordo_tpu import faults
+from gordo_tpu.client import Client
+from tests.chaos.conftest import PROJECT_NAME
+from tests.chaos.test_chaos import _get_json, _serve_replicas
+
+pytestmark = pytest.mark.slow
+
+SEEDS = (
+    [int(os.environ["GORDO_CHAOS_SEED"])]
+    if os.environ.get("GORDO_CHAOS_SEED")
+    else [7, 101, 9001]
+)
+
+N_ROWS = 30
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_plane():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _rows(n, n_tags, seed):
+    return (
+        np.random.default_rng(seed)
+        .uniform(0, 1, size=(n, n_tags))
+        .tolist()
+    )
+
+
+def _published_verdicts(base, machine):
+    """Ground truth from the long-poll surface (which bypasses the
+    ``stream.push`` seam): ids of every verdict the hub published."""
+    status, doc = _get_json(
+        f"{base}/gordo/v0/{PROJECT_NAME}/stream"
+        "?mode=poll&after=0&timeout=0"
+    )
+    assert status == 200 and not doc["replay-gap"]
+    return [
+        ev["id"] for ev in doc["events"]
+        if ev["type"] == "verdict" and ev["data"]["machine"] == machine
+    ]
+
+
+def _consume_until_sentinel(client, out):
+    """Collect chaos-a events until the chaos-b sentinel arrives.
+
+    Yielded ids are strictly increasing (client cursor guard), and the
+    sentinel is published after every chaos-a event — so once it shows
+    up, anything the stream lost is lost for good and the comparison
+    against the hub's ring is exact."""
+    for ev in client.stream(machines=["chaos-a", "chaos-b"], after=0):
+        if ev["data"]["machine"] == "chaos-b":
+            return
+        if ev["type"] == "verdict":
+            out.append(ev["id"])
+
+
+def _feed(base, seed, done):
+    """Ingest N_ROWS for chaos-a one row at a time (paced, so most
+    events hit the LIVE push path where the seam fires), then a
+    chaos-b sentinel row."""
+    feeder = Client(PROJECT_NAME, base_url=base)
+    time.sleep(0.3)  # let the consumer attach first
+    rows = _rows(N_ROWS, 3, seed)
+    for row in rows:
+        feeder.stream_ingest({"chaos-a": [row]})
+        time.sleep(0.01)
+    feeder.stream_ingest({"chaos-b": [_rows(1, 4, seed)[0]]})
+    done.append(True)
+
+
+class TestPushDisconnect:
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_exactly_once_across_mid_frame_disconnects(
+        self, chaos_model_dir, seed
+    ):
+        """``disconnect`` kills the transport after the id/event lines
+        of a frame have hit the wire.  The client must discard the torn
+        frame, reconnect with its cursor, and end up with every
+        published verdict exactly once."""
+
+        def fn(bases, colls):
+            base = bases[0]
+            collected, done = [], []
+            with faults.injected(
+                f"seed={seed};stream.push=disconnect:0.3:match=chaos-a"
+            ) as plane:
+                feeder = threading.Thread(
+                    target=_feed, args=(base, seed, done)
+                )
+                feeder.start()
+                consumer = Client(PROJECT_NAME, base_url=base)
+                try:
+                    _consume_until_sentinel(consumer, collected)
+                finally:
+                    feeder.join()
+                fired = plane.stats()["stream.push:disconnect"]["fired"]
+            published = _published_verdicts(base, "chaos-a")
+            return collected, published, fired, done
+
+        collected, published, fired, done = _serve_replicas(
+            [chaos_model_dir], fn
+        )
+        assert done, "feeder did not finish"
+        assert len(published) == N_ROWS
+        # the contract: exactly the published set, no loss, no dup
+        assert collected == published, (
+            f"lost={set(published) - set(collected)} "
+            f"dup_or_phantom={set(collected) - set(published)}"
+        )
+        assert fired >= 1, "seeded schedule never exercised the seam"
+
+
+class TestSlowConsumerEviction:
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_exactly_once_across_queue_overflow(
+        self, chaos_model_dir, seed, monkeypatch
+    ):
+        """``slow_consumer`` stalls the SSE writer until its bounded
+        queue (shrunk to 4 here) overflows and the hub marks it dead;
+        the client reconnects and the ring replays what the dead
+        subscriber missed."""
+        monkeypatch.setenv("GORDO_STREAM_QUEUE", "4")
+
+        def fn(bases, colls):
+            base = bases[0]
+            collected, done = [], []
+            with faults.injected(
+                f"seed={seed};"
+                "stream.push=slow_consumer:1:times=1,match=chaos-a"
+            ) as plane:
+                feeder = threading.Thread(
+                    target=_feed, args=(base, seed, done)
+                )
+                feeder.start()
+                consumer = Client(PROJECT_NAME, base_url=base)
+                try:
+                    _consume_until_sentinel(consumer, collected)
+                finally:
+                    feeder.join()
+                fired = plane.stats()[
+                    "stream.push:slow_consumer"
+                ]["fired"]
+            published = _published_verdicts(base, "chaos-a")
+            return collected, published, fired
+
+        collected, published, fired = _serve_replicas(
+            [chaos_model_dir], fn
+        )
+        assert len(published) == N_ROWS
+        assert collected == published, (
+            f"lost={set(published) - set(collected)} "
+            f"dup_or_phantom={set(collected) - set(published)}"
+        )
+        assert fired == 1
+
+
+class TestIngestRetrySafety:
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_503_retries_never_double_apply(self, chaos_model_dir, seed):
+        """The ``stream.ingest`` seam fires BEFORE any state mutation:
+        a 503'd ingest applied nothing, so the client's automatic retry
+        lands the row exactly once — N rows in, N verdicts out, ids
+        with no holes in the per-machine sequence."""
+
+        def fn(bases, colls):
+            base = bases[0]
+            client = Client(PROJECT_NAME, base_url=base)
+            n = 10
+            with faults.injected(
+                f"seed={seed};stream.ingest=http_503:1:times=2"
+            ) as plane:
+                accepted = 0
+                for row in _rows(n, 3, seed):
+                    doc = client.stream_ingest({"chaos-a": [row]})
+                    accepted += doc["accepted"]
+                fired = plane.stats()["stream.ingest:http_503"]["fired"]
+            published = _published_verdicts(base, "chaos-a")
+            return n, accepted, fired, published
+
+        n, accepted, fired, published = _serve_replicas(
+            [chaos_model_dir], fn
+        )
+        assert accepted == n  # every row acked exactly once
+        assert fired == 2  # the schedule actually 503'd two ingests
+        assert len(published) == n  # ...and none of them half-applied
+        # steps are per-machine sequential — a double-apply would show
+        # as more events than rows, a loss as fewer
+        assert len(set(published)) == n
+
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_reset_mid_ingest_is_retry_safe(self, chaos_model_dir, seed):
+        """``reset`` tears the connection before the response; the
+        client retries the POST.  Because the seam precedes mutation,
+        the retried request is the FIRST application of the row."""
+
+        def fn(bases, colls):
+            base = bases[0]
+            client = Client(PROJECT_NAME, base_url=base)
+            n = 8
+            with faults.injected(
+                f"seed={seed};stream.ingest=reset:1:times=2"
+            ):
+                for row in _rows(n, 3, seed):
+                    client.stream_ingest({"chaos-a": [row]})
+            published = _published_verdicts(base, "chaos-a")
+            return n, published
+
+        n, published = _serve_replicas([chaos_model_dir], fn)
+        assert len(published) == n
+        assert len(set(published)) == n

@@ -16,7 +16,7 @@ def test_default_runs_every_stage_in_priority_order():
         "serving_precision", "serving_sharded", "serving_wire",
         "serving_openloop", "telemetry_overhead", "health_overhead",
         "cold_start", "multi_device", "refresh", "backfill",
-        "scores_lifecycle", "lstm",
+        "scores_lifecycle", "streaming", "lstm",
     ]
 
 

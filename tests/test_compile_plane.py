@@ -327,9 +327,12 @@ def test_warmup_collection_precompiles_from_manifest(model_dir):
     assert "serve.fleet/full" in labels
     assert "serve.fleet/subset" in labels
     assert "serve.score/anomaly" in labels
+    # the streaming plane's incremental step warms alongside (rows=1 —
+    # its dispatch shape is always one arriving row)
+    assert "serve.stream_step" in labels
     # manifest row buckets drove the warm set
     rows = {p["rows"] for p in stats["programs"]}
-    assert rows == {256, 2048}
+    assert rows == {1, 256, 2048}
 
 
 def test_serving_results_identical_warmup_on_vs_off(model_dir):

@@ -587,7 +587,11 @@ class TestGeneratorShardedTier:
 
         docs = generate_workflow(self._config(), serve_shards=2)
         table = shard_map(MACHINES, 2)
-        mappings = [d for d in docs if d["kind"] == "Mapping"]
+        mappings = [
+            d for d in docs
+            if d["kind"] == "Mapping"
+            and "stream" not in d["metadata"]["name"]
+        ]
         assert len(mappings) == len(MACHINES)
         for mapping in mappings:
             machine = mapping["spec"]["prefix"].rstrip("/").split("/")[-1]
@@ -595,6 +599,49 @@ class TestGeneratorShardedTier:
                 f"gordo-ml-server-shard-{table[machine]}-shardproj:5555"
             )
             assert mapping["spec"]["service"] == expected
+
+    def test_stream_routes_per_shard_plus_merged(self):
+        """Streams are per-replica state, so each shard gets its own
+        SSE-safe Mapping (prefix carries the shard, rewrite drops it);
+        the merged read-only view routes to the watchman relay."""
+        from gordo_tpu.workflow import generate_workflow
+
+        docs = generate_workflow(self._config(), serve_shards=2)
+        streams = {
+            d["metadata"]["name"]: d for d in docs
+            if d["kind"] == "Mapping"
+            and "stream" in d["metadata"]["name"]
+        }
+        assert sorted(streams) == [
+            "gordo-mapping-shardproj-stream-merged",
+            "gordo-mapping-shardproj-stream-shard-0",
+            "gordo-mapping-shardproj-stream-shard-1",
+        ]
+        for i in range(2):
+            spec = streams[
+                f"gordo-mapping-shardproj-stream-shard-{i}"
+            ]["spec"]
+            assert spec["prefix"] == (
+                f"/gordo/v0/shardproj/shard-{i}/stream"
+            )
+            assert spec["rewrite"] == "/gordo/v0/shardproj/stream"
+            assert spec["service"] == (
+                f"gordo-ml-server-shard-{i}-shardproj:5555"
+            )
+            assert spec["timeout_ms"] == 0
+            assert spec["idle_timeout_ms"] == 86_400_000
+        merged = streams["gordo-mapping-shardproj-stream-merged"]["spec"]
+        assert merged["prefix"] == "/gordo/v0/shardproj/stream/merged"
+        assert merged["rewrite"] == "/stream"
+        assert "watchman" in merged["service"]
+        assert merged["timeout_ms"] == 0
+        # every shard Service fronts long-lived connections
+        for svc in (d for d in docs if d["kind"] == "Service"):
+            annotations = svc["metadata"]["annotations"]
+            assert (
+                "service.beta.kubernetes.io/"
+                "aws-load-balancer-connection-idle-timeout"
+            ) in annotations
 
     def test_watchman_targets_every_shard(self):
         from gordo_tpu.workflow import generate_workflow

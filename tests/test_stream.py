@@ -1,0 +1,707 @@
+"""Streaming scoring plane tests (``gordo_tpu/serve/stream.py``).
+
+Three layers, mirroring the subsystem:
+
+* unit — event ring / subscriber fan-out / SSE framing / env knobs
+  (fast lane);
+* numerical — the acceptance pin: incremental carried-state verdicts
+  byte-identical (fp32) to re-scoring the full lookback at every
+  steady-state step, for all three window modes, and ACROSS a
+  generation flip mid-stream (slow lane — fits real models);
+* integration — ingest/subscribe routes, Last-Event-ID resume,
+  threshold events, shard misroute contract, the client iterator, and
+  the watchman re-fan relay (fast lane — rides one small real build).
+"""
+
+import asyncio
+import threading
+
+import numpy as np
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+import gordo_tpu.models.factories  # noqa: F401 — register model kinds
+from gordo_tpu.anomaly.diff import DiffBasedAnomalyDetector
+from gordo_tpu.builder import build_project
+from gordo_tpu.models.estimator import (
+    AutoEncoder,
+    LSTMAutoEncoder,
+    LSTMForecast,
+)
+from gordo_tpu.ops.scalers import MinMaxScaler
+from gordo_tpu.pipeline import Pipeline
+from gordo_tpu.serve import ModelCollection, build_app
+from gordo_tpu.serve import stream as stream_mod
+from gordo_tpu.serve.scorer import CompiledScorer
+from gordo_tpu.serve.shard import ShardSpec, shard_map
+from gordo_tpu.serve.stream import (
+    EventRing,
+    MachineStream,
+    StreamHub,
+    StreamUnsupported,
+    reference_verdict,
+    sse_format,
+    warm_stream_program,
+)
+from gordo_tpu.workflow import NormalizedConfig
+
+
+# ---------------------------------------------------------------------------
+# unit: ring / subscribers / framing
+# ---------------------------------------------------------------------------
+
+
+class TestEventRing:
+    def test_monotonic_ids_and_since(self):
+        ring = EventRing(maxlen=16)
+        for i in range(5):
+            ring.append("verdict", {"machine": "m", "n": i})
+        assert ring.last_id == 5
+        events, gap = ring.since(2)
+        assert [e["id"] for e in events] == [3, 4, 5]
+        assert not gap
+
+    def test_machine_filter(self):
+        ring = EventRing(maxlen=16)
+        ring.append("verdict", {"machine": "a"})
+        ring.append("verdict", {"machine": "b"})
+        events, _ = ring.since(0, machines={"b"})
+        assert [e["data"]["machine"] for e in events] == ["b"]
+
+    def test_replay_gap_when_trimmed(self):
+        ring = EventRing(maxlen=4)
+        for i in range(10):
+            ring.append("verdict", {"n": i})
+        events, gap = ring.since(2)  # ids 3..6 were trimmed
+        assert gap
+        assert [e["id"] for e in events] == [7, 8, 9, 10]
+        # resuming from the head is never a gap
+        _, gap = ring.since(10)
+        assert not gap
+
+    def test_fresh_ring_no_gap(self):
+        ring = EventRing(maxlen=4)
+        _, gap = ring.since(0)
+        assert not gap
+
+
+class TestHubFanout:
+    def test_publish_fans_to_matching_subscribers(self):
+        hub = StreamHub()
+        all_sub = hub.subscribe()
+        only_b = hub.subscribe(["b"])
+        hub.publish("verdict", {"machine": "a"})
+        hub.publish("verdict", {"machine": "b"})
+        assert all_sub.queue.qsize() == 2
+        assert only_b.queue.qsize() == 1
+        assert only_b.queue.get_nowait()["data"]["machine"] == "b"
+        hub.unsubscribe(all_sub)
+        hub.unsubscribe(only_b)
+        assert hub.n_subscribers == 0
+
+    def test_slow_consumer_marked_dead_on_overflow(self):
+        hub = StreamHub()
+        sub = hub.subscribe(maxsize=2)
+        for i in range(4):
+            hub.publish("verdict", {"machine": "m", "n": i})
+        assert sub.dead
+        # the ring kept everything the queue could not
+        events, gap = hub.ring.since(0)
+        assert len(events) == 4 and not gap
+
+    def test_dead_subscriber_skipped(self):
+        hub = StreamHub()
+        sub = hub.subscribe()
+        sub.dead = True
+        hub.publish("verdict", {"machine": "m"})
+        assert sub.queue.qsize() == 0
+
+
+class TestSseFraming:
+    def test_frame_layout(self):
+        frame = sse_format(
+            {"id": 7, "type": "verdict", "data": {"machine": "m"}}
+        )
+        assert frame == b'id: 7\nevent: verdict\ndata: {"machine":"m"}\n\n'
+
+    def test_poll_events_returns_batch_and_cursor(self):
+        async def run():
+            hub = StreamHub()
+            hub.publish("verdict", {"machine": "m", "n": 0})
+            doc = await stream_mod.poll_events(hub, None, 0, timeout=0)
+            return doc
+
+        doc = asyncio.run(run())
+        assert doc["last-event-id"] == 1
+        assert len(doc["events"]) == 1 and not doc["replay-gap"]
+
+    def test_poll_waits_for_next_event(self):
+        async def run():
+            hub = StreamHub()
+
+            async def later():
+                await asyncio.sleep(0.05)
+                hub.publish("verdict", {"machine": "m"})
+
+            task = asyncio.ensure_future(later())
+            doc = await stream_mod.poll_events(hub, None, 0, timeout=5.0)
+            await task
+            return doc
+
+        doc = asyncio.run(run())
+        assert len(doc["events"]) == 1
+
+
+def test_env_knobs(monkeypatch):
+    monkeypatch.setenv("GORDO_STREAM_REPLAY", "128")
+    monkeypatch.setenv("GORDO_STREAM_QUEUE", "9")
+    monkeypatch.setenv("GORDO_STREAM_KEEPALIVE", "3.5")
+    monkeypatch.setenv("GORDO_STREAM_POLL_TIMEOUT", "1.5")
+    assert stream_mod.replay_ring_size() == 128
+    assert stream_mod.queue_depth() == 9
+    assert stream_mod.keepalive_seconds() == 3.5
+    assert stream_mod.poll_timeout_seconds() == 1.5
+
+
+# ---------------------------------------------------------------------------
+# numerical parity (the acceptance pin) — slow lane
+# ---------------------------------------------------------------------------
+
+
+def _fit(X, estimator, window=None):
+    det = DiffBasedAnomalyDetector(
+        base_estimator=Pipeline([MinMaxScaler(), estimator]), window=window
+    )
+    det.cross_validate(X)
+    det.fit(X)
+    return det
+
+
+def _assert_byte_equal(verdict, ref):
+    for key in ref:
+        a = np.asarray(verdict[key], np.float32)
+        b = np.asarray(ref[key], np.float32)
+        assert a.tobytes() == b.tobytes(), key
+
+
+def _stream_and_check(scorer, X_stream, check_production=True):
+    """Feed rows one at a time; every steady-state verdict must be
+    byte-identical (fp32) to the full-window program over the same
+    trailing rows."""
+    ms = MachineStream("parity", scorer)
+    h = ms.state_rows
+    n_checked = 0
+    for t in range(1, len(X_stream) + 1):
+        verdict = ms.ingest(X_stream[t - 1])
+        if t <= ms.offset:
+            assert verdict is None  # warm-up: nothing aligned yet
+            continue
+        assert verdict is not None
+        if t >= h:
+            ref = reference_verdict(scorer, X_stream[t - h : t])
+            _assert_byte_equal(verdict, ref)
+            n_checked += 1
+    assert n_checked >= 10  # the pin actually exercised steady state
+    if check_production:
+        # production path comparison: anomaly_arrays pads requests to
+        # row buckets, and XLA kernel selection varies with batch shape
+        # at the last ulp — tolerance, not bytes, is the honest contract
+        # there (byte-identity above is against the SAME-shape program)
+        out = scorer.anomaly_arrays(np.asarray(X_stream, np.float32))
+        np.testing.assert_allclose(
+            float(verdict["total-anomaly-score"]),
+            float(np.asarray(out["total-anomaly-score"])[-1]),
+            rtol=1e-4, atol=1e-6,
+        )
+    return ms
+
+
+@pytest.mark.slow
+class TestIncrementalParity:
+    @pytest.mark.parametrize(
+        "estimator,window",
+        [
+            (lambda: AutoEncoder(kind="feedforward_hourglass", epochs=3), 5),
+            (lambda: AutoEncoder(kind="feedforward_hourglass", epochs=3), None),
+            (
+                lambda: LSTMAutoEncoder(
+                    kind="lstm_hourglass", lookback_window=4, epochs=2
+                ),
+                5,
+            ),
+            (
+                lambda: LSTMForecast(
+                    kind="lstm_hourglass", lookback_window=4, epochs=2
+                ),
+                3,
+            ),
+        ],
+        ids=["ff-smoothed", "ff-unsmoothed", "lstm-ae", "lstm-forecast"],
+    )
+    def test_byte_parity_vs_full_window(self, sine_tags, estimator, window):
+        det = _fit(sine_tags[:400], estimator(), window=window)
+        scorer = CompiledScorer(det)
+        assert scorer.fused
+        _stream_and_check(scorer, sine_tags[400:440])
+
+    def test_parity_across_generation_flip(self, sine_tags):
+        """A delta hot-reload swaps the scorer mid-stream: the carried
+        ring survives (same window geometry), and the FIRST post-flip
+        verdict is already byte-identical to a full re-score under the
+        new generation's params."""
+        det_a = _fit(
+            sine_tags[:300],
+            AutoEncoder(kind="feedforward_hourglass", epochs=3),
+            window=5,
+        )
+        det_b = _fit(
+            sine_tags[100:400],
+            AutoEncoder(kind="feedforward_hourglass", epochs=4),
+            window=5,
+        )
+        scorer_a, scorer_b = CompiledScorer(det_a), CompiledScorer(det_b)
+        hub = StreamHub()
+        X = sine_tags[400:440]
+        ms = None
+        for t in range(1, len(X) + 1):
+            scorer = scorer_a if t <= 20 else scorer_b
+            ms = hub.stream_for("flip", scorer)
+            verdict = ms.ingest(X[t - 1])
+            if t < ms.state_rows:
+                continue
+            ref = reference_verdict(scorer, X[t - ms.state_rows : t])
+            _assert_byte_equal(verdict, ref)
+        assert ms.scorer is scorer_b  # the flip actually happened
+
+    def test_geometry_change_reprimes_from_mirror(self, sine_tags):
+        """A flip that CHANGES the window geometry rebuilds the ring
+        from the host mirror — verdicts immediately byte-match a full
+        re-score once enough history fits the new geometry."""
+        det_a = _fit(
+            sine_tags[:300],
+            AutoEncoder(kind="feedforward_hourglass", epochs=3),
+            window=7,
+        )
+        det_b = _fit(
+            sine_tags[:300],
+            AutoEncoder(kind="feedforward_hourglass", epochs=3),
+            window=3,
+        )
+        scorer_a, scorer_b = CompiledScorer(det_a), CompiledScorer(det_b)
+        X = sine_tags[400:430]
+        ms = MachineStream("geom", scorer_a)
+        for t in range(1, 16):
+            ms.ingest(X[t - 1])
+        ms.rebind(scorer_b)  # 7-row ring -> 3-row ring, mirror re-primes
+        for t in range(16, len(X) + 1):
+            verdict = ms.ingest(X[t - 1])
+            ref = reference_verdict(scorer_b, X[t - ms.state_rows : t])
+            _assert_byte_equal(verdict, ref)
+
+    def test_warmup_stream_program(self, sine_tags):
+        det = _fit(
+            sine_tags[:300],
+            AutoEncoder(kind="feedforward_hourglass", epochs=2),
+            window=5,
+        )
+        warmed = warm_stream_program(
+            CompiledScorer(det), sine_tags.shape[1]
+        )
+        assert [label for label, _ in warmed] == ["serve.stream_step"]
+
+    def test_unfused_model_raises_stream_unsupported(self):
+        class NotAChain:
+            chain = None
+            dtype = "float32"
+
+        with pytest.raises(StreamUnsupported):
+            MachineStream("nope", NotAChain())
+
+
+# ---------------------------------------------------------------------------
+# integration: routes, resume, shard contract, client, watchman relay
+# ---------------------------------------------------------------------------
+
+_DATASET = {
+    "type": "RandomDataset",
+    "train_start_date": "2017-12-25T06:00:00Z",
+    "train_end_date": "2017-12-27T06:00:00Z",
+}
+
+PROJECT = {
+    "machines": [
+        {"name": "stream-a", "dataset": dict(_DATASET, tags=["st-1", "st-2", "st-3"])},
+        {"name": "stream-b", "dataset": dict(_DATASET, tags=["st-4", "st-5", "st-6"])},
+    ],
+    "globals": {
+        "model": {
+            "gordo_tpu.anomaly.diff.DiffBasedAnomalyDetector": {
+                "base_estimator": {
+                    "gordo_tpu.pipeline.Pipeline": {
+                        "steps": [
+                            "gordo_tpu.ops.scalers.MinMaxScaler",
+                            {
+                                "gordo_tpu.models.estimator.AutoEncoder": {
+                                    "kind": "feedforward_hourglass",
+                                    "epochs": 2,
+                                    "batch_size": 64,
+                                }
+                            },
+                        ]
+                    }
+                }
+            }
+        }
+    },
+}
+
+MACHINES = ["stream-a", "stream-b"]
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    out = tmp_path_factory.mktemp("stream-artifacts")
+    result = build_project(
+        NormalizedConfig(PROJECT, "streamproj").machines, str(out)
+    )
+    assert not result.failed
+    return str(out)
+
+
+def _rows(n, seed=0):
+    return np.random.default_rng(seed).uniform(0, 1, size=(n, 3)).tolist()
+
+
+def _call(model_dir, fn, **app_kw):
+    async def runner():
+        collection = ModelCollection.from_directory(
+            model_dir, project="streamproj"
+        )
+        client = TestClient(TestServer(build_app(collection, **app_kw)))
+        await client.start_server()
+        try:
+            return await fn(client)
+        finally:
+            await client.close()
+
+    return asyncio.run(runner())
+
+
+class TestStreamRoutes:
+    def test_ingest_then_poll(self, model_dir):
+        async def fn(client):
+            r = await client.post(
+                "/gordo/v0/streamproj/stream/ingest",
+                json={"X": {"stream-a": _rows(6)}},
+            )
+            body = await r.json()
+            poll = await client.get(
+                "/gordo/v0/streamproj/stream",
+                params={"mode": "poll", "after": "0", "timeout": "0"},
+            )
+            return r.status, body, await poll.json()
+
+        status, body, doc = _call(model_dir, fn)
+        assert status == 200
+        assert body["accepted"] == 6
+        assert body["events"] == 6  # offset 0: every row verdicts
+        assert body["last-event-id"] == doc["last-event-id"]
+        assert [e["id"] for e in doc["events"]] == list(range(1, 7))
+        assert all(e["type"] == "verdict" for e in doc["events"])
+        ev = doc["events"][0]["data"]
+        assert ev["machine"] == "stream-a"
+        assert len(ev["tag-anomaly-scores"]) == 3
+        assert "anomaly-confidence" in ev
+
+    def test_poll_machine_filter_and_resume(self, model_dir):
+        async def fn(client):
+            await client.post(
+                "/gordo/v0/streamproj/stream/ingest",
+                json={"X": {"stream-a": _rows(3), "stream-b": _rows(3)}},
+            )
+            only_b = await client.get(
+                "/gordo/v0/streamproj/stream",
+                params={
+                    "mode": "poll", "after": "0", "timeout": "0",
+                    "machines": "stream-b",
+                },
+            )
+            doc = await only_b.json()
+            resumed = await client.get(
+                "/gordo/v0/streamproj/stream",
+                params={
+                    "mode": "poll", "timeout": "0",
+                    "after": str(doc["last-event-id"]),
+                },
+            )
+            return doc, await resumed.json()
+
+        doc, resumed = _call(model_dir, fn)
+        assert len(doc["events"]) == 3
+        assert all(
+            e["data"]["machine"] == "stream-b" for e in doc["events"]
+        )
+        # the cursor resumes cleanly: only events past it come back
+        assert all(
+            e["id"] > doc["last-event-id"] for e in resumed["events"]
+        )
+
+    def test_threshold_crossing_events(self, model_dir):
+        """Rows far outside the training range force the total score
+        over the aggregate threshold — the hub pushes the transition
+        (once), then the return transition when rows normalize."""
+
+        async def fn(client):
+            await client.post(
+                "/gordo/v0/streamproj/stream/ingest",
+                json={"machine": "stream-a", "x": _rows(4)},
+            )
+            wild = (np.ones((3, 3)) * 1e4).tolist()
+            await client.post(
+                "/gordo/v0/streamproj/stream/ingest",
+                json={"machine": "stream-a", "x": wild},
+            )
+            await client.post(
+                "/gordo/v0/streamproj/stream/ingest",
+                json={"machine": "stream-a", "x": _rows(4, seed=1)},
+            )
+            poll = await client.get(
+                "/gordo/v0/streamproj/stream",
+                params={"mode": "poll", "after": "0", "timeout": "0"},
+            )
+            return await poll.json()
+
+        doc = _call(model_dir, fn)
+        crossings = [e for e in doc["events"] if e["type"] == "threshold"]
+        assert [c["data"]["direction"] for c in crossings] == [
+            "above", "below",
+        ]
+        assert all(
+            c["data"]["threshold"] > 0 for c in crossings
+        )
+
+    def test_sse_replay_and_live_no_dup(self, model_dir):
+        """One SSE connection sees replayed + live events exactly once,
+        ids strictly increasing — the no-loss/no-dup wire contract."""
+
+        async def fn(client):
+            r = await client.post(
+                "/gordo/v0/streamproj/stream/ingest",
+                json={"machine": "stream-a", "x": _rows(5)},
+            )
+            n_before = (await r.json())["last-event-id"]
+            sse = await client.get(
+                "/gordo/v0/streamproj/stream",
+                headers={"Last-Event-ID": "0"},
+            )
+            assert sse.headers["Content-Type"].startswith(
+                "text/event-stream"
+            )
+
+            async def pump():
+                await asyncio.sleep(0.05)
+                await client.post(
+                    "/gordo/v0/streamproj/stream/ingest",
+                    json={"machine": "stream-a", "x": _rows(5, seed=2)},
+                )
+
+            task = asyncio.ensure_future(pump())
+            ids = []
+            while len(ids) < n_before + 5:
+                line = (await asyncio.wait_for(
+                    sse.content.readline(), 10
+                )).decode()
+                if line.startswith("id: "):
+                    ids.append(int(line[4:]))
+            await task
+            sse.close()
+            return n_before, ids
+
+        n_before, ids = _call(model_dir, fn)
+        assert ids == list(range(1, n_before + 6))  # no loss, no dup
+
+    def test_ingest_errors(self, model_dir):
+        async def fn(client):
+            unknown = await client.post(
+                "/gordo/v0/streamproj/stream/ingest",
+                json={"machine": "nope", "x": _rows(1)},
+            )
+            missing = await client.post(
+                "/gordo/v0/streamproj/stream/ingest", json={"z": 1}
+            )
+            bad_width = await client.post(
+                "/gordo/v0/streamproj/stream/ingest",
+                json={"machine": "stream-a", "x": [[0.1, 0.2]]},
+            )
+            bad_cursor = await client.get(
+                "/gordo/v0/streamproj/stream",
+                params={"mode": "poll", "after": "xyz"},
+            )
+            return (
+                unknown.status, missing.status, bad_width.status,
+                bad_cursor.status,
+            )
+
+        assert _call(model_dir, fn) == (404, 400, 400, 400)
+
+    def test_misrouted_machine_is_421(self, model_dir):
+        """Shard contract: streaming requests naming a foreign machine
+        421 with the owner identified, same as the path routes."""
+        table = shard_map(MACHINES, 2)
+        mine = [m for m in MACHINES if table[m] == 0][0]
+        foreign = [m for m in MACHINES if table[m] == 1][0]
+
+        async def fn():
+            coll = ModelCollection.from_directory(
+                model_dir, project="streamproj", shard=ShardSpec(0, 2)
+            )
+            client = TestClient(TestServer(build_app(coll)))
+            await client.start_server()
+            try:
+                mis = await client.post(
+                    "/gordo/v0/streamproj/stream/ingest",
+                    json={"machine": foreign, "x": _rows(1)},
+                )
+                sub = await client.get(
+                    "/gordo/v0/streamproj/stream",
+                    params={"mode": "poll", "machines": foreign,
+                            "timeout": "0"},
+                )
+                own = await client.post(
+                    "/gordo/v0/streamproj/stream/ingest",
+                    json={"machine": mine, "x": _rows(1)},
+                )
+                return mis.status, await mis.json(), sub.status, own.status
+
+            finally:
+                await client.close()
+
+        mis, body, sub, own = asyncio.run(fn())
+        assert (mis, sub, own) == (421, 421, 200)
+        assert body["shard"] == 1 and body["shard-count"] == 2
+
+    def test_stream_metrics_exported(self, model_dir):
+        async def fn(client):
+            await client.post(
+                "/gordo/v0/streamproj/stream/ingest",
+                json={"machine": "stream-a", "x": _rows(2)},
+            )
+            metrics = await client.get("/metrics")
+            return await metrics.text()
+
+        text = _call(model_dir, fn)
+        for name in (
+            "gordo_stream_subscribers",
+            "gordo_stream_events_pushed_total",
+            "gordo_stream_ingest_rows_total",
+            "gordo_stream_push_seconds",
+            "gordo_stream_dropped_total",
+        ):
+            assert name in text, name
+
+
+class TestClientStream:
+    def _serve(self, model_dir, fn):
+        """Real TCP server (the sync client drives its own loop)."""
+
+        async def runner():
+            coll = ModelCollection.from_directory(
+                model_dir, project="streamproj"
+            )
+            app_runner = web.AppRunner(build_app(coll))
+            await app_runner.setup()
+            site = web.TCPSite(app_runner, "127.0.0.1", 0)
+            await site.start()
+            port = app_runner.addresses[0][1]
+            try:
+                return await asyncio.get_running_loop().run_in_executor(
+                    None, fn, f"http://127.0.0.1:{port}"
+                )
+            finally:
+                await app_runner.cleanup()
+
+        return asyncio.run(runner())
+
+    def test_stream_iterator_with_ingest(self, model_dir):
+        from gordo_tpu.client import Client
+
+        def fn(base):
+            client = Client("streamproj", base_url=base)
+            feeder = threading.Thread(
+                target=client.stream_ingest,
+                args=({"stream-a": _rows(4), "stream-b": _rows(4)},),
+            )
+            feeder.start()
+            try:
+                events = list(
+                    client.stream(machines=["stream-a"], after=0,
+                                  max_events=4)
+                )
+            finally:
+                feeder.join()
+            return events
+
+        events = self._serve(model_dir, fn)
+        assert len(events) == 4
+        assert all(e["type"] == "verdict" for e in events)
+        assert all(e["data"]["machine"] == "stream-a" for e in events)
+        ids = [e["id"] for e in events]
+        assert ids == sorted(set(ids))  # no dup, in order
+
+
+class TestWatchmanRelay:
+    def test_relay_refans_with_origin(self, model_dir):
+        from gordo_tpu.watchman.server import Watchman, build_watchman_app
+
+        async def fn():
+            coll = ModelCollection.from_directory(
+                model_dir, project="streamproj"
+            )
+            app_runner = web.AppRunner(build_app(coll))
+            await app_runner.setup()
+            site = web.TCPSite(app_runner, "127.0.0.1", 0)
+            await site.start()
+            base = f"http://127.0.0.1:{app_runner.addresses[0][1]}"
+            watchman = Watchman(
+                "streamproj", MACHINES, [base],
+                poll_interval=3600, discover=False,
+            )
+            wm_client = TestClient(
+                TestServer(build_watchman_app(watchman))
+            )
+            await wm_client.start_server()
+            try:
+                # start the relay, give the upstream SSE a beat to attach
+                first = await wm_client.get(
+                    "/stream",
+                    params={"mode": "poll", "after": "0", "timeout": "0"},
+                )
+                assert first.status == 200
+                await asyncio.sleep(0.2)
+                async with wm_client.session.post(
+                    f"{base}/gordo/v0/streamproj/stream/ingest",
+                    json={"machine": "stream-a", "x": _rows(3)},
+                ) as r:
+                    assert r.status == 200
+                for _ in range(50):
+                    poll = await wm_client.get(
+                        "/stream",
+                        params={"mode": "poll", "after": "0",
+                                "timeout": "0.2"},
+                    )
+                    doc = await poll.json()
+                    if len(doc["events"]) >= 3:
+                        return doc
+                return doc
+            finally:
+                await wm_client.close()
+                await app_runner.cleanup()
+
+        doc = asyncio.run(fn())
+        assert len(doc["events"]) == 3
+        for ev in doc["events"]:
+            assert ev["type"] == "verdict"
+            assert ev["data"]["machine"] == "stream-a"
+            assert ev["data"]["origin-id"] >= 1  # upstream id preserved
+            assert ev["data"]["target"].startswith("http://127.0.0.1")

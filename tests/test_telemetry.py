@@ -50,6 +50,15 @@ def _golden_registry() -> metrics_mod.MetricsRegistry:
     h.observe(0.004, "/a")
     h.observe(0.05, "/a")  # exactly on a bound: le is inclusive
     h.observe(3.2, "/a")   # over the last bound: +Inf only
+    # a gordo_stream_* family pins the streaming-plane catalog rendering
+    # (per-event-type counter with the type label)
+    s = reg.counter(
+        "gordo_stream_events_pushed_total",
+        "Events pushed to stream subscribers",
+        labels=("type",),
+    )
+    s.inc(5, "verdict")
+    s.inc(1, "threshold")
     return reg
 
 

@@ -120,7 +120,7 @@ def test_generate_workflow_documents():
     assert kinds.count("Job") == 1              # ONE builder job, not 3 pods
     assert kinds.count("Deployment") == 2       # ml-server + watchman
     assert kinds.count("Service") == 2
-    assert kinds.count("Mapping") == 3          # per-machine URL contract
+    assert kinds.count("Mapping") == 4          # per-machine + stream routes
     assert kinds.count("ConfigMap") == 1        # embedded build plan
 
     job = next(d for d in docs if d["kind"] == "Job")
@@ -135,6 +135,36 @@ def test_generate_workflow_documents():
     plan_cm = next(d for d in docs if d["kind"] == "ConfigMap")
     embedded = yaml.safe_load(plan_cm["data"]["plan.yaml"])
     assert embedded["n_machines"] == 3
+
+
+def test_generate_workflow_stream_route_is_sse_safe():
+    """The streaming plane rides long-lived SSE connections: its Mapping
+    must disable Ambassador's request timeout and stretch the idle
+    timeout past the keepalive cadence, and the Services in front of the
+    server/watchman must carry the LB connection-idle annotation."""
+    docs = generate_workflow(_config())
+    stream = next(
+        d for d in docs
+        if d["kind"] == "Mapping" and "stream" in d["metadata"]["name"]
+    )
+    assert stream["spec"]["prefix"] == "/gordo/v0/genproj/stream"
+    assert stream["spec"]["timeout_ms"] == 0
+    assert stream["spec"]["idle_timeout_ms"] == 86_400_000
+    assert stream["spec"]["service"].startswith("gordo-ml-server")
+
+    # per-machine mappings keep their request timeouts — only the
+    # stream route is exempt
+    for m in (d for d in docs if d["kind"] == "Mapping"):
+        if m is not stream:
+            assert "timeout_ms" not in m["spec"]
+
+    for svc in (d for d in docs if d["kind"] == "Service"):
+        annotations = svc["metadata"]["annotations"]
+        key = (
+            "service.beta.kubernetes.io/"
+            "aws-load-balancer-connection-idle-timeout"
+        )
+        assert annotations[key] == "3600"
 
 
 def test_generate_argo_workflow_dag_per_chunk():
