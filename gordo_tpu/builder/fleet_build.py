@@ -39,9 +39,9 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
-from jax.sharding import Mesh
 
 from gordo_tpu import artifacts, serializer, telemetry
+from gordo_tpu.mesh import Mesh
 from gordo_tpu.builder.build_model import (
     assemble_metadata,
     build_model,
@@ -1315,6 +1315,10 @@ def build_project(
             # started without GORDO_SERVE_DTYPE set still warms and
             # serves what the build intended
             serve_dtype=serve_dtype(),
+            # the device mesh the fleet programs compiled over — lets
+            # the serve plane (and `gordo mesh info`) see what placement
+            # this build warmed for
+            mesh=mesh,
         )
     except Exception:  # the manifest is a hint, never a build failure
         logger.exception("warmup manifest write failed")

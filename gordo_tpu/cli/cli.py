@@ -138,6 +138,12 @@ def build(output_dir, name, model_config, data_config, metadata,
                    "builder.fleet_build.default_bucket_size).")
 @click.option("--data-parallel", default=1, show_default=True,
               help="Mesh 'data' axis size (chips per model shard).")
+@click.option("--mesh-devices", default=None, envvar="GORDO_MESH_DEVICES",
+              help="Fleet-mesh width: 'all'/'auto' (default) spreads the "
+                   "models axis over every visible device, '1' forces the "
+                   "single-device path, an integer N takes the first N "
+                   "devices. Resolved by gordo_tpu.mesh.FleetMesh; env "
+                   "equivalent GORDO_MESH_DEVICES.")
 @click.option("--data-workers", default=8, show_default=True,
               type=click.IntRange(min=1),
               help="Concurrent data-loader threads feeding the stream.")
@@ -188,7 +194,7 @@ def build(output_dir, name, model_config, data_config, metadata,
 @click.option("--replace-cache", is_flag=True)
 def build_project_cmd(machine_config, project_name, output_dir,
                       model_register_dir, max_bucket_size, data_parallel,
-                      data_workers, align_lengths, pad_lengths,
+                      mesh_devices, data_workers, align_lengths, pad_lengths,
                       machines_filter, multihost, barrier_timeout, auto_pad,
                       artifact_format, replace_cache):
     """Build EVERY machine in the project config — homogeneous machines
@@ -229,16 +235,15 @@ def build_project_cmd(machine_config, project_name, output_dir,
         return
 
     # ---- single host ----
-    import jax
+    from gordo_tpu.mesh import FleetMesh
 
-    from gordo_tpu.parallel.mesh import fleet_mesh
-
-    devices = jax.devices()
-    mesh = (
-        fleet_mesh(devices, data_parallel=data_parallel)
-        if len(devices) > 1
-        else None
-    )
+    try:
+        fleet_mesh = FleetMesh.resolve(
+            mesh_devices, data_parallel=data_parallel
+        )
+    except ValueError as exc:
+        raise click.BadParameter(str(exc), param_hint="--mesh-devices")
+    mesh = fleet_mesh.mesh
     result = build_project(
         machines,
         output_dir,
@@ -394,6 +399,11 @@ def _run_multihost_build(dist_cfg, machines, output_dir, model_register_dir,
               help="Shard stacked serving dispatches over ALL visible "
                    "devices (the 'models' mesh axis): one server process "
                    "drives a whole slice instead of one chip.")
+@click.option("--mesh-devices", default=None, envvar="GORDO_MESH_DEVICES",
+              help="Fleet-mesh width for --model-parallel: 'all'/'auto' "
+                   "(default) uses every visible device, '1' forces the "
+                   "single-device path, an integer N takes the first N "
+                   "devices. Default: $GORDO_MESH_DEVICES.")
 @click.option("--warmup/--no-warmup", default=False, show_default=True,
               help="Precompile the serving programs in the background at "
                    "startup so the first request doesn't pay jit "
@@ -413,7 +423,8 @@ def _run_multihost_build(dist_cfg, machines, output_dir, model_register_dir,
                    "disables.")
 def run_server_cmd(model_dir, host, port, project, rescan_interval,
                    coalesce_ms, coalesce_min_concurrency, coalesce_knee,
-                   model_parallel, warmup, shard, reload_watch):
+                   model_parallel, mesh_devices, warmup, shard,
+                   reload_watch):
     """Serve model(s) over the /gordo/v0/<project>/<machine>/ routes."""
     from gordo_tpu.serve.server import run_server
     from gordo_tpu.serve.shard import ShardSpec
@@ -430,6 +441,7 @@ def run_server_cmd(model_dir, host, port, project, rescan_interval,
         coalesce_min_concurrency=coalesce_min_concurrency,
         coalesce_knee_batch=coalesce_knee,
         model_parallel=model_parallel,
+        mesh_devices=mesh_devices,
         warmup=warmup,
         shard=shard or None,
         reload_watch_interval=reload_watch,
@@ -694,6 +706,83 @@ def warmup_cmd(model_dir, server_url, row_sizes, shard, timeout):
         f"server at {url} did not report ready within {timeout:.0f}s "
         f"(last state: {last_state})"
     )
+
+
+# ---------------------------------------------------------------------------
+# mesh (device placement plane)
+# ---------------------------------------------------------------------------
+
+@gordo.group("mesh")
+def mesh_group():
+    """Device placement plane: inspect the fleet mesh and bucket placement."""
+
+
+@mesh_group.command("info")
+@click.option("--mesh-devices", default=None, envvar="GORDO_MESH_DEVICES",
+              help="Fleet-mesh width: the same 'all'/'auto'/'1'/N spec "
+                   "run-server and build-project accept. Default: "
+                   "$GORDO_MESH_DEVICES, else all visible devices.")
+@click.option("--data-parallel", default=1, show_default=True,
+              help="Width of the 'data' mesh axis (build-time row "
+                   "sharding; serving uses 1).")
+@click.option("--model-dir", default=None,
+              help="Also print the per-bucket placement plan for these "
+                   "artifacts: stacked machines, padded fleet rows, and "
+                   "which model slots each device holds.")
+@click.option("--shard", default=None, envvar="GORDO_SERVE_SHARD",
+              help="--model-dir mode: 'i/N' replica shard to plan for "
+                   "(the subset a sharded replica would stack).")
+def mesh_info(mesh_devices, data_parallel, model_dir, shard):
+    """Print the resolved device mesh (JSON): devices, mesh shape, and —
+    with --model-dir — the per-bucket placement plan a server loading
+    those artifacts would use."""
+    from gordo_tpu.mesh import FleetMesh
+
+    try:
+        fm = FleetMesh.resolve(mesh_devices, data_parallel=data_parallel)
+    except ValueError as exc:
+        raise click.BadParameter(str(exc), param_hint="--mesh-devices")
+    doc = fm.describe()
+    if model_dir:
+        from gordo_tpu.serve.server import ModelCollection
+        from gordo_tpu.serve.shard import ShardSpec
+
+        shard_spec = None
+        if shard:
+            try:
+                shard_spec = ShardSpec.parse(shard)
+            except ValueError as exc:
+                raise click.BadParameter(str(exc), param_hint="--shard")
+        try:
+            collection = ModelCollection.from_directory(
+                model_dir, serve_mesh=fm.mesh, shard=shard_spec
+            )
+        except FileNotFoundError as exc:
+            raise click.ClickException(str(exc))
+        plan = []
+        for i, bucket in enumerate(collection.fleet_scorer.buckets):
+            shards = (
+                bucket.mesh.shape["models"] if bucket.mesh is not None else 1
+            )
+            entry = {
+                "bucket": i,
+                "machines": len(bucket.names),
+                "fleet-rows-padded": bucket.m_pad,
+                "model-shards": shards,
+                "sharded": bucket.mesh is not None,
+            }
+            if bucket.mesh is not None:
+                per = bucket.m_pad // shards
+                # devices grid is (models, data); every device in models
+                # row j holds the same model-slot range
+                entry["per-device-slots"] = {
+                    str(d.id): [j * per, (j + 1) * per]
+                    for j in range(shards)
+                    for d in bucket.mesh.devices[j].reshape(-1)
+                }
+            plan.append(entry)
+        doc["buckets"] = plan
+    click.echo(json.dumps(doc, indent=1))
 
 
 # ---------------------------------------------------------------------------

@@ -109,7 +109,7 @@ def _sharding_token(leaf: Any) -> Any:
     mesh sharding distinguishes executables — numpy inputs, shape
     structs, and uncommitted single-device arrays all lower to the same
     program, so they share a token (None)."""
-    from jax.sharding import NamedSharding
+    from gordo_tpu.mesh import NamedSharding
 
     sharding = getattr(leaf, "sharding", None)
     return sharding if isinstance(sharding, NamedSharding) else None

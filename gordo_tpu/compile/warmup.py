@@ -58,6 +58,7 @@ def write_warmup_manifest(
     row_buckets: Optional[Sequence[int]] = None,
     live_machines: Optional[set] = None,
     serve_dtype: Optional[str] = None,
+    mesh=None,
 ) -> Optional[str]:
     """Write (merge) this build's warmup manifest shard file.
 
@@ -72,6 +73,12 @@ def write_warmup_manifest(
     recorded doc-level so the serve plane warms, and defaults to serving,
     the same precision; a rewrite (latest build) wins over merged rows'
     older dtype.
+
+    ``mesh``: the device mesh this build's fleet programs compiled over
+    (a ``jax.sharding.Mesh``, or ``None`` for single-device) — recorded
+    doc-level as ``{"device_count", "shape"}`` so the serve plane can see
+    what placement the build warmed for.  v2 manifests without the key
+    (older builds) read back as ``mesh=None``.
 
     ``live_machines``: when given, kept rows PRUNE to it — machines no
     longer present in the build output drop out of their rows, and rows
@@ -117,6 +124,11 @@ def write_warmup_manifest(
         ),
         "programs": kept + list(entries),
     }
+    if mesh is not None:
+        doc["mesh"] = {
+            "device_count": int(mesh.devices.size),
+            "shape": {str(k): int(v) for k, v in mesh.shape.items()},
+        }
     os.makedirs(os.path.dirname(path), exist_ok=True)
     tmp = f"{path}.tmp.{os.getpid()}"
     try:
@@ -149,6 +161,7 @@ def load_warmup_manifest(path: str) -> Optional[Dict[str, Any]]:
     row_buckets: set = set()
     programs: List[Dict[str, Any]] = []
     dtypes: set = set()
+    meshes: List[Optional[Dict[str, Any]]] = []
     for name in sorted(os.listdir(directory)):
         if not name.endswith(".json"):
             continue
@@ -161,6 +174,7 @@ def load_warmup_manifest(path: str) -> Optional[Dict[str, Any]]:
         row_buckets.update(int(r) for r in doc.get("row_buckets", ()))
         programs.extend(doc.get("programs", ()))
         dtypes.add(str(doc.get("dtype", "float32")))
+        meshes.append(doc.get("mesh"))
     if not programs and not row_buckets:
         return None
     dtype: Optional[str] = None
@@ -171,10 +185,16 @@ def load_warmup_manifest(path: str) -> Optional[Dict[str, Any]]:
             "warmup manifest shards disagree on serving dtype (%s); "
             "ignoring the manifest dtype", sorted(dtypes),
         )
+    # placement plane: shards of one build agree on the mesh; mixed or
+    # absent (pre-r22) manifests read back as None and the serve plane
+    # resolves its own mesh as before
+    distinct = {json.dumps(m, sort_keys=True) for m in meshes}
+    mesh = meshes[0] if len(distinct) == 1 else None
     return {
         "dtype": dtype,
         "row_buckets": sorted(row_buckets) or list(DEFAULT_ROW_BUCKETS),
         "programs": programs,
+        "mesh": mesh,
     }
 
 
@@ -254,6 +274,14 @@ def warmup_collection(
     # the serving precision actually warmed (bucket program prefixes carry
     # it; a bf16 manifest/collection warms bf16 executables, never fp32)
     stats["dtype"] = getattr(fleet, "dtype", "float32")
+    # the placement the warmed executables were compiled for: sharded
+    # buckets AOT-compile with NamedSharding-annotated shape structs, so
+    # a mesh-N warmup lands mesh-N executables, never single-device ones
+    serve_mesh = getattr(collection, "serve_mesh", None)
+    stats["model_shards"] = (
+        int(serve_mesh.shape.get("models", 1)) if serve_mesh is not None
+        else 1
+    )
 
     for bucket in fleet.buckets:
         ok = True

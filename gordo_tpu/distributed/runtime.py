@@ -248,7 +248,7 @@ class DistributedRuntime:
     def global_mesh(self, data_parallel: int = 1):
         """The canonical mesh over ALL processes' devices (``"models"``
         axis spans hosts)."""
-        from gordo_tpu.parallel.mesh import global_fleet_mesh
+        from gordo_tpu.mesh import global_fleet_mesh
 
         return global_fleet_mesh(data_parallel=data_parallel)
 
@@ -260,7 +260,7 @@ class DistributedRuntime:
         single-host CLI's behaviour."""
         import jax
 
-        from gordo_tpu.parallel.mesh import fleet_mesh
+        from gordo_tpu.mesh import fleet_mesh
 
         local = jax.local_devices()
         if len(local) <= 1:
@@ -276,7 +276,8 @@ class DistributedRuntime:
         program across the process boundary."""
         import jax
         import numpy as np
-        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from gordo_tpu.mesh import model_sharding
 
         mesh = self.global_mesh()  # data axis = 1: models axis is every device
         flat = list(mesh.devices.reshape(-1))
@@ -287,7 +288,7 @@ class DistributedRuntime:
             i for i, d in enumerate(flat)
             if d.process_index == jax.process_index()
         ]
-        sharding = NamedSharding(mesh, P("models"))
+        sharding = model_sharding(mesh)
         x = jax.make_array_from_process_local_data(
             sharding, full[mine], full.shape
         )

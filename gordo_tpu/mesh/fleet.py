@@ -1,0 +1,231 @@
+"""Fleet device-mesh construction — the placement plane's mesh owner.
+
+The framework's canonical mesh has two axes:
+
+- ``"models"`` — the fleet axis: independent machines' stacked models.  This
+  replaces the reference's Argo pod-per-machine fan-out; collectives never
+  cross it (pure map), so XLA partitions it for free.
+- ``"data"`` — batch/row axis for data-parallel fitting of a single larger
+  model (all-reduce of grads rides ICI).
+
+:class:`FleetMesh` wraps device discovery + mesh construction behind ONE
+resolution path (``GORDO_MESH_DEVICES`` env var / ``--mesh-devices`` CLI
+flag / auto = every visible device), with the single-device case degenerating
+to ``mesh=None`` — exactly the sentinel every existing call site already
+treats as "today's one-device path", so 1 device is bit-for-bit unchanged.
+
+On a v5e-64 slice the default is all 64 chips on ``"models"``; a single-chip
+dev box gets ``mesh=None`` and every program still compiles identically.
+This module (and its sibling :mod:`gordo_tpu.mesh.placement`) is the only
+place in the stack allowed to construct ``jax.sharding`` objects — enforced
+by ``scripts/lint.py`` the same way the compile plane owns ``jax.jit``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from gordo_tpu.telemetry import metrics as telemetry
+
+MODEL_AXIS = "models"
+DATA_AXIS = "data"
+
+#: mesh width resolution env var: unset/"auto"/"all" = every visible
+#: device, "1" = force the single-device degenerate path, an integer N =
+#: the first N devices (error if fewer are visible)
+ENV_MESH_DEVICES = "GORDO_MESH_DEVICES"
+
+_MESH_DEVICES_GAUGE = telemetry.gauge(
+    "gordo_mesh_devices",
+    "Device count of the most recently constructed fleet mesh",
+)
+
+
+def fleet_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    data_parallel: int = 1,
+) -> Mesh:
+    """Build the canonical ``("models", "data")`` mesh over ``devices``.
+
+    ``data_parallel`` chips are grouped per model-shard; the rest of the
+    devices spread the fleet axis.
+    """
+    devices = list(devices) if devices is not None else jax.devices()
+    n = len(devices)
+    if n % data_parallel != 0:
+        raise ValueError(
+            f"data_parallel={data_parallel} does not divide device count {n}"
+        )
+    grid = np.asarray(devices).reshape(n // data_parallel, data_parallel)
+    return Mesh(grid, (MODEL_AXIS, DATA_AXIS))
+
+
+def global_fleet_mesh(data_parallel: int = 1) -> Mesh:
+    """The canonical mesh over EVERY process's devices — the multi-host
+    form of :func:`fleet_mesh` (``gordo_tpu.distributed.runtime``).
+
+    Devices order by ``(process_index, device id)`` so each host's local
+    devices are CONTIGUOUS along the ``"models"`` axis: a host feeds its
+    shard of a stacked fleet array with one contiguous
+    ``make_array_from_process_local_data`` block, and a per-host slice of
+    the machine list maps onto a per-host slice of the mesh.  Requires a
+    uniform local device count (true of any TPU slice and of the
+    simulated launcher); raises otherwise rather than building a mesh
+    whose process boundaries fall mid-row.
+    """
+    import collections
+
+    devices = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    per_proc = collections.Counter(d.process_index for d in devices)
+    counts = set(per_proc.values())
+    if len(counts) > 1:
+        raise ValueError(
+            "global_fleet_mesh needs a uniform local device count per "
+            f"process, got {dict(per_proc)}"
+        )
+    if data_parallel > 1 and min(counts) % data_parallel != 0:
+        # keep every ("models" row x "data" group) within one host: the
+        # data axis carries grad all-reduces, which should ride ICI, not
+        # straddle the host boundary onto DCN
+        raise ValueError(
+            f"data_parallel={data_parallel} does not divide the per-process "
+            f"device count {min(counts)}; a data group must not span hosts"
+        )
+    return fleet_mesh(devices, data_parallel=data_parallel)
+
+
+def pad_to_multiple(m: int, k: int) -> int:
+    """Smallest multiple of ``k`` that is >= ``m``."""
+    return -(-m // k) * k
+
+
+def _parse_device_spec(spec: Any) -> Optional[int]:
+    """``GORDO_MESH_DEVICES`` / ``--mesh-devices`` value → requested device
+    count, or ``None`` for "all visible devices"."""
+    if spec is None:
+        return None
+    s = str(spec).strip().lower()
+    if s in ("", "auto", "all"):
+        return None
+    try:
+        n = int(s)
+    except ValueError:
+        raise ValueError(
+            f"mesh device spec {spec!r} is not an integer, 'all', or 'auto' "
+            f"(set via --mesh-devices or ${ENV_MESH_DEVICES})"
+        ) from None
+    if n < 1:
+        raise ValueError(f"mesh device spec must be >= 1, got {n}")
+    return n
+
+
+class FleetMesh:
+    """The resolved placement decision: which devices, what mesh.
+
+    ``.mesh`` is the canonical ``("models", "data")`` :class:`Mesh` when
+    more than one device participates, and ``None`` for the single-device
+    degenerate case — the exact sentinel the fleet fit/scoring call sites
+    already branch on, so one device stays today's path bit-for-bit.
+    """
+
+    __slots__ = ("devices", "data_parallel", "mesh")
+
+    def __init__(
+        self,
+        devices: Optional[Sequence[jax.Device]] = None,
+        data_parallel: int = 1,
+    ):
+        self.devices = tuple(devices) if devices is not None else tuple(
+            jax.devices()
+        )
+        self.data_parallel = int(data_parallel)
+        self.mesh: Optional[Mesh] = (
+            fleet_mesh(self.devices, data_parallel=self.data_parallel)
+            if len(self.devices) > 1
+            else None
+        )
+        _MESH_DEVICES_GAUGE.set(float(len(self.devices)))
+
+    # -- resolution ---------------------------------------------------------
+    @classmethod
+    def from_devices(
+        cls,
+        devices: Optional[Sequence[jax.Device]] = None,
+        data_parallel: int = 1,
+    ) -> "FleetMesh":
+        return cls(devices, data_parallel=data_parallel)
+
+    @classmethod
+    def resolve(
+        cls,
+        spec: Any = None,
+        data_parallel: int = 1,
+        devices: Optional[Sequence[jax.Device]] = None,
+    ) -> "FleetMesh":
+        """Resolve the mesh width: explicit ``spec`` (the ``--mesh-devices``
+        flag) wins, else ``$GORDO_MESH_DEVICES``, else all visible devices.
+        """
+        if spec is None:
+            spec = os.environ.get(ENV_MESH_DEVICES)
+        want = _parse_device_spec(spec)
+        pool = list(devices) if devices is not None else jax.devices()
+        if want is not None:
+            if want > len(pool):
+                raise ValueError(
+                    f"mesh device spec asks for {want} devices but only "
+                    f"{len(pool)} are visible (XLA_FLAGS="
+                    f"--xla_force_host_platform_device_count=N forces more "
+                    "on CPU)"
+                )
+            pool = pool[:want]
+        return cls(pool, data_parallel=data_parallel)
+
+    # -- introspection ------------------------------------------------------
+    @property
+    def n_devices(self) -> int:
+        return len(self.devices)
+
+    @property
+    def n_model_shards(self) -> int:
+        """Width of the fleet axis: how many ways a stacked bucket splits."""
+        if self.mesh is None:
+            return 1
+        return self.mesh.shape[MODEL_AXIS]
+
+    @property
+    def is_sharded(self) -> bool:
+        return self.mesh is not None
+
+    def pad(self, m: int) -> int:
+        """Fleet size ``m`` padded up to the mesh divisibility requirement
+        (the pad-to-mesh policy: ragged buckets round up, never truncate).
+        """
+        return pad_to_multiple(m, self.n_model_shards)
+
+    def describe(self) -> Dict[str, Any]:
+        """JSON-able summary for ``gordo mesh info`` and the project index."""
+        return {
+            "n_devices": self.n_devices,
+            "devices": [str(d) for d in self.devices],
+            "platform": self.devices[0].platform if self.devices else None,
+            "mesh_shape": (
+                {k: int(v) for k, v in self.mesh.shape.items()}
+                if self.mesh is not None
+                else None
+            ),
+            "model_shards": self.n_model_shards,
+            "data_parallel": self.data_parallel,
+            "sharded": self.is_sharded,
+        }
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return (
+            f"FleetMesh(n_devices={self.n_devices}, "
+            f"model_shards={self.n_model_shards}, "
+            f"data_parallel={self.data_parallel})"
+        )

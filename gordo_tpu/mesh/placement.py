@@ -1,0 +1,140 @@
+"""Sharding construction + the one counted ``device_put`` seam.
+
+Everything that ships host data to devices outside the artifact plane's
+``to_device`` goes through :func:`place` here, and every
+``jax.sharding.NamedSharding`` in the stack is built by this module —
+``scripts/lint.py`` rejects raw ``jax.device_put`` / ``jax.sharding.*``
+construction anywhere else, the same single-owner contract the shard
+function and the compile plane already enforce.
+
+Placement layout for a fleet-stacked program: every operand with a leading
+``models`` axis (params, opt-state, X/y/w stacks, thresholds) shards that
+axis over the mesh fleet axis and replicates the rest; scalars replicate.
+The shardings are donation-compatible — a donated input buffer and its
+matching output share a layout, so the compile plane's ``donate_argnums``
+keep working unchanged on the sharded path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gordo_tpu.telemetry import metrics as telemetry
+
+from .fleet import DATA_AXIS, MODEL_AXIS, FleetMesh
+
+_PLACEMENTS = telemetry.counter(
+    "gordo_fleet_placements_total",
+    "Fleet-stack device placements by kind (sharded mesh vs single device)",
+    labels=("kind",),
+)
+_DEVICE_TRANSFERS = telemetry.counter(
+    "gordo_mesh_device_transfers_total",
+    "Array leaves transferred to each device by the placement plane",
+    labels=("device",),
+)
+
+
+def model_sharding(mesh: Mesh, extra_dims: int = 0) -> NamedSharding:
+    """Sharding placing a leading ``models`` axis over the mesh fleet axis."""
+    return NamedSharding(mesh, P(MODEL_AXIS, *([None] * extra_dims)))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def data_sharding(mesh: Mesh, extra_dims: int = 0) -> NamedSharding:
+    """Sharding placing a leading rows axis over the mesh ``data`` axis
+    (the data-parallel single-model fit path)."""
+    return NamedSharding(mesh, P(DATA_AXIS, *([None] * extra_dims)))
+
+
+class PlacementSpec:
+    """The sharding plan for one fleet-stacked program's operands.
+
+    Wraps an optional mesh (a raw :class:`Mesh`, a :class:`FleetMesh`, or
+    ``None``) and answers "what sharding does THIS operand get".  With no
+    mesh every method returns ``None`` — which ``jax.device_put`` and the
+    compile plane both read as "default single-device placement", keeping
+    the degenerate case today's code path exactly.
+    """
+
+    __slots__ = ("mesh",)
+
+    def __init__(self, mesh: Optional[Any] = None):
+        if isinstance(mesh, FleetMesh):
+            mesh = mesh.mesh
+        self.mesh: Optional[Mesh] = mesh
+
+    @property
+    def is_sharded(self) -> bool:
+        return self.mesh is not None
+
+    def stacked(self, extra_dims: int = 0) -> Optional[NamedSharding]:
+        """Leading ``models`` axis sharded, ``extra_dims`` trailing axes
+        replicated (params/opt-state/X/y/w stacks)."""
+        if self.mesh is None:
+            return None
+        return model_sharding(self.mesh, extra_dims)
+
+    def replicated(self) -> Optional[NamedSharding]:
+        """Fully replicated (scalars, shared configuration arrays)."""
+        if self.mesh is None:
+            return None
+        return replicated_sharding(self.mesh)
+
+    def leaf(self, a: Any) -> Optional[NamedSharding]:
+        """The stacked sharding matched to ``a``'s rank (leading axis is
+        the fleet axis, everything after replicates)."""
+        if self.mesh is None:
+            return None
+        ndim = getattr(a, "ndim", 0)
+        return model_sharding(self.mesh, max(int(ndim) - 1, 0))
+
+    def tree(self, host_tree: Any) -> Optional[Any]:
+        """Per-leaf stacked shardings for a whole pytree (params stacks)."""
+        if self.mesh is None:
+            return None
+        return jax.tree_util.tree_map(self.leaf, host_tree)
+
+
+def _iter_sharding_devices(sharding: Any) -> Iterable[jax.Device]:
+    """Union of devices named by ``sharding`` (a Sharding or a pytree of
+    them); empty for ``None`` / non-sharding leaves."""
+    seen = set()
+    for s in jax.tree_util.tree_leaves(sharding):
+        device_set = getattr(s, "device_set", None)
+        if device_set:
+            for d in device_set:
+                if d not in seen:
+                    seen.add(d)
+                    yield d
+
+
+def place(tree: Any, sharding: Any = None) -> Any:
+    """THE device transfer of the placement plane.
+
+    ``sharding`` may be ``None`` (default single-device placement — the
+    degenerate path), one sharding broadcast over the tree, or a pytree of
+    shardings matching ``tree``.  Counts one placement per call
+    (``gordo_fleet_placements_total{kind}``) and the per-device leaf
+    transfers (``gordo_mesh_device_transfers_total{device}``).
+    """
+    if sharding is None:
+        out = jax.device_put(tree)
+    else:
+        out = jax.device_put(tree, sharding)
+    if telemetry.enabled():
+        devices = list(_iter_sharding_devices(sharding))
+        sharded = len(devices) > 1
+        _PLACEMENTS.inc(1.0, "sharded" if sharded else "single")
+        n_leaves = len(jax.tree_util.tree_leaves(tree))
+        if not devices:
+            devices = jax.devices()[:1]
+        for d in devices:
+            _DEVICE_TRANSFERS.inc(float(n_leaves), str(d.id))
+    return out

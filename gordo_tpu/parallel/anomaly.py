@@ -47,7 +47,6 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh
 
 from gordo_tpu import compile as compile_plane
 from gordo_tpu.anomaly.diff import SMOOTHING_WINDOW, DiffBasedAnomalyDetector
@@ -58,8 +57,13 @@ from gordo_tpu.ops.scalers import (
     RobustScaler,
     StandardScaler,
 )
+from gordo_tpu.mesh import (
+    MODEL_AXIS,
+    Mesh,
+    model_sharding,
+    pad_to_multiple,
+)
 from gordo_tpu.parallel import fleet as fleet_mod
-from gordo_tpu.parallel.mesh import MODEL_AXIS, model_sharding, pad_to_multiple
 from gordo_tpu.pipeline import Pipeline
 from gordo_tpu.registry import lookup_factory
 from gordo_tpu.train.cv import build_splitter

@@ -30,13 +30,15 @@ from typing import Any, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh
 
-from gordo_tpu.parallel.mesh import (
-    DATA_AXIS,
+from gordo_tpu.mesh import (
     MODEL_AXIS,
+    Mesh,
+    data_sharding,
     model_sharding,
     pad_to_multiple,
+    place,
+    replicated_sharding,
 )
 from gordo_tpu.train.fit import TrainConfig, batch_geometry, make_fit_fn
 
@@ -274,10 +276,7 @@ def fleet_stage(
         seeds = _pad_models(seeds, m_pad)
 
     ms = model_sharding(mesh) if mesh is not None else None
-    if ms is not None:
-        Xd, yd, wd = jax.device_put((Xp, yp, wp), ms)
-    else:
-        Xd, yd, wd = jax.device_put((Xp, yp, wp))
+    Xd, yd, wd = place((Xp, yp, wp), ms)
 
     init_keys, fit_keys = fleet_keys(seeds)
     if params is None:
@@ -288,7 +287,7 @@ def fleet_stage(
         # caller's pytree must stay usable afterwards
         params = jax.tree.map(jnp.array, params)
     if ms is not None:
-        params = jax.device_put(params, ms)
+        params = place(params, ms)
 
     return StagedFleetFit(
         params=params, X=Xd, y=yd, w=wd, fit_keys=fit_keys,
@@ -428,8 +427,6 @@ def fit_data_parallel(
     replacement for the `tf.distribute` capability the reference never used
     (SURVEY.md §6.8).
     """
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     X = np.asarray(X, np.float32)
     y = np.asarray(y, np.float32)
@@ -446,8 +443,8 @@ def fit_data_parallel(
 
     from gordo_tpu import compile as compile_plane
 
-    rows = NamedSharding(mesh, P(DATA_AXIS))
-    repl = NamedSharding(mesh, P())
+    rows = data_sharding(mesh)
+    repl = replicated_sharding(mesh)
     fitted = compile_plane.cached_closure(
         ("fleet.data_parallel_fit", module, cfg, steps, bs, mesh),
         lambda: compile_plane.jit(

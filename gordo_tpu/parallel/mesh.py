@@ -1,91 +1,35 @@
-"""Device-mesh construction and sharding helpers.
+"""Compatibility shim — mesh construction moved to :mod:`gordo_tpu.mesh`.
 
-The framework's canonical mesh has two axes:
-
-- ``"models"`` — the fleet axis: independent machines' stacked models.  This
-  replaces the reference's Argo pod-per-machine fan-out; collectives never
-  cross it (pure map), so XLA partitions it for free.
-- ``"data"`` — batch/row axis for data-parallel fitting of a single larger
-  model (all-reduce of grads rides ICI).
-
-On a v5e-64 slice the default is all 64 chips on ``"models"``; a single-chip
-dev box gets a 1x1 mesh and every program still compiles identically.
+The placement plane (``gordo_tpu/mesh/``) is now the one owner of device
+meshes and shardings; this module re-exports the original surface so
+existing imports (``gordo_tpu.parallel.mesh.fleet_mesh`` etc.) keep
+working.  New code should import from ``gordo_tpu.mesh`` directly.
 """
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from gordo_tpu.mesh import (  # noqa: F401  (re-export surface)
+    DATA_AXIS,
+    MODEL_AXIS,
+    Mesh,
+    NamedSharding,
+    PartitionSpec,
+    fleet_mesh,
+    global_fleet_mesh,
+    model_sharding,
+    pad_to_multiple,
+    replicated_sharding,
+)
 
-import jax
-import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
-MODEL_AXIS = "models"
-DATA_AXIS = "data"
-
-
-def fleet_mesh(
-    devices: Optional[Sequence[jax.Device]] = None,
-    data_parallel: int = 1,
-) -> Mesh:
-    """Build the canonical ``("models", "data")`` mesh over ``devices``.
-
-    ``data_parallel`` chips are grouped per model-shard; the rest of the
-    devices spread the fleet axis.
-    """
-    devices = list(devices) if devices is not None else jax.devices()
-    n = len(devices)
-    if n % data_parallel != 0:
-        raise ValueError(
-            f"data_parallel={data_parallel} does not divide device count {n}"
-        )
-    grid = np.asarray(devices).reshape(n // data_parallel, data_parallel)
-    return Mesh(grid, (MODEL_AXIS, DATA_AXIS))
-
-
-def global_fleet_mesh(data_parallel: int = 1) -> Mesh:
-    """The canonical mesh over EVERY process's devices — the multi-host
-    form of :func:`fleet_mesh` (``gordo_tpu.distributed.runtime``).
-
-    Devices order by ``(process_index, device id)`` so each host's local
-    devices are CONTIGUOUS along the ``"models"`` axis: a host feeds its
-    shard of a stacked fleet array with one contiguous
-    ``make_array_from_process_local_data`` block, and a per-host slice of
-    the machine list maps onto a per-host slice of the mesh.  Requires a
-    uniform local device count (true of any TPU slice and of the
-    simulated launcher); raises otherwise rather than building a mesh
-    whose process boundaries fall mid-row.
-    """
-    import collections
-
-    devices = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
-    per_proc = collections.Counter(d.process_index for d in devices)
-    counts = set(per_proc.values())
-    if len(counts) > 1:
-        raise ValueError(
-            "global_fleet_mesh needs a uniform local device count per "
-            f"process, got {dict(per_proc)}"
-        )
-    if data_parallel > 1 and min(counts) % data_parallel != 0:
-        # keep every ("models" row x "data" group) within one host: the
-        # data axis carries grad all-reduces, which should ride ICI, not
-        # straddle the host boundary onto DCN
-        raise ValueError(
-            f"data_parallel={data_parallel} does not divide the per-process "
-            f"device count {min(counts)}; a data group must not span hosts"
-        )
-    return fleet_mesh(devices, data_parallel=data_parallel)
-
-
-def model_sharding(mesh: Mesh, extra_dims: int = 0) -> NamedSharding:
-    """Sharding placing a leading ``models`` axis over the mesh fleet axis."""
-    return NamedSharding(mesh, P(MODEL_AXIS, *([None] * extra_dims)))
-
-
-def replicated_sharding(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, P())
-
-
-def pad_to_multiple(m: int, k: int) -> int:
-    """Smallest multiple of ``k`` that is >= ``m``."""
-    return -(-m // k) * k
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "Mesh",
+    "NamedSharding",
+    "PartitionSpec",
+    "fleet_mesh",
+    "global_fleet_mesh",
+    "model_sharding",
+    "pad_to_multiple",
+    "replicated_sharding",
+]

@@ -198,7 +198,7 @@ class _Bucket:
             c["detector"]["feature_thresholds"] is not None for c in chains
         )
 
-        from gordo_tpu.parallel.mesh import MODEL_AXIS
+        from gordo_tpu.mesh import MODEL_AXIS
 
         self.mesh = (
             mesh
@@ -311,10 +311,11 @@ class _Bucket:
             self.agg_thresholds_np = None
             self.agg_thresholds = None
         if mesh is not None:
-            from gordo_tpu.parallel.mesh import (
+            from gordo_tpu.mesh import (
                 MODEL_AXIS,
                 model_sharding,
                 pad_to_multiple,
+                place,
             )
 
             shards = mesh.shape[MODEL_AXIS]
@@ -327,9 +328,7 @@ class _Bucket:
                         a = np.concatenate(
                             [a, np.repeat(a[:1], pad, axis=0)]
                         )
-                    return jax.device_put(
-                        a, model_sharding(mesh, a.ndim - 1)
-                    )
+                    return place(a, model_sharding(mesh, a.ndim - 1))
 
                 return jax.tree.map(one, tree)
 
@@ -340,9 +339,7 @@ class _Bucket:
                 agg = np.asarray(self.agg_thresholds_np)
                 if pad:
                     agg = np.concatenate([agg, np.repeat(agg[:1], pad)])
-                self.agg_thresholds = jax.device_put(
-                    agg, model_sharding(mesh, 0)
-                )
+                self.agg_thresholds = place(agg, model_sharding(mesh, 0))
             self._x_sharding = model_sharding(self.mesh, 2)
 
     def _init_prestacked(self, prestacked: Dict[str, Any]) -> None:
@@ -363,10 +360,11 @@ class _Bucket:
         )
         pack_hosts = prestacked["packs"]
         if self.mesh is not None:
-            from gordo_tpu.parallel.mesh import (
+            from gordo_tpu.mesh import (
                 MODEL_AXIS,
                 model_sharding,
                 pad_to_multiple,
+                place,
             )
 
             shards = self.mesh.shape[MODEL_AXIS]
@@ -401,7 +399,7 @@ class _Bucket:
                 agg = self.agg_thresholds_np
                 if pad:
                     agg = np.concatenate([agg, np.repeat(agg[:1], pad)])
-                self.agg_thresholds = jax.device_put(
+                self.agg_thresholds = place(
                     jnp.asarray(agg), model_sharding(self.mesh, 0)
                 )
             return
@@ -471,10 +469,10 @@ class _Bucket:
             # pure map with no collectives); going via jnp.asarray first
             # would stage the full array on device 0 and pay a second
             # device-to-device scatter
+            from gordo_tpu.mesh import place
+
             _H2D.inc(1.0, "serve.fleet")
-            X = jax.device_put(
-                np.asarray(X_stack, np.float32), self._x_sharding
-            )
+            X = place(np.asarray(X_stack, np.float32), self._x_sharding)
         else:
             _H2D.inc(1.0, "serve.fleet")
             X = jnp.asarray(X_stack, jnp.float32)
@@ -1174,7 +1172,7 @@ class FleetScorer:
                 # only each shard's machines
                 m_eff = bucket.m_pad
                 if bucket.mesh is not None:
-                    from gordo_tpu.parallel.mesh import MODEL_AXIS
+                    from gordo_tpu.mesh import MODEL_AXIS
 
                     m_eff = -(-m_eff // bucket.mesh.shape[MODEL_AXIS])
             chunks = [wanted]

@@ -1679,6 +1679,17 @@ async def scores_aggregate(request: web.Request) -> web.Response:
     return await _respond(request, envelope)
 
 
+def _mesh_doc(mesh) -> dict:
+    """Wire-shape description of a serve mesh (``None`` = single-device)."""
+    if mesh is None:
+        return {"device-count": 1, "shape": None, "sharded": False}
+    return {
+        "device-count": int(mesh.devices.size),
+        "shape": {str(k): int(v) for k, v in mesh.shape.items()},
+        "sharded": True,
+    }
+
+
 async def project_index(request: web.Request) -> web.Response:
     collection: ModelCollection = request.app[COLLECTION_KEY]
     store = collection.pack_store
@@ -1697,6 +1708,9 @@ async def project_index(request: web.Request) -> web.Response:
         # change-detector stamp for the artifacts backing this replica;
         # watchman republishes it per target (routing-topology surface)
         "fleet-generation": collection.generation,
+        # placement plane: the device mesh this replica's stacked fleet
+        # dispatches shard over (no mesh = single-device serving)
+        "mesh": _mesh_doc(collection.serve_mesh),
     }
     if collection.quarantined:
         doc["quarantined"] = sorted(collection.quarantined)
@@ -2031,6 +2045,7 @@ def run_server(
     coalesce_min_concurrency: int = 2,
     coalesce_knee_batch: int = 0,
     model_parallel: bool = False,
+    mesh_devices: Optional[str] = None,
     warmup: bool = False,
     shard: Optional[str] = None,
     health_rollup_interval: Optional[float] = None,
@@ -2040,7 +2055,9 @@ def run_server(
 
     ``model_parallel=True`` shards every stacked serving dispatch over all
     visible devices (the ``"models"`` mesh axis) — one server process
-    driving a whole slice instead of one chip.
+    driving a whole slice instead of one chip. ``mesh_devices`` narrows
+    the fleet-mesh width (``"all"``/``"auto"``/``"1"``/an integer N;
+    default is the ``GORDO_MESH_DEVICES`` env var, else all devices).
 
     ``shard``: ``"i/N"`` (or a :class:`~gordo_tpu.serve.shard.ShardSpec`)
     — serve only shard i of an N-replica fleet-sharded tier; default is
@@ -2076,22 +2093,21 @@ def run_server(
         shard = ShardSpec.parse(shard)
     serve_mesh = None
     if model_parallel:
-        import jax
+        from gordo_tpu.mesh import FleetMesh
 
-        from gordo_tpu.parallel.mesh import fleet_mesh
-
-        devices = jax.devices()
-        if len(devices) > 1:
-            serve_mesh = fleet_mesh(devices)
+        fm = FleetMesh.resolve(mesh_devices)  # honors GORDO_MESH_DEVICES
+        if fm.is_sharded:
+            serve_mesh = fm.mesh
             logger.info(
-                "Model-parallel serving over %d devices", len(devices)
+                "Model-parallel serving over %d devices", fm.n_devices
             )
         else:
             logger.warning(
                 "--model-parallel requested but only 1 device is visible "
                 "(%s) — serving single-device; check the TPU runtime/"
-                "device visibility if a slice was expected",
-                devices[0].platform,
+                "device visibility (or GORDO_MESH_DEVICES) if a slice "
+                "was expected",
+                fm.devices[0].platform,
             )
     # crash-safe writer audit before loading: sweep orphaned tmp files a
     # killed build left behind and re-publish a stale GENERATION sidecar;

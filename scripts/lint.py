@@ -122,6 +122,15 @@ ARTIFACTS_DIR = os.path.join("gordo_tpu", "artifacts")
 ARTIFACTS_COPY_CALLS = {"stack", "concatenate", "vstack", "hstack"}
 ARTIFACTS_DEVICE_PUT_FN = "to_device"
 
+#: placement single-owner contract (r22): device meshes and shardings are
+#: owned by gordo_tpu/mesh/ — raw ``jax.device_put`` and any
+#: ``jax.sharding.*`` construction/import outside the placement plane
+#: (and the artifact plane's ``to_device``, policed separately above)
+#: bypasses the counted ``place()`` seam and the mesh the compile plane
+#: keys executables on.  Tests are allowlisted (they probe placement
+#: directly); ``# noqa`` opts a line out, as elsewhere.
+MESH_DIR = os.path.join("gordo_tpu", "mesh")
+
 #: serve-path shard contract: the machine→replica partition has exactly
 #: ONE implementation (gordo_tpu/serve/shard.py, wrapping the builder's
 #: partition_machines).  Server, client, watchman and the workflow
@@ -397,6 +406,71 @@ def _artifacts_pack_findings(
                      " — the one counted whole-pack transfer is the only "
                      "allowed call site")
                 )
+    return findings
+
+
+def _mesh_findings(path: str, tree: ast.AST, noqa_lines: set) -> List[Finding]:
+    """Flag raw ``jax.device_put`` calls and ``jax.sharding`` imports /
+    attribute chains outside the placement plane (``gordo_tpu/mesh/``):
+    device placement has ONE owner — go through ``gordo_tpu.mesh.place``
+    for transfers and ``model_sharding``/``PlacementSpec`` (or the
+    re-exported ``Mesh``/``NamedSharding`` types) for shardings.  The
+    artifact plane's ``to_device`` is the other transfer seam and is
+    policed by ``_artifacts_pack_findings``."""
+    norm = os.path.normpath(path)
+    parts = norm.split(os.sep)
+    if "tests" in parts or os.path.basename(norm).startswith("test_"):
+        return []
+    if MESH_DIR in norm:
+        return []
+    in_artifacts = ARTIFACTS_DIR in norm
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        bad = None
+        if (
+            isinstance(node, ast.Attribute)
+            and node.attr == "device_put"
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "jax"
+            and not in_artifacts  # to_device scoping handled separately
+        ):
+            bad = (
+                "raw jax.device_put outside gordo_tpu/mesh/ — route the "
+                "transfer through gordo_tpu.mesh.place (counted, "
+                "sharding-aware) or artifacts.to_device (pack loads)"
+            )
+        elif isinstance(node, ast.Import) and any(
+            a.name == "jax.sharding" or a.name.startswith("jax.sharding.")
+            for a in node.names
+        ):
+            bad = (
+                "import of jax.sharding outside gordo_tpu/mesh/ — the "
+                "placement plane owns mesh/sharding construction; import "
+                "Mesh/NamedSharding/model_sharding from gordo_tpu.mesh"
+            )
+        elif isinstance(node, ast.ImportFrom) and node.module and (
+            node.module == "jax.sharding"
+            or node.module.startswith("jax.sharding.")
+        ):
+            bad = (
+                "import from jax.sharding outside gordo_tpu/mesh/ — the "
+                "placement plane owns mesh/sharding construction; import "
+                "Mesh/NamedSharding/model_sharding from gordo_tpu.mesh"
+            )
+        elif (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Attribute)
+            and node.value.attr == "sharding"
+            and isinstance(node.value.value, ast.Name)
+            and node.value.value.id == "jax"
+        ):
+            bad = (
+                f"jax.sharding.{node.attr} outside gordo_tpu/mesh/ — the "
+                "placement plane owns mesh/sharding construction; use the "
+                "gordo_tpu.mesh re-exports"
+            )
+        if bad and getattr(node, "lineno", 0) not in noqa_lines:
+            findings.append((path, node.lineno, bad))
     return findings
 
 
@@ -748,6 +822,7 @@ def lint_file(path: str) -> List[Finding]:
     findings.extend(_bulk_frame_findings(path, tree, noqa_lines))
     findings.extend(_shard_findings(path, tree, noqa_lines))
     findings.extend(_jit_findings(path, tree, noqa_lines))
+    findings.extend(_mesh_findings(path, tree, noqa_lines))
     findings.extend(_artifact_path_findings(path, tree, noqa_lines))
     findings.extend(_artifacts_pack_findings(path, tree, noqa_lines))
     findings.extend(_refresh_import_findings(path, tree, noqa_lines))

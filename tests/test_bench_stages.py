@@ -165,12 +165,14 @@ def test_serving_wire_stage_smoke(monkeypatch):
 
 @pytest.mark.slow
 def test_multi_device_stage_smoke(monkeypatch):
-    """The CI slow-lane multi_device smoke (ISSUE 16 satellite): forked
+    """The CI slow-lane multi_device smoke (r22 placement plane): forked
     children over a tiny {1,2} device sweep must report the per-count
-    throughput curve, the speedup map, and the honesty note when the
-    host has fewer cores than forced devices. The >=1.6x-at-2 gate
-    field exists but is only meaningful on real multi-core/multi-chip
-    hosts."""
+    throughput curve, the speedup map, fp32 BYTE PARITY of the sharded
+    fit + scoring vs the 1-device child, per-device placement attested
+    via addressable_shards, exactly one sharded executable per bucket,
+    and the honesty note when the host has fewer cores than forced
+    devices. The >=1.6x-at-2 gate field exists but is only meaningful
+    on real multi-core/multi-chip hosts."""
     monkeypatch.setenv("BENCH_MULTI_DEVICE_COUNTS", "1,2")
     monkeypatch.setenv("BENCH_MULTI_DEVICE_MACHINES", "8")
     monkeypatch.setenv("BENCH_MULTI_DEVICE_ROWS", "256")
@@ -186,6 +188,15 @@ def test_multi_device_stage_smoke(monkeypatch):
         rel=5e-3,
     )
     assert "multi_device_ge_1_6x_at_2_ok" in out
+    # the r22 correctness gates
+    assert out["multi_device_byte_parity"] == {"2": True}
+    assert out["multi_device_byte_parity_ok"] is True
+    assert out["multi_device_placement_ok"] is True
+    att = out["multi_device_placement"]["2"]
+    assert att["fit"]["n_shards"] == 2
+    assert att["fit"]["device_ids"] == [0, 1]
+    assert att["score"]["n_shards"] == 2
+    assert att["one_executable_per_bucket_ok"] is True
 
 
 @pytest.mark.slow

@@ -1,0 +1,313 @@
+"""Placement plane (ISSUE 18): FleetMesh resolution, PlacementSpec
+shardings, degenerate single-device behavior, pad-to-mesh policy, the
+sharded warmup-manifest round-trip, and sharded-vs-single fleet-fit byte
+parity.  Fast lane: conftest forces 8 virtual CPU devices, so sharded
+cases run on device subsets without a fresh process."""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from gordo_tpu.mesh import (
+    DATA_AXIS,
+    ENV_MESH_DEVICES,
+    MODEL_AXIS,
+    FleetMesh,
+    PlacementSpec,
+    fleet_mesh,
+    model_sharding,
+    pad_to_multiple,
+    place,
+    replicated_sharding,
+)
+
+
+class TestResolution:
+    def test_default_takes_every_visible_device(self):
+        fm = FleetMesh.resolve()
+        assert fm.n_devices == len(jax.devices())
+        assert fm.is_sharded and fm.mesh is not None
+        assert fm.mesh.shape[MODEL_AXIS] == fm.n_devices
+        assert fm.mesh.shape[DATA_AXIS] == 1
+
+    def test_spec_narrows_to_first_n(self):
+        fm = FleetMesh.resolve("2")
+        assert fm.devices == tuple(jax.devices()[:2])
+        assert fm.n_model_shards == 2
+
+    def test_one_is_the_degenerate_sentinel(self):
+        fm = FleetMesh.resolve("1")
+        assert fm.mesh is None
+        assert not fm.is_sharded
+        assert fm.n_model_shards == 1
+        assert fm.pad(7) == 7  # no mesh, no pad
+
+    def test_env_var_is_the_default_spec(self, monkeypatch):
+        monkeypatch.setenv(ENV_MESH_DEVICES, "2")
+        assert FleetMesh.resolve().n_devices == 2
+        # an explicit spec wins over the env
+        assert FleetMesh.resolve("1").n_devices == 1
+
+    def test_auto_and_all_mean_every_device(self, monkeypatch):
+        monkeypatch.delenv(ENV_MESH_DEVICES, raising=False)
+        for spec in ("auto", "all", "", None):
+            assert FleetMesh.resolve(spec).n_devices == len(jax.devices())
+
+    def test_over_ask_raises_with_visibility_hint(self):
+        with pytest.raises(ValueError, match="only .* visible"):
+            FleetMesh.resolve(str(len(jax.devices()) + 1))
+
+    def test_garbage_specs_raise(self):
+        for bad in ("banana", "0", "-2", "1.5"):
+            with pytest.raises(ValueError):
+                FleetMesh.resolve(bad)
+
+    def test_data_parallel_must_divide(self):
+        with pytest.raises(ValueError, match="does not divide"):
+            FleetMesh.from_devices(jax.devices()[:3], data_parallel=2)
+
+    def test_describe_is_json_able(self):
+        doc = FleetMesh.resolve("4").describe()
+        json.dumps(doc)
+        assert doc["model_shards"] == 4 and doc["sharded"]
+        assert doc["mesh_shape"] == {MODEL_AXIS: 4, DATA_AXIS: 1}
+        assert FleetMesh.resolve("1").describe()["mesh_shape"] is None
+
+
+class TestPadToMesh:
+    def test_pad_to_multiple(self):
+        assert pad_to_multiple(5, 4) == 8
+        assert pad_to_multiple(8, 4) == 8
+        assert pad_to_multiple(1, 4) == 4
+
+    def test_ragged_fleet_pads_up_never_truncates(self):
+        fm = FleetMesh.resolve("4")
+        for m, want in ((1, 4), (3, 4), (4, 4), (5, 8), (9, 12)):
+            assert fm.pad(m) == want
+
+    def test_device_count_exceeding_fleet_still_places(self):
+        """8 devices, 3 models: the stack pads to 8 and every device holds
+        exactly one (possibly padded) model slot."""
+        fm = FleetMesh.resolve()  # all 8 virtual devices
+        m_pad = fm.pad(3)
+        assert m_pad == 8
+        arr = place(
+            np.arange(m_pad * 2, dtype=np.float32).reshape(m_pad, 2),
+            model_sharding(fm.mesh, 1),
+        )
+        shards = arr.addressable_shards
+        assert len(shards) == 8
+        assert sorted(s.device.id for s in shards) == list(range(8))
+        for s in shards:
+            assert s.data.shape == (1, 2)
+
+
+class TestPlacement:
+    def test_sharded_placement_attests_addressable_shards(self):
+        fm = FleetMesh.resolve("4")
+        x = np.arange(4 * 3, dtype=np.float32).reshape(4, 3)
+        arr = place(x, model_sharding(fm.mesh, 1))
+        assert len(arr.addressable_shards) == 4
+        assert np.array_equal(np.asarray(arr), x)
+
+    def test_replicated_placement_copies_everywhere(self):
+        fm = FleetMesh.resolve("2")
+        arr = place(np.float32(3.5), replicated_sharding(fm.mesh))
+        assert len(arr.addressable_shards) == 2
+        assert all(
+            float(s.data) == 3.5 for s in arr.addressable_shards
+        )
+
+    def test_placement_spec_degenerates_to_none(self):
+        spec = PlacementSpec(FleetMesh.resolve("1"))
+        assert not spec.is_sharded
+        assert spec.stacked() is None and spec.replicated() is None
+        assert spec.tree({"a": np.zeros(3)}) is None
+        assert spec.leaf(np.zeros((2, 2))) is None
+
+    def test_placement_spec_tree_shards_leading_axis(self):
+        fm = FleetMesh.resolve("2")
+        spec = PlacementSpec(fm)
+        tree = {"w": np.zeros((4, 3)), "b": np.zeros((4,))}
+        sh = spec.tree(tree)
+        for leaf in sh.values():
+            assert leaf.spec[0] == MODEL_AXIS
+        placed = place(tree, sh)
+        assert len(placed["w"].addressable_shards) == 2
+
+    def test_placement_counters(self):
+        from gordo_tpu.telemetry import metrics as telemetry
+
+        reg_c = telemetry.REGISTRY.get("gordo_fleet_placements_total")
+        before_sharded = reg_c.value("sharded")
+        before_single = reg_c.value("single")
+        fm = FleetMesh.resolve("2")
+        place(np.zeros((2, 2), np.float32), model_sharding(fm.mesh, 1))
+        place(np.zeros((2, 2), np.float32))
+        assert reg_c.value("sharded") == before_sharded + 1
+        assert reg_c.value("single") == before_single + 1
+
+    def test_mesh_devices_gauge_tracks_last_mesh(self):
+        from gordo_tpu.telemetry import metrics as telemetry
+
+        g = telemetry.REGISTRY.get("gordo_mesh_devices")
+        FleetMesh.resolve("4")
+        assert g.value() == 4.0
+        FleetMesh.resolve("1")
+        assert g.value() == 1.0
+
+
+class TestWarmupManifestRoundTrip:
+    def _entry(self, name):
+        return [{"signature": f"sig-{name}", "machines": [name],
+                 "n_machines": 1, "n_features": 2, "n_outputs": 2,
+                 "lookback": 1}]
+
+    def test_sharded_mesh_round_trips(self, tmp_path):
+        from gordo_tpu.compile import (
+            load_warmup_manifest,
+            write_warmup_manifest,
+        )
+
+        out = str(tmp_path)
+        mesh = fleet_mesh(jax.devices()[:2])
+        write_warmup_manifest(out, self._entry("m1"), mesh=mesh)
+        manifest = load_warmup_manifest(out)
+        assert manifest["mesh"] == {
+            "device_count": 2,
+            "shape": {MODEL_AXIS: 2, DATA_AXIS: 1},
+        }
+
+    def test_pre_r22_manifest_reads_mesh_none(self, tmp_path):
+        from gordo_tpu.compile import (
+            load_warmup_manifest,
+            write_warmup_manifest,
+        )
+
+        out = str(tmp_path)
+        write_warmup_manifest(out, self._entry("m1"))
+        assert load_warmup_manifest(out)["mesh"] is None
+
+    def test_disagreeing_shards_read_mesh_none(self, tmp_path):
+        from gordo_tpu.compile import (
+            load_warmup_manifest,
+            write_warmup_manifest,
+        )
+
+        out = str(tmp_path)
+        write_warmup_manifest(
+            out, self._entry("m1"), shard=(0, 2),
+            mesh=fleet_mesh(jax.devices()[:2]),
+        )
+        write_warmup_manifest(
+            out, self._entry("m2"), shard=(1, 2),
+            mesh=fleet_mesh(jax.devices()[:4]),
+        )
+        assert load_warmup_manifest(out)["mesh"] is None
+
+
+class TestShardedFitParity:
+    """The acceptance bar: fp32 fleet fit over a real device mesh is
+    byte-identical to the single-device path whenever each device holds
+    at least TWO model slots.  A per-device block of exactly 1 model makes
+    XLA:CPU collapse the unit leading axis and re-associate the per-model
+    matmul FMAs — deterministic, but ~1 ULP off; pinned separately below
+    so a silent change in either behavior is caught."""
+
+    M, N, F = 8, 40, 4
+
+    @pytest.fixture(scope="class")
+    def module(self):
+        from gordo_tpu.registry import lookup_factory
+
+        return lookup_factory("AutoEncoder", "feedforward_hourglass")(
+            n_features=self.F, n_features_out=self.F
+        )
+
+    @pytest.fixture()
+    def data(self):
+        rng = np.random.default_rng(7)
+        X = rng.standard_normal((self.M, self.N, self.F)).astype(np.float32)
+        w = np.ones((self.M, self.N), np.float32)
+        return X, w
+
+    def _fit(self, module, data, mesh):
+        from gordo_tpu.train.fit import TrainConfig
+        from gordo_tpu.parallel.fleet import fleet_fit
+
+        X, w = data
+        cfg = TrainConfig(epochs=2, batch_size=32)
+        seeds = np.arange(self.M, dtype=np.uint32)
+        return fleet_fit(module, X, X, w, cfg, seeds=seeds, mesh=mesh)
+
+    @pytest.mark.parametrize("n_dev", [2, 4])
+    def test_fit_bytes_match_single_device(self, module, data, n_dev):
+        single = self._fit(module, data, None)
+        sharded = self._fit(
+            module, data, FleetMesh.resolve(str(n_dev)).mesh
+        )
+        assert np.array_equal(single.history, sharded.history)
+        for a, b in zip(
+            single.unstack_params(), sharded.unstack_params()
+        ):
+            for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+                na, nb = np.asarray(la), np.asarray(lb)
+                assert na.dtype == nb.dtype == np.float32
+                assert na.tobytes() == nb.tobytes()
+
+    def test_block_of_one_is_deterministic_and_ulp_close(
+        self, module, data
+    ):
+        """8 models over 8 devices: one model per device.  Not byte-equal
+        to single-device (XLA:CPU unit-dim codegen), but run-to-run
+        deterministic and within float32 ULP noise of it."""
+        mesh = FleetMesh.resolve("8").mesh
+        single = self._fit(module, data, None)
+        a = self._fit(module, data, mesh)
+        b = self._fit(module, data, mesh)
+        assert np.array_equal(a.history, b.history)
+        np.testing.assert_allclose(
+            single.history, a.history, rtol=1e-5, atol=1e-6
+        )
+
+
+class TestMeshCLIAndIndexDoc:
+    def test_mesh_info_cli_reports_devices_and_shape(self):
+        from click.testing import CliRunner
+
+        from gordo_tpu.cli.cli import gordo
+
+        res = CliRunner().invoke(
+            gordo, ["mesh", "info", "--mesh-devices", "2"]
+        )
+        assert res.exit_code == 0, res.output
+        doc = json.loads(res.output)
+        assert doc["n_devices"] == 2
+        assert doc["mesh_shape"] == {MODEL_AXIS: 2, DATA_AXIS: 1}
+
+    def test_mesh_info_cli_rejects_over_ask(self):
+        from click.testing import CliRunner
+
+        from gordo_tpu.cli.cli import gordo
+
+        res = CliRunner().invoke(
+            gordo,
+            ["mesh", "info", "--mesh-devices", str(len(jax.devices()) + 1)],
+        )
+        assert res.exit_code != 0
+        assert "visible" in res.output
+
+    def test_project_index_mesh_doc(self):
+        from gordo_tpu.serve.server import _mesh_doc
+
+        assert _mesh_doc(None) == {
+            "device-count": 1, "shape": None, "sharded": False,
+        }
+        doc = _mesh_doc(fleet_mesh(jax.devices()[:2]))
+        assert doc == {
+            "device-count": 2,
+            "shape": {MODEL_AXIS: 2, DATA_AXIS: 1},
+            "sharded": True,
+        }

@@ -59,6 +59,28 @@ def _golden_registry() -> metrics_mod.MetricsRegistry:
     )
     s.inc(5, "verdict")
     s.inc(1, "threshold")
+    # r22 placement-plane families pin the mesh catalog rendering: the
+    # mesh-width gauge, the per-kind placement counter, and the
+    # per-device transfer counter (labels mirror gordo_tpu/mesh/)
+    reg.gauge(
+        "gordo_mesh_devices",
+        "Device count of the most recently constructed fleet mesh",
+    ).set(4)
+    p = reg.counter(
+        "gordo_fleet_placements_total",
+        "Fleet-stack device placements by kind (sharded mesh vs single "
+        "device)",
+        labels=("kind",),
+    )
+    p.inc(2, "sharded")
+    p.inc(1, "single")
+    t = reg.counter(
+        "gordo_mesh_device_transfers_total",
+        "Array leaves transferred to each device by the placement plane",
+        labels=("device",),
+    )
+    t.inc(6, "0")
+    t.inc(6, "1")
     return reg
 
 
