@@ -447,6 +447,170 @@ def bench_build_pipeline(mesh, out: dict) -> None:
     )
 
 
+def bench_build_throughput(mesh, out: dict) -> None:
+    """r23 acceptance: the dispatch/collect split of the build plane.
+
+    Same paired-alternating-best-of protocol as ``bench_build_pipeline``
+    (one warmup run per mode lands the compiles, then 4 alternating
+    serial/async rounds, per-mode BEST standing — min() rejects one-sided
+    timeshare contamination).  Two additions:
+
+    - per-stage attribution from the pipeline stage histogram deltas
+      around the best async round — dispatch (host-side launch), device
+      (dispatch→collect wall), fetch (blocking D2H), assemble
+      (per-machine detector unpacking), write, load — plus the new
+      ``gordo_build_device_idle_seconds`` occupancy counter, so the
+      remaining between-chunk gaps are measurable instead of inferred;
+    - an in-bench byte-parity attestation: one serial and one async
+      build of the same machines must produce identical artifacts
+      (params + metadata modulo wall-clock fields) and identical
+      registry keys, the same contract tests/test_dispatch_collect.py
+      pins.
+
+    1-core honesty: on this timeshared single-core container the
+    dispatch-behind-collect overlap cannot show as wall-clock win (host
+    assembly and "device" compute share the one core, so overlapped work
+    serializes anyway) — the CPU-measurable win here is the vectorized
+    collect side (pickle-clone assembly, partial D2H, ``tolist`` metadata)
+    and the speedup number reads as its lower bound; the overlap itself
+    is banked for the TPU tunnel where device compute is genuinely
+    asynchronous to the host.
+    """
+    from gordo_tpu import telemetry
+    from gordo_tpu.builder.fleet_build import build_project
+
+    def stage_sums() -> dict:
+        metric = telemetry.REGISTRY.snapshot()["metrics"].get(
+            "gordo_build_pipeline_stage_seconds"
+        ) or {}
+        sums = {}
+        for key, v in metric.get("series", {}).items():
+            sums[json.loads(key)[0]] = float(v["sum"])
+        return sums
+
+    def timed(machines, bucket, pipe, label, out_dir=None, reg=None):
+        keep = out_dir is not None
+        out_dir = out_dir or tempfile.mkdtemp(
+            prefix=f"gordo-bench-bt-{label}-"
+        )
+        before = stage_sums()
+        t0 = time.perf_counter()
+        result = build_project(
+            machines, out_dir, mesh=mesh, max_bucket_size=bucket,
+            pipeline=pipe, model_register_dir=reg,
+        )
+        dt = time.perf_counter() - t0
+        after = stage_sums()
+        if not keep:
+            shutil.rmtree(out_dir, ignore_errors=True)
+        if result.failed or len(result.artifacts) != len(machines):
+            raise RuntimeError(
+                f"build_throughput {label}@{len(machines)}: "
+                f"{len(result.failed)} failed"
+            )
+        stages = {
+            k: round(after.get(k, 0.0) - before.get(k, 0.0), 4)
+            for k in sorted(set(after) | set(before))
+        }
+        return dt, stages, result.device_idle_seconds
+
+    n_machines, bucket = 512, 64
+    machines = make_machines(n_machines, prefix=f"bench-bt{n_machines}")
+    for pipe in (False, True):  # warmup: land the compiles
+        timed(machines, bucket, pipe, "warmup")
+    times = {"serial": [], "async": []}
+    stage_attr = {"serial": None, "async": None}
+    idle = {"serial": None, "async": None}
+    for rnd in range(4):
+        for label, pipe in (("serial", False), ("async", True)):
+            dt, stages, idle_s = timed(machines, bucket, pipe, label)
+            if not times[label] or dt < min(times[label]):
+                stage_attr[label] = stages  # attribution of the BEST round
+                idle[label] = round(idle_s, 4)
+            times[label].append(dt)
+            log(f"build_throughput {label}@{n_machines} round {rnd}: "
+                f"{dt:.2f}s ({n_machines / dt * 3600.0:.0f} models/h)")
+    best = {label: min(ts) for label, ts in times.items()}
+    for label, t in best.items():
+        out[f"build_throughput_{label}_models_per_hour_{n_machines}"] = (
+            round(n_machines / t * 3600.0, 1)
+        )
+    out[f"build_throughput_speedup_{n_machines}"] = round(
+        best["serial"] / best["async"], 4
+    )
+    for label in ("serial", "async"):
+        out[f"build_throughput_stage_seconds_{label}"] = stage_attr[label]
+        out[f"build_throughput_device_idle_seconds_{label}"] = idle[label]
+    out["build_throughput_note"] = (
+        "1-core timeshare: overlap cannot move wall-clock here (host and "
+        "'device' share the core); speedup is the vectorized-collect "
+        "lower bound, dispatch overlap banked for TPU"
+    )
+
+    # -- in-bench byte-parity attestation (async vs serial, v2 packs) ------
+    import pickle
+
+    from gordo_tpu import artifacts as artifacts_mod
+    from gordo_tpu.utils import disk_registry
+
+    def scrub(obj, seen=None):
+        # mirror tests/test_build_pipeline.py::_scrub_timings: zero
+        # wall-clock fields through the pickled graph
+        if seen is None:
+            seen = set()
+        if id(obj) in seen:
+            return
+        seen.add(id(obj))
+        if isinstance(obj, dict):
+            for key, zero in (("fleet_seconds", 0.0), ("bucket_size", 0)):
+                if key in obj:
+                    obj[key] = zero
+            for v in obj.values():
+                scrub(v, seen)
+            return
+        if isinstance(obj, (list, tuple)):
+            for v in obj:
+                scrub(v, seen)
+            return
+        d = getattr(obj, "__dict__", None)
+        if d is None:
+            return
+        if "fit_seconds_" in d:
+            d["fit_seconds_"] = 0.0
+        for v in d.values():
+            scrub(v, seen)
+
+    parity_machines = make_machines(32, prefix="bench-btp")
+    dirs = {}
+    for label, pipe in (("serial", False), ("async", True)):
+        d = tempfile.mkdtemp(prefix=f"gordo-bench-btpar-{label}-")
+        r = tempfile.mkdtemp(prefix=f"gordo-bench-btreg-{label}-")
+        timed(parity_machines, 8, pipe, f"parity-{label}", out_dir=d, reg=r)
+        dirs[label] = (d, r)
+    try:
+        sa = artifacts_mod.open_store(dirs["serial"][0])
+        sb = artifacts_mod.open_store(dirs["async"][0])
+        parity_ok = sorted(sa.names()) == sorted(sb.names())
+        for m in parity_machines:
+            ma, mb = sa.load_model(m.name), sb.load_model(m.name)
+            scrub(ma)
+            scrub(mb)
+            parity_ok = parity_ok and (
+                pickle.dumps(ma) == pickle.dumps(mb)
+            )
+        parity_ok = parity_ok and sorted(
+            disk_registry.list_keys(dirs["serial"][1])
+        ) == sorted(disk_registry.list_keys(dirs["async"][1]))
+    finally:
+        for d, r in dirs.values():
+            shutil.rmtree(d, ignore_errors=True)
+            shutil.rmtree(r, ignore_errors=True)
+    out["build_throughput_parity_ok"] = bool(parity_ok)
+    log(f"build_throughput parity (async vs serial, v2): {parity_ok}")
+    if not parity_ok:
+        raise RuntimeError("async-vs-serial artifact parity FAILED")
+
+
 def bench_lstm_build(mesh, out: dict) -> None:
     """BASELINE config 2: lstm_hourglass on 50-tag windowed sequences —
     the scenario where scan latency and MXU under-utilization bite."""
@@ -3856,7 +4020,8 @@ def run_stage_bounded(
 
 #: stage registry order == run order == metric priority (a mid-run wedge
 #: costs the least important remaining numbers)
-STAGES = ("build", "build_pipeline", "artifact_io", "hot_reload",
+STAGES = ("build", "build_pipeline", "build_throughput",
+          "artifact_io", "hot_reload",
           "serving", "serving_precision", "serving_sharded",
           "serving_wire", "serving_openloop", "telemetry_overhead",
           "health_overhead", "cold_start", "multi_device", "refresh",
@@ -3976,6 +4141,10 @@ def main(argv: "list[str] | None" = None) -> None:
         "build": (build_stage, lambda: remaining() * 0.6),
         "build_pipeline": (
             lambda: bench_build_pipeline(mesh, out),
+            lambda: remaining() * 0.6,
+        ),
+        "build_throughput": (
+            lambda: bench_build_throughput(mesh, out),
             lambda: remaining() * 0.6,
         ),
         "artifact_io": (

@@ -97,6 +97,16 @@ _PIPE_CHUNKS_TOTAL = telemetry.counter(
     "Fleet chunks driven to completion, by execution path",
     labels=("path",),  # pipelined | serial
 )
+_PIPE_DEVICE_IDLE_SECONDS = telemetry.counter(
+    "gordo_build_device_idle_seconds",
+    "Seconds the drive loop held NO dispatched fleet program in flight "
+    "(host-side lower bound on device idle: load/fetch/assemble/write "
+    "time the pipeline failed to hide behind device compute)",
+)
+_PIPE_DEVICE_INFLIGHT = telemetry.gauge(
+    "gordo_build_device_inflight",
+    "Fleet chunk programs dispatched but not yet collected",
+)
 
 
 # -- incremental refresh knobs (docs/configuration.md) ----------------------
@@ -364,6 +374,10 @@ class ProjectBuildResult:
         #: whether the pipelined drive loop ran (False: serial path via
         #: the GORDO_BUILD_PIPELINE=off kill switch or pipeline=False)
         self.pipelined: bool = False
+        #: seconds the drive loop held no dispatched fleet program in
+        #: flight (see ``_DeviceOccupancy``) — the pipeline's
+        #: dispatch-overlap headroom, measurable even on CPU
+        self.device_idle_seconds: float = 0.0
         #: artifact format this build wrote ("v1" per-machine dirs, "v2"
         #: memory-mapped bucket packs — see gordo_tpu/artifacts/)
         self.artifact_format: str = "v1"
@@ -387,6 +401,7 @@ class ProjectBuildResult:
             "build_seconds": self.seconds,
             "peak_loaded_machines": self.peak_loaded,
             "pipelined": self.pipelined,
+            "device_idle_seconds": self.device_idle_seconds,
             "artifact_format": self.artifact_format,
         }
         if self.warm_started or self.warm_fallbacks:
@@ -421,6 +436,57 @@ class _LoadTracker:
     def release(self, n: int = 1) -> None:
         with self._lock:
             self.current -= n
+
+
+class _DeviceOccupancy:
+    """Tracks dispatched-but-uncollected chunk programs on the drive
+    thread and accumulates the windows where NO program was in flight —
+    the ``gordo_build_device_idle_seconds`` series.
+
+    This is a host-side LOWER bound on true device idle (the device may
+    also starve while a dispatched program's inputs stream — only device
+    profiling sees that), but it is exactly the quantity the
+    dispatch/collect split exists to shrink: serial drives count every
+    between-chunk fetch/assemble/write gap as idle; the pipelined drive
+    should count little beyond the first chunk's load."""
+
+    def __init__(self):
+        self._inflight = 0
+        self._idle_since: Optional[float] = time.time()
+        self.idle_seconds = 0.0
+
+    def dispatched(self) -> None:
+        if self._inflight == 0 and self._idle_since is not None:
+            dt = time.time() - self._idle_since
+            self.idle_seconds += dt
+            _PIPE_DEVICE_IDLE_SECONDS.inc(dt)
+            self._idle_since = None
+        self._inflight += 1
+        _PIPE_DEVICE_INFLIGHT.set(float(self._inflight))
+
+    def collected(self) -> None:
+        self._inflight -= 1
+        if self._inflight == 0:
+            self._idle_since = time.time()
+        _PIPE_DEVICE_INFLIGHT.set(float(self._inflight))
+
+
+@dataclasses.dataclass
+class _PendingChunk:
+    """One chunk between its dispatch and its collect: the in-flight
+    :class:`~gordo_tpu.parallel.anomaly.PendingFleetBuild` plus everything
+    the finish side needs (the loaded arrays stay referenced here so a
+    collect-time failure can still demote to singles and free them).
+    Warm-start chunks build synchronously inside dispatch (the parity
+    gate must read results before deciding on in-chunk cold rebuilds), so
+    they arrive with ``detectors`` already set and ``pending`` None."""
+
+    key: Tuple
+    ok_chunk: List[Machine]
+    loaded: Dict[str, Tuple]
+    t0: float
+    pending: Optional[Any] = None
+    detectors: Optional[List[Any]] = None
 
 
 def _as_machine(m: Union[Machine, Dict[str, Any]]) -> Machine:
@@ -592,6 +658,7 @@ def build_project(
     artifact_fmt = artifacts.resolve_format(artifact_format)
     result.artifact_format = artifact_fmt
     tracker = _LoadTracker()
+    occupancy = _DeviceOccupancy()
     warm_resolved: Dict[str, Tuple[Any, Optional[float]]] = {}
     #: per-machine warm-start attestation, stamped into artifact metadata
     warm_info_by_name: Dict[str, Dict[str, Any]] = {}
@@ -842,6 +909,19 @@ def build_project(
                 warm_params=warm_list,
             )
 
+    def _dispatch_chunk(spec_obj, cv, ok_chunk, loaded):
+        """Async half of _train_chunk (cold builds): launch the chunk's
+        fleet program(s), return the pending handle without blocking.
+        Part of the lint-enforced D2H-free dispatch window."""
+        builder = FleetDiffBuilder(
+            spec_obj, cv=cv, mesh=mesh, pad_lengths=pad_lengths
+        )
+        with profiling.trace(f"fleet_dispatch/{len(ok_chunk)}"):
+            return builder.dispatch(
+                [loaded[m.name][0] for m in ok_chunk],
+                [loaded[m.name][1] for m in ok_chunk],
+            )
+
     def _build_chunk_warm(spec, cv, ok_chunk, loaded):
         """One chunk in warm_start mode: machines with resolved previous
         params run the warm program under a reduced-epoch config, the
@@ -910,10 +990,16 @@ def build_project(
                 dets[m.name] = det
         return [dets[m.name] for m in ok_chunk]
 
-    def _run_bucket(key: Tuple, chunk: List[Machine], loaded: Dict[str, Tuple]):
-        """Width-validate + train one chunk on device.  Returns
-        ``(ok_chunk, detectors, fleet_seconds)`` or None when every
-        machine demoted (width mismatch / fleet failure)."""
+    def _dispatch_bucket(
+        key: Tuple, chunk: List[Machine], loaded: Dict[str, Tuple]
+    ) -> Optional[_PendingChunk]:
+        """Width-validate + DISPATCH one chunk's fleet program(s); returns
+        a pending record (or None when every machine demoted).  Cold
+        chunks return with device futures only — the blocking fetch lives
+        in ``_finish_bucket`` — so the caller can dispatch chunk k+1
+        before finishing chunk k.  Warm-start chunks run synchronously
+        here (see :class:`_PendingChunk`).  Lint-enforced D2H-free zone
+        alongside ``_drive_pipeline`` (scripts/lint.py)."""
         spec = specs[key]
         widths = key[1]
         # config said these widths; data disagreeing (exotic provider)
@@ -939,53 +1025,110 @@ def build_project(
             return None
         cv = ok_chunk[0].evaluation.get("cv")
         t0 = time.time()
-        try:
-            if warm_start:
+        if warm_start:
+            occupancy.dispatched()
+            try:
                 detectors = _build_chunk_warm(spec, cv, ok_chunk, loaded)
-            else:
-                detectors = _train_chunk(spec, cv, ok_chunk, loaded)
+            except Exception:
+                logger.exception(
+                    "Fleet bucket failed; falling back to singles"
+                )
+                for m in ok_chunk:
+                    _demote_to_single(
+                        m, singles, machine_keys, key_extra, demoted
+                    )
+                _free(loaded, [m.name for m in ok_chunk])
+                return None
+            finally:
+                occupancy.collected()
+            return _PendingChunk(
+                key=key, ok_chunk=ok_chunk, loaded=loaded, t0=t0,
+                detectors=detectors,
+            )
+        try:
+            pending = _dispatch_chunk(spec, cv, ok_chunk, loaded)
         except Exception:
-            logger.exception("Fleet bucket failed; falling back to singles")
+            # host-side failure (trace/compile/stacking) — async XLA
+            # failures surface at collect and demote in _finish_bucket
+            logger.exception("Fleet dispatch failed; falling back to singles")
             for m in ok_chunk:
                 _demote_to_single(
                     m, singles, machine_keys, key_extra, demoted
                 )
             _free(loaded, [m.name for m in ok_chunk])
             return None
-        fleet_seconds = time.time() - t0
+        occupancy.dispatched()
+        _PIPE_STAGE_SECONDS.observe(time.time() - t0, "dispatch")
+        return _PendingChunk(
+            key=key, ok_chunk=ok_chunk, loaded=loaded, t0=t0,
+            pending=pending,
+        )
+
+    def _finish_bucket(rec: _PendingChunk):
+        """Collect one dispatched chunk: blocking D2H fetch + per-machine
+        assembly.  An async failure from dispatch surfaces here and
+        demotes the chunk to singles, exactly like the serial path's
+        train-time failures.  Returns ``(ok_chunk, detectors,
+        fleet_seconds)`` or None."""
+        ok_chunk, loaded = rec.ok_chunk, rec.loaded
+        detectors = rec.detectors
+        if rec.pending is not None:
+            try:
+                with profiling.trace(f"fleet_collect/{len(ok_chunk)}"):
+                    detectors = rec.pending.collect()
+            except Exception:
+                logger.exception(
+                    "Fleet bucket failed; falling back to singles"
+                )
+                for m in ok_chunk:
+                    _demote_to_single(
+                        m, singles, machine_keys, key_extra, demoted
+                    )
+                _free(loaded, [m.name for m in ok_chunk])
+                return None
+            finally:
+                occupancy.collected()
+            _PIPE_STAGE_SECONDS.observe(rec.pending.fetch_seconds, "fetch")
+            _PIPE_STAGE_SECONDS.observe(
+                rec.pending.assemble_seconds, "assemble"
+            )
+        fleet_seconds = time.time() - rec.t0
         _BUILD_BUCKET_SECONDS.observe(fleet_seconds)
         _PIPE_STAGE_SECONDS.observe(fleet_seconds, "device")
         return ok_chunk, detectors, fleet_seconds
 
-    def _drive_serial(pool) -> None:
-        """The pre-pipeline drive loop (GORDO_BUILD_PIPELINE=off): loads
-        still prefetch one chunk ahead, but artifact dumps run inline on
-        the critical path after each chunk trains."""
-        next_futures = _submit(pool, chunks[0][1]) if chunks else None
-        for i, (key, chunk) in enumerate(chunks):
-            loaded = _collect(chunk, next_futures)
-            # prefetch the NEXT chunk now — it loads while this one trains
-            next_futures = (
-                _submit(pool, chunks[i + 1][1]) if i + 1 < len(chunks) else None
-            )
-            out = _run_bucket(key, chunk, loaded)
-            if out is None:
-                continue
-            ok_chunk, detectors, fleet_seconds = out
-            _record_manifest(key, ok_chunk)
-            _PIPE_CHUNKS_TOTAL.inc(1.0, "serial")
-            if artifact_fmt == "v2":
-                _write_chunk(
-                    *_chunk_payload(ok_chunk, detectors, fleet_seconds, loaded)
-                )
-                continue
-            baselines = _chunk_baselines(ok_chunk, detectors, loaded)
+    def _finish_chunk(rec: _PendingChunk, writer: Optional[_ArtifactWriter]):
+        """Finish one chunk end-to-end: collect, manifest, and hand the
+        artifacts to the writer pool (pipelined) or write them inline
+        (serial, ``writer=None``)."""
+        key = rec.key
+        out = _finish_bucket(rec)
+        if out is None:
+            return
+        ok_chunk, detectors, fleet_seconds = out
+        loaded = rec.loaded
+        _record_manifest(key, ok_chunk)
+        _PIPE_CHUNKS_TOTAL.inc(1.0, "pipelined" if writer else "serial")
+        if artifact_fmt == "v2":
+            payload = _chunk_payload(ok_chunk, detectors, fleet_seconds,
+                                     loaded, rec.pending)
+            if writer is not None:
+                # v2: the chunk IS the write unit — one pack per chunk
+                # rides the writer queue as a single item
+                writer.submit([payload])
+            else:
+                _write_chunk(*payload)
+            return
+        per_machine = fleet_seconds / len(ok_chunk)
+        if writer is None:
+            baselines = _chunk_baselines(ok_chunk, detectors, loaded,
+                                         rec.pending)
             for m, det in zip(ok_chunk, detectors):
                 _dump_machine(
                     m,
                     det,
                     loaded[m.name],
-                    fleet_seconds / len(ok_chunk),
+                    per_machine,
                     output_dir,
                     model_register_dir,
                     result,
@@ -997,63 +1140,85 @@ def build_project(
                 )
                 _done(m.name)
                 _free(loaded, [m.name])  # artifact on disk: arrays drop
+            return
+        # machines in a chunk share ONE model config, so their
+        # definition.yaml bytes are identical by construction —
+        # serialize once per chunk instead of per machine (the
+        # byte-parity test pins pipelined == serial per machine, so
+        # a config that DID diverge inside a chunk would be caught)
+        chunk_definition = serializer.render_definition(detectors[0])
+        baselines = _chunk_baselines(ok_chunk, detectors, loaded,
+                                     rec.pending)
+        batch = []
+        for m, det in zip(ok_chunk, detectors):
+            metadata = _machine_metadata(
+                m,
+                det,
+                loaded[m.name],
+                per_machine,
+                fleet=True,
+                align_lengths=align_lengths,
+                pad_lengths=pad_lengths,
+                cache_key=machine_keys[m.name],
+                baseline=baselines.get(m.name),
+            )
+            _free(loaded, [m.name])  # arrays drop at enqueue, not write
+            batch.append(
+                (m.name, det, metadata, per_machine, chunk_definition)
+            )
+        writer.submit(batch)  # one handoff per chunk
 
-    def _drive_pipeline(pool, writer: _ArtifactWriter) -> None:
-        """The pipelined drive loop: loader pool (stage A, prefetching) ∥
-        device compute on this thread (stage B) ∥ artifact-writer pool
-        (stage C).  Metadata assembles at enqueue time so the chunk's
-        arrays free BEFORE the write queues (the 2-chunk peak_loaded
-        bound holds regardless of writer backlog).  This function is a
-        D2H-free zone — ``scripts/lint.py`` rejects blocking
-        device→host calls (jax.device_get / np.asarray / to_host /
-        block_until_ready) in its body."""
+    def _drive_serial(pool) -> None:
+        """The pre-pipeline drive loop (GORDO_BUILD_PIPELINE=off): loads
+        still prefetch one chunk ahead, but dispatch and collect run back
+        to back (no overlap) and artifact dumps run inline on the
+        critical path after each chunk trains."""
         next_futures = _submit(pool, chunks[0][1]) if chunks else None
         for i, (key, chunk) in enumerate(chunks):
-            t_wait = time.time()
             loaded = _collect(chunk, next_futures)
-            _PIPE_STALL_SECONDS.inc(time.time() - t_wait, "load")
+            # prefetch the NEXT chunk now — it loads while this one trains
             next_futures = (
                 _submit(pool, chunks[i + 1][1]) if i + 1 < len(chunks) else None
             )
-            out = _run_bucket(key, chunk, loaded)
-            if out is None:
-                continue
-            ok_chunk, detectors, fleet_seconds = out
-            _record_manifest(key, ok_chunk)
-            _PIPE_CHUNKS_TOTAL.inc(1.0, "pipelined")
-            if artifact_fmt == "v2":
-                # v2: the chunk IS the write unit — one pack per chunk
-                # rides the writer queue as a single item
-                writer.submit([
-                    _chunk_payload(ok_chunk, detectors, fleet_seconds, loaded)
-                ])
-                continue
-            per_machine = fleet_seconds / len(ok_chunk)
-            # machines in a chunk share ONE model config, so their
-            # definition.yaml bytes are identical by construction —
-            # serialize once per chunk instead of per machine (the
-            # byte-parity test pins pipelined == serial per machine, so
-            # a config that DID diverge inside a chunk would be caught)
-            chunk_definition = serializer.render_definition(detectors[0])
-            baselines = _chunk_baselines(ok_chunk, detectors, loaded)
-            batch = []
-            for m, det in zip(ok_chunk, detectors):
-                metadata = _machine_metadata(
-                    m,
-                    det,
-                    loaded[m.name],
-                    per_machine,
-                    fleet=True,
-                    align_lengths=align_lengths,
-                    pad_lengths=pad_lengths,
-                    cache_key=machine_keys[m.name],
-                    baseline=baselines.get(m.name),
-                )
-                _free(loaded, [m.name])  # arrays drop at enqueue, not write
-                batch.append(
-                    (m.name, det, metadata, per_machine, chunk_definition)
-                )
-            writer.submit(batch)  # one handoff per chunk
+            rec = _dispatch_bucket(key, chunk, loaded)
+            if rec is not None:
+                _finish_chunk(rec, None)
+
+    def _drive_pipeline(pool, writer: _ArtifactWriter) -> None:
+        """The pipelined drive loop: loader pool (stage A, prefetching) ∥
+        device stage B split into DISPATCH and COLLECT halves on this
+        thread ∥ artifact-writer pool (stage C).
+
+        Stage B's split is the r23 overlap: chunk k+1's program
+        dispatches (async H2D staging through the placement seam + jax
+        async dispatch) BEFORE chunk k's blocking fetch/assembly runs, so
+        the host-side collect work of chunk k hides behind chunk k+1's
+        device compute instead of starving the device between chunks.
+        Loads for chunk k+2 submit only after chunk k's arrays free,
+        preserving the 2-chunk peak_loaded bound.  Metadata assembles at
+        enqueue time so the chunk's arrays free BEFORE the write queues
+        (the bound holds regardless of writer backlog).  This function is
+        a D2H-free zone — ``scripts/lint.py`` rejects blocking
+        device→host calls (jax.device_get / np.asarray / to_host /
+        block_until_ready) in its body; the D2H lives in
+        ``_finish_bucket`` via ``PendingFleetBuild.collect``."""
+        if not chunks:
+            return
+        futures = _submit(pool, chunks[0][1])
+        prev: Optional[_PendingChunk] = None
+        for i, (key, chunk) in enumerate(chunks):
+            t_wait = time.time()
+            loaded = _collect(chunk, futures)
+            _PIPE_STALL_SECONDS.inc(time.time() - t_wait, "load")
+            rec = _dispatch_bucket(key, chunk, loaded)
+            if prev is not None:
+                _finish_chunk(prev, writer)  # overlaps chunk i's compute
+            prev = rec
+            futures = (
+                _submit(pool, chunks[i + 1][1]) if i + 1 < len(chunks) else None
+            )
+        if prev is not None:
+            _finish_chunk(prev, writer)
 
     use_pipeline = _pipeline_enabled(pipeline) and bool(chunks)
     result.pipelined = use_pipeline
@@ -1083,16 +1248,18 @@ def build_project(
         _BUILD_MACHINE_SECONDS.observe(per_machine, "fleet")
         _done(name)
 
-    def _chunk_payload(ok_chunk, detectors, fleet_seconds, loaded) -> Tuple:
+    def _chunk_payload(ok_chunk, detectors, fleet_seconds, loaded,
+                       pending=None) -> Tuple:
         """Assemble a v2 chunk's write payload (metadata closes over the
         training arrays, so they free HERE — at enqueue — keeping the
         2-chunk peak_loaded bound independent of writer backlog).
         Fleet-health baselines sketch FIRST, while the chunk's training
         arrays are still resident — one stacked scoring dispatch for the
-        whole chunk (telemetry.fleet_health.training_baselines)."""
+        whole chunk (telemetry.fleet_health.training_baselines), fed the
+        collect side's stacked arrays so nothing restacks."""
         per_machine = fleet_seconds / len(ok_chunk)
         chunk_definition = serializer.render_definition(detectors[0])
-        baselines = _chunk_baselines(ok_chunk, detectors, loaded)
+        baselines = _chunk_baselines(ok_chunk, detectors, loaded, pending)
         metadatas = []
         for m, det in zip(ok_chunk, detectors):
             metadatas.append(_machine_metadata(
@@ -1293,6 +1460,7 @@ def build_project(
             shard_state.finish()
     result.seconds = time.time() - t_start
     result.peak_loaded = tracker.peak
+    result.device_idle_seconds = occupancy.idle_seconds
     _write_telemetry_snapshot(output_dir, result.shard)
     try:
         # the (signature, bucket) set this build materialized — what the
@@ -1346,16 +1514,24 @@ def _write_telemetry_snapshot(
         logger.exception("telemetry snapshot write failed: %s", path)
 
 
-def _chunk_baselines(ok_chunk, detectors, loaded) -> Dict[str, Any]:
+def _chunk_baselines(ok_chunk, detectors, loaded, pending=None) -> Dict[str, Any]:
     """Training-time residual sketches for a just-trained chunk — ONE
     stacked scoring dispatch over the still-resident training arrays
     (the device-stage cost rides the same thread the chunk trained on,
-    like training itself).  ``GORDO_FLEET_BASELINE=off`` skips it."""
+    like training itself).  ``pending`` (the chunk's collected
+    :class:`PendingFleetBuild`, when it built async) re-exposes the
+    fetched stacked arrays so the scorer skips its leaf-by-leaf restack
+    of the per-machine views.  ``GORDO_FLEET_BASELINE=off`` skips it."""
     from gordo_tpu.telemetry import fleet_health
 
+    hint = (
+        pending.prestacked([m.name for m in ok_chunk])
+        if pending is not None else None
+    )
     return fleet_health.training_baselines(
         {m.name: det for m, det in zip(ok_chunk, detectors)},
         {m.name: loaded[m.name][0] for m in ok_chunk if m.name in loaded},
+        prestacked_hint=hint,
     )
 
 

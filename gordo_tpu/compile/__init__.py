@@ -10,9 +10,11 @@ pre-compile before the first request arrives.
 
 from gordo_tpu.compile.registry import (  # noqa: F401
     REGISTRY,
+    ClosureProgram,
     CompileRegistry,
     Program,
     cached_closure,
+    closure_program,
     install_persistent_cache_counters,
     jit,
     program,
@@ -29,10 +31,12 @@ from gordo_tpu.compile.warmup import (  # noqa: F401
 
 __all__ = [
     "REGISTRY",
+    "ClosureProgram",
     "CompileRegistry",
     "Program",
     "WARMUP_DIR",
     "cached_closure",
+    "closure_program",
     "filter_manifest",
     "install_persistent_cache_counters",
     "jit",

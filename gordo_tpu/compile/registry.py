@@ -304,6 +304,126 @@ class Program:
         return dt
 
 
+class ClosureProgram:
+    """A per-configuration jitted CLOSURE with the :class:`Program`
+    warm/bind surface.
+
+    The fleet build programs of ``parallel/anomaly.py`` are closures over
+    their configuration (module, fold layout, scaler options), built on
+    demand and cached in the registry's closure LRU — they cannot be
+    top-level :class:`Program`\\ s because the closure itself is part of
+    the identity.  Wrapping each closure in a ``ClosureProgram`` gives the
+    build plane the same two properties the serve plane gets from
+    ``Program``: :meth:`warm` pre-compiles a signature from
+    ``jax.ShapeDtypeStruct``\\ s alone (no data, no execution — schedulable
+    before the first chunk's arrays exist), and a call whose signature was
+    warmed dispatches the AOT executable directly instead of re-entering
+    jit's trace-cache path.  A call whose signature was never warmed (the
+    common cold-build case) falls through to the plain jitted closure —
+    behavior and numerics identical either way, and near-zero overhead:
+    the fallthrough is one attribute check while the executable dict is
+    empty.
+
+    Executables live on the instance, so they are evicted together with
+    the closure when the registry's closure LRU drops it.
+    """
+
+    __slots__ = ("name", "_jitted", "_exes", "_lock", "_aot_broken")
+
+    def __init__(self, fn: Callable, name: str = "closure", **jit_kwargs):
+        import jax
+
+        self.name = name
+        self._jitted = jax.jit(fn, **jit_kwargs)
+        with REGISTRY._lock:
+            REGISTRY._jits[name] = self._jitted
+        self._exes: Dict[Any, Any] = {}
+        self._lock = threading.Lock()
+        self._aot_broken = False
+
+    def _sig(self, args: Tuple):
+        import jax
+
+        flat, treedef = jax.tree.flatten(args)
+        if any(isinstance(leaf, jax.core.Tracer) for leaf in flat):
+            return None
+        return (treedef, tuple(_leaf_sig(leaf) for leaf in flat))
+
+    def warm(self, *args) -> float:
+        """Pre-compile this closure for the given argument shapes without
+        executing it (arguments may be real arrays or
+        ``jax.ShapeDtypeStruct``\\ s, shardings included).  Returns compile
+        seconds, 0.0 on a cache hit.  Raises on tracer inputs or compile
+        failure so warmup gates fail loudly."""
+        key = self._sig(args)
+        if key is None:
+            raise ValueError(f"cannot warm {self.name} with tracer inputs")
+        with self._lock:
+            if key in self._exes:
+                return 0.0
+        if not _plane_enabled():
+            return 0.0
+        _CACHE_MISSES.inc(1.0, "programs")
+        t0 = time.perf_counter()
+        exe = self._jitted.lower(*args).compile()
+        dt = time.perf_counter() - t0
+        _COMPILE_SECONDS.observe(dt, self.name)
+        with self._lock:
+            self._exes[key] = exe
+        return dt
+
+    def bind(self, *args):
+        """Resolve this signature to its compiled executable (compiling if
+        needed) and return it, or None when the AOT path is off or cannot
+        express the call.  The executable is only valid while calls repeat
+        the same shapes/dtypes/shardings."""
+        if self._aot_broken or not _plane_enabled():
+            return None
+        key = self._sig(args)
+        if key is None:
+            return None
+        with self._lock:
+            exe = self._exes.get(key)
+        if exe is not None:
+            return exe
+        try:
+            self.warm(*args)
+        except Exception as exc:
+            self._aot_broken = True
+            logger.warning(
+                "AOT compile unavailable for closure %s (%s); "
+                "dispatching through jit",
+                self.name, exc,
+            )
+            return None
+        with self._lock:
+            return self._exes.get(key)
+
+    def __call__(self, *args):
+        # empty-dict check first: a never-warmed closure (the common cold
+        # build) pays one truthiness test, not a tree flatten
+        if self._aot_broken or not self._exes or not _plane_enabled():
+            return self._jitted(*args)
+        key = self._sig(args)
+        if key is None:
+            return self._jitted(*args)
+        with self._lock:
+            exe = self._exes.get(key)
+        if exe is None:
+            return self._jitted(*args)
+        _CACHE_HITS.inc(1.0, "programs")
+        try:
+            return exe(*args)
+        except Exception:
+            logger.exception(
+                "compiled executable for closure %s failed; "
+                "falling back to jit", self.name,
+            )
+            with self._lock:
+                self._exes.pop(key, None)
+            return self._jitted(*args)
+
+
 class CompileRegistry:
     """Process-wide compile-plane state: the AOT executable cache, the
     builder closure cache, the registered-program index, and the warming
@@ -435,6 +555,15 @@ def cached_closure(key, factory: Callable[[], Any]):
     """Module-level convenience for :meth:`CompileRegistry.cached_closure`
     on the process registry."""
     return REGISTRY.cached_closure(key, factory)
+
+
+def closure_program(
+    fn: Callable, *, name: str = "closure", **jit_kwargs
+) -> ClosureProgram:
+    """Wrap a per-configuration closure as a :class:`ClosureProgram`
+    (warm/bind-capable jitted closure).  Pair with :func:`cached_closure`
+    so the wrapper shares the closure LRU's eviction."""
+    return ClosureProgram(fn, name=name, **jit_kwargs)
 
 
 def warming() -> bool:

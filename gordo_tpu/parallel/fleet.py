@@ -175,6 +175,18 @@ def _pad_stacked(
     return out
 
 
+def stage_inputs(tree: Any, mesh: Optional[Mesh] = None) -> Any:
+    """Asynchronously stage a pytree of stacked host arrays onto the
+    device(s) through the placement seam: model-axis sharding when a mesh
+    is given, default placement otherwise.  ``jax.device_put`` does not
+    block, so the H2D copies overlap whatever the device is already
+    running — dispatching bucket k+1's program on staged inputs never
+    waits on bucket k.  Shared by :func:`fleet_stage` and the fleet
+    builder's dispatch window (``parallel/anomaly.py``)."""
+    ms = model_sharding(mesh) if mesh is not None else None
+    return place(tree, ms)
+
+
 def fleet_keys(seeds: np.ndarray) -> Tuple[jax.Array, jax.Array]:
     """Per-machine (init_key, fit_key) pairs, derived EXACTLY like the
     single-model path (``train.fit.fit``: split of ``PRNGKey(seed)``) so a
@@ -276,7 +288,7 @@ def fleet_stage(
         seeds = _pad_models(seeds, m_pad)
 
     ms = model_sharding(mesh) if mesh is not None else None
-    Xd, yd, wd = place((Xp, yp, wp), ms)
+    Xd, yd, wd = stage_inputs((Xp, yp, wp), mesh)
 
     init_keys, fit_keys = fleet_keys(seeds)
     if params is None:

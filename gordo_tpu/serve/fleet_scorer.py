@@ -790,6 +790,38 @@ def _prestack_group(
     return prestacked, names, chains
 
 
+def _hint_group(
+    hint: Dict[str, Any], names: List[str], chains: List[Dict]
+) -> Tuple[Optional[Dict[str, Any]], List[str], List[Dict]]:
+    """Adopt a builder-supplied prestack for this signature group.
+
+    The fleet builder's collect side fetches each chunk's results as
+    stacked ``(M, ...)`` host arrays and hands the per-machine detectors
+    zero-copy views; ``hint`` re-exposes those stacked arrays whole
+    (``PendingFleetBuild.prestacked``).  When this group is exactly the
+    hinted fleet, the bucket initializes through the prestacked path —
+    one ``to_device`` per pack — instead of re-stacking the per-machine
+    views leaf by leaf.  Row order follows the hint (group-dispatch
+    order); bucket semantics don't depend on name order.  Any mismatch —
+    a subset fleet, mixed signatures splitting the models across groups —
+    falls back to the generic stacking path unchanged.
+    """
+    hinted = hint.get("names")
+    if (
+        hinted is None
+        or len(hinted) != len(names)
+        or set(hinted) != set(names)
+    ):
+        return None, names, chains
+    by_name = dict(zip(names, chains))
+    names = list(hinted)
+    return (
+        {k: hint[k] for k in ("packs", "feature_thresholds", "agg")},
+        names,
+        [by_name[n] for n in names],
+    )
+
+
 def _signature(chain: Dict[str, Any]) -> Optional[Tuple]:
     det = chain["detector"]
     if det is None:
@@ -971,6 +1003,7 @@ class FleetScorer:
         mesh: Optional[Any] = None,
         pack_store: Optional[Any] = None,
         dtype: Optional[str] = None,
+        prestacked_hint: Optional[Dict[str, Any]] = None,
     ) -> "FleetScorer":
         """``mesh``: optional ``("models", "data")`` fleet mesh; buckets
         shard their stacked machine axis over it so one serving dispatch
@@ -986,6 +1019,12 @@ class FleetScorer:
         (``None`` resolves ``GORDO_SERVE_DTYPE``); one fleet, one
         precision — per-machine mixing would make bulk responses depend
         on bucketing accidents.
+
+        ``prestacked_hint``: already-stacked host arrays for the whole
+        fleet (``PendingFleetBuild.prestacked``) — the builder's
+        baseline-sketch call adopts them via :func:`_hint_group` instead
+        of re-stacking its freshly assembled detectors' views leaf by
+        leaf.  Ignored (generic stacking) on any mismatch.
         """
         self = cls()
         self.models = dict(models)
@@ -1009,6 +1048,10 @@ class FleetScorer:
             if pack_store is not None:
                 prestacked, names, chains = _prestack_group(
                     pack_store, names, chains
+                )
+            elif prestacked_hint is not None:
+                prestacked, names, chains = _hint_group(
+                    prestacked_hint, names, chains
                 )
             bucket = _Bucket(
                 names, chains, mesh=mesh, prestacked=prestacked,

@@ -663,14 +663,18 @@ def training_baseline(model: Any, X: Any) -> Optional[Dict[str, Any]]:
 
 
 def training_baselines(
-    models: Dict[str, Any], X_by_name: Dict[str, Any]
+    models: Dict[str, Any], X_by_name: Dict[str, Any],
+    prestacked_hint: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Dict[str, Any]]:
     """Training-time residual sketches for a whole trained chunk in ONE
     stacked dispatch (the chunk shares a structural signature, so the
     fleet scorer buckets it into a single vmapped program — the builder
     pays ~one bulk serving round per chunk, not one dispatch per
-    machine).  Returns ``{machine: sketch doc}``; machines whose scoring
-    failed are simply absent."""
+    machine).  ``prestacked_hint``: the chunk's stacked host arrays as
+    fetched by the build's collect side (``PendingFleetBuild.prestacked``)
+    — the scorer adopts them whole instead of re-stacking per-machine
+    views leaf by leaf.  Returns ``{machine: sketch doc}``; machines
+    whose scoring failed are simply absent."""
     if not baselines_enabled() or not models:
         return {}
     docs: Dict[str, Dict[str, Any]] = {}
@@ -683,7 +687,8 @@ def training_baselines(
             if name in models
         }
         scorer = FleetScorer.from_models(
-            {n: models[n] for n in X_by}
+            {n: models[n] for n in X_by},
+            prestacked_hint=prestacked_hint,
         )
         with FLEET_HEALTH.suspended():
             out = scorer.score_all(X_by)

@@ -30,16 +30,24 @@ METRIC_NAME_RE = re.compile(r"^gordo_[a-z_]+$")
 #: registration entrypoints whose first literal argument is a metric name
 METRIC_FACTORIES = {"counter", "gauge", "histogram"}
 
-#: latency-critical drive loops, by file basename → function names: the
-#: build-pipeline drive loop and the coalescer's drain thread.  A
-#: blocking device→host transfer there stalls EVERY stage behind it
-#: (the drain thread can't gather the next batch; the drive loop can't
-#: stage the next chunk), so direct D2H calls are design bugs in these
-#: scopes — results must flow through the writer/finish pools instead.
-#: ``# noqa`` opts a line out, as elsewhere.
+#: latency-critical drive loops and dispatch windows, by file basename →
+#: function names: the build-pipeline drive loop, the coalescer's drain
+#: thread, and (r23) the fleet-build DISPATCH window — everything between
+#: launching chunk k+1's program and collecting chunk k.  A blocking
+#: device→host transfer there stalls EVERY stage behind it (the drain
+#: thread can't gather the next batch; the drive loop can't stage the
+#: next chunk; a fetch inside dispatch serializes the overlap the
+#: dispatch/collect split exists to create), so direct D2H calls are
+#: design bugs in these scopes — results must flow through the collect
+#: side (``PendingFleetBuild.collect`` / ``_finish_bucket``) or the
+#: writer/finish pools instead.  ``# noqa`` opts a line out, as
+#: elsewhere.
 D2H_FORBIDDEN_SCOPES = {
-    "fleet_build.py": {"_drive_pipeline"},
+    "fleet_build.py": {"_drive_pipeline", "_dispatch_bucket",
+                       "_dispatch_chunk"},
     "coalesce.py": {"_run", "_drain"},
+    "anomaly.py": {"dispatch", "_dispatch_group",
+                   "_dispatch_exact_length_groups", "_dispatch_padded"},
 }
 #: attribute calls that force a blocking device→host transfer
 D2H_BLOCKING_ATTRS = {"device_get", "block_until_ready"}
@@ -646,11 +654,11 @@ class _ImportTracker(ast.NodeVisitor):
 
 
 def _d2h_findings(path: str, tree: ast.AST, noqa_lines: set) -> List[Finding]:
-    """Flag blocking device→host calls inside the pipeline drive loop and
-    the coalescer drain thread (see ``D2H_FORBIDDEN_SCOPES``): direct
-    ``jax.device_get`` / ``.block_until_ready()`` / ``np.asarray`` (which
-    materializes a jax array on host) / ``to_host`` calls in those
-    function bodies."""
+    """Flag blocking device→host calls inside the pipeline drive loop,
+    the coalescer drain thread, and the fleet-build dispatch window (see
+    ``D2H_FORBIDDEN_SCOPES``): direct ``jax.device_get`` /
+    ``.block_until_ready()`` / ``np.asarray`` (which materializes a jax
+    array on host) / ``to_host`` calls in those function bodies."""
     scopes = D2H_FORBIDDEN_SCOPES.get(os.path.basename(path))
     if not scopes:
         return []
@@ -680,8 +688,9 @@ def _d2h_findings(path: str, tree: ast.AST, noqa_lines: set) -> List[Finding]:
                 findings.append(
                     (path, call.lineno,
                      f"blocking D2H call {bad}() inside {node.name}() — "
-                     "this scope is a pipeline drive loop/drain thread; "
-                     "route results through the writer/finish pool")
+                     "this scope is a drive loop/drain thread/dispatch "
+                     "window; route results through the collect side or "
+                     "the writer/finish pool")
                 )
     return findings
 

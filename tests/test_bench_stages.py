@@ -12,7 +12,8 @@ import bench
 
 def test_default_runs_every_stage_in_priority_order():
     assert bench.parse_stages([]) == [
-        "build", "build_pipeline", "artifact_io", "hot_reload", "serving",
+        "build", "build_pipeline", "build_throughput",
+        "artifact_io", "hot_reload", "serving",
         "serving_precision", "serving_sharded", "serving_wire",
         "serving_openloop", "telemetry_overhead", "health_overhead",
         "cold_start", "multi_device", "refresh", "backfill",
@@ -22,6 +23,12 @@ def test_default_runs_every_stage_in_priority_order():
 
 def test_backfill_stage_selectable():
     assert bench.parse_stages(["--stage", "backfill"]) == ["backfill"]
+
+
+def test_build_throughput_stage_selectable():
+    assert bench.parse_stages(["--stage", "build_throughput"]) == [
+        "build_throughput"
+    ]
 
 
 def test_cold_start_stage_selectable():

@@ -181,6 +181,122 @@ def test_plane_kill_switch_uses_plain_jit(monkeypatch):
     )
 
 
+def test_closure_program_warm_precompiles_and_call_hits():
+    """r23: the fleet-build closures get the Program warm/call contract —
+    a warmed signature dispatches the AOT executable (cache HIT), and the
+    result is bitwise the jitted closure's."""
+    import jax
+    import jax.numpy as jnp
+
+    scale = 2.5  # the closed-over configuration
+
+    def f(x):
+        return x * scale
+
+    prog = compile_plane.closure_program(f, name="test.closure_warm")
+    sds = jax.ShapeDtypeStruct((6,), jnp.float32)
+    assert prog.warm(sds) > 0.0   # compiled now
+    assert prog.warm(sds) == 0.0  # idempotent
+    before = _counter(
+        telemetry.REGISTRY.snapshot(), "gordo_compile_cache_hits_total",
+        "programs",
+    )
+    x = np.arange(6, dtype=np.float32)
+    out = prog(x)
+    np.testing.assert_array_equal(np.asarray(out), x * scale)
+    np.testing.assert_array_equal(
+        np.asarray(out), np.asarray(prog._jitted(x))
+    )
+    after = _counter(
+        telemetry.REGISTRY.snapshot(), "gordo_compile_cache_hits_total",
+        "programs",
+    )
+    assert after == before + 1
+
+
+def test_closure_program_cold_and_unwarmed_signatures_fall_through():
+    """A never-warmed closure (the common cold build) and a warmed one
+    called at a DIFFERENT signature both dispatch through plain jit —
+    same numerics, nothing cached for the unseen shape."""
+    import jax
+    import jax.numpy as jnp
+
+    def f(x):
+        return x + 10.0
+
+    cold = compile_plane.closure_program(f, name="test.closure_cold")
+    assert not cold._exes
+    x = np.arange(4, dtype=np.float32)
+    np.testing.assert_array_equal(np.asarray(cold(x)), x + 10.0)
+    assert not cold._exes  # __call__ never populates the AOT dict
+
+    warmed = compile_plane.closure_program(f, name="test.closure_other")
+    warmed.warm(jax.ShapeDtypeStruct((4,), jnp.float32))
+    y = np.arange(7, dtype=np.float32)  # signature never warmed
+    np.testing.assert_array_equal(np.asarray(warmed(y)), y + 10.0)
+    assert len(warmed._exes) == 1
+
+
+def test_closure_program_kill_switch_uses_plain_jit(monkeypatch):
+    monkeypatch.setenv("GORDO_COMPILE_PLANE", "off")
+
+    def f(x):
+        return x - 1.0
+
+    prog = compile_plane.closure_program(f, name="test.closure_off")
+    import jax
+    import jax.numpy as jnp
+
+    assert prog.warm(jax.ShapeDtypeStruct((3,), jnp.float32)) == 0.0
+    assert not prog._exes  # plane off: nothing compiles ahead of time
+    x = np.arange(3, dtype=np.float32)
+    np.testing.assert_array_equal(np.asarray(prog(x)), x - 1.0)
+
+
+def test_fleet_builder_warm_precompiles_group_program():
+    """FleetDiffBuilder.warm pre-compiles the bucket's program from shapes
+    alone: the subsequent dispatch of a matching group is an AOT hit."""
+    from gordo_tpu.parallel.anomaly import FleetDiffBuilder, analyze_definition
+    from gordo_tpu.serializer import from_definition
+
+    definition = {
+        "gordo_tpu.anomaly.diff.DiffBasedAnomalyDetector": {
+            "base_estimator": {
+                "gordo_tpu.pipeline.Pipeline": {
+                    "steps": [
+                        "gordo_tpu.ops.scalers.MinMaxScaler",
+                        {
+                            "gordo_tpu.models.estimator.AutoEncoder": {
+                                "kind": "feedforward_hourglass",
+                                "epochs": 1,
+                                "batch_size": 64,
+                            }
+                        },
+                    ]
+                }
+            }
+        }
+    }
+    spec = analyze_definition(from_definition(definition))
+    builder = FleetDiffBuilder(spec)
+    dt = builder.warm(m=2, n_rows=220, n_features=3)
+    assert dt > 0.0
+    assert builder.warm(m=2, n_rows=220, n_features=3) == 0.0
+    before = _counter(
+        telemetry.REGISTRY.snapshot(), "gordo_compile_cache_hits_total",
+        "programs",
+    )
+    rng = np.random.default_rng(3)
+    Xs = [rng.standard_normal((220, 3)).astype(np.float32) for _ in range(2)]
+    dets = builder.dispatch(Xs).collect()
+    assert len(dets) == 2
+    after = _counter(
+        telemetry.REGISTRY.snapshot(), "gordo_compile_cache_hits_total",
+        "programs",
+    )
+    assert after == before + 1  # the dispatch hit the warmed executable
+
+
 # ---------------------------------------------------------------------------
 # warmup manifest round-trip
 # ---------------------------------------------------------------------------

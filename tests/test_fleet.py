@@ -309,13 +309,15 @@ def test_pad_lengths_ragged_one_program_zero_rows_dropped(
     spec = analyze_definition(from_definition(DETECTOR_DEF))
 
     calls = []
-    orig = FleetDiffBuilder._build_group
+    orig = FleetDiffBuilder._dispatch_group
 
-    def counting(self, X, y, lens=None):
+    def counting(self, X, y, lens=None, warm=None):
         calls.append((X.shape, None if lens is None else tuple(lens)))
-        return orig(self, X, y, lens=lens)
+        return orig(self, X, y, lens=lens, warm=warm)
 
-    monkeypatch.setattr(anomaly_mod.FleetDiffBuilder, "_build_group", counting)
+    monkeypatch.setattr(
+        anomaly_mod.FleetDiffBuilder, "_dispatch_group", counting
+    )
 
     detectors = FleetDiffBuilder(spec, pad_lengths=100).build(Xs)
     assert len(calls) == 1                            # O(1) compiles

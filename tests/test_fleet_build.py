@@ -328,13 +328,14 @@ def test_align_lengths_collapses_ragged_row_counts(tmp_path, monkeypatch):
     machines = [machine(i, h) for i, h in enumerate((20, 21, 22))]
 
     seen_lengths = []
-    orig_build = fb.FleetDiffBuilder.build
+    # the drive loop enters the builder through the async dispatch seam
+    orig_dispatch = fb.FleetDiffBuilder.dispatch
 
-    def recording_build(self, Xs, ys):
+    def recording_dispatch(self, Xs, ys=None, **kwargs):
         seen_lengths.append(sorted({x.shape[0] for x in Xs}))
-        return orig_build(self, Xs, ys)
+        return orig_dispatch(self, Xs, ys, **kwargs)
 
-    monkeypatch.setattr(fb.FleetDiffBuilder, "build", recording_build)
+    monkeypatch.setattr(fb.FleetDiffBuilder, "dispatch", recording_dispatch)
 
     result = build_project(
         machines, str(tmp_path / "aligned"), align_lengths=60,
@@ -384,13 +385,14 @@ def test_pad_lengths_keeps_rows_and_collapses_programs(tmp_path, monkeypatch):
     pad = 72
 
     seen = []
-    orig = fb.FleetDiffBuilder._build_group
+    # every group — padded or exact — launches through _dispatch_group
+    orig = fb.FleetDiffBuilder._dispatch_group
 
-    def recording(self, X, y, lens=None):
+    def recording(self, X, y, lens=None, warm=None):
         seen.append((X.shape[1], None if lens is None else list(lens)))
-        return orig(self, X, y, lens=lens)
+        return orig(self, X, y, lens=lens, warm=warm)
 
-    monkeypatch.setattr(fb.FleetDiffBuilder, "_build_group", recording)
+    monkeypatch.setattr(fb.FleetDiffBuilder, "_dispatch_group", recording)
 
     reg = tmp_path / "reg"
     result = build_project(
@@ -551,13 +553,13 @@ class TestAutoPad:
 
         machines = self._ragged_machines(prefix="np")
         seen_lengths = []
-        orig_build = fb.FleetDiffBuilder.build
+        orig_dispatch = fb.FleetDiffBuilder.dispatch
 
-        def recording_build(self, Xs, ys):
+        def recording_dispatch(self, Xs, ys=None, **kwargs):
             seen_lengths.append(sorted({x.shape[0] for x in Xs}))
-            return orig_build(self, Xs, ys)
+            return orig_dispatch(self, Xs, ys, **kwargs)
 
-        monkeypatch.setattr(fb.FleetDiffBuilder, "build", recording_build)
+        monkeypatch.setattr(fb.FleetDiffBuilder, "dispatch", recording_dispatch)
         result = build_project(
             machines, str(tmp_path / "m"), auto_pad=False,
             auto_pad_budget_seconds=1.0,
