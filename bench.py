@@ -611,6 +611,207 @@ def bench_build_throughput(mesh, out: dict) -> None:
         raise RuntimeError("async-vs-serial artifact parity FAILED")
 
 
+def bench_build_ingest(mesh, out: dict) -> None:
+    """r24 acceptance: the fleet-vectorized ingest plane vs the
+    per-machine pandas load path.
+
+    Same paired-alternating-best-of protocol as the other build stages:
+    one warmup run per mode lands the compiles and the OS page cache,
+    then 4 alternating per-machine/ingest rounds with the per-mode BEST
+    standing (min() rejects one-sided timeshare contamination).  The
+    GATED number is the load stage — the pipeline stage-seconds
+    histogram delta around each best round — because that is the work
+    the ingest plane replaces: 512 sequential resample/join/row-filter
+    pandas passes become one columnar numpy pass per dataset geometry,
+    writing straight into the preallocated stacked buffer.  Acceptance:
+    ingest load ≤ 0.5× the per-machine load.
+
+    ``loader_workers`` is recorded for both modes to attest the r23
+    regression fix: the async loader pool is now sized adaptively (2
+    threads when the chunk-granular ingest path runs, the wide
+    per-machine pool otherwise) instead of a fixed 8 that lost 1.9s to
+    thread-pool contention on this 1-core container.
+
+    In-bench byte-parity attestation mirrors build_throughput: one
+    per-machine and one ingest build of a 32-machine set — 8 of them
+    dataset-fingerprint twins so the fetch-dedup path is exercised, not
+    just the vectorized assembly — must produce identical artifacts
+    (models modulo zeroed wall-clock timings, metadata modulo volatile
+    timing fields) and identical registry keys.  The ingest run's dedup
+    counters land in ``build_ingest_dedup``.
+    """
+    import pickle
+
+    from gordo_tpu import telemetry
+    from gordo_tpu import artifacts as artifacts_mod
+    from gordo_tpu.builder.fleet_build import build_project
+    from gordo_tpu.utils import disk_registry
+
+    def stage_sums() -> dict:
+        metric = telemetry.REGISTRY.snapshot()["metrics"].get(
+            "gordo_build_pipeline_stage_seconds"
+        ) or {}
+        sums = {}
+        for key, v in metric.get("series", {}).items():
+            sums[json.loads(key)[0]] = float(v["sum"])
+        return sums
+
+    def timed(machines, bucket, ing, label, out_dir=None, reg=None):
+        keep = out_dir is not None
+        out_dir = out_dir or tempfile.mkdtemp(
+            prefix=f"gordo-bench-bi-{label}-"
+        )
+        before = stage_sums()
+        t0 = time.perf_counter()
+        result = build_project(
+            machines, out_dir, mesh=mesh, max_bucket_size=bucket,
+            pipeline=True, ingest=ing, model_register_dir=reg,
+        )
+        dt = time.perf_counter() - t0
+        after = stage_sums()
+        if not keep:
+            shutil.rmtree(out_dir, ignore_errors=True)
+        if result.failed or len(result.artifacts) != len(machines):
+            raise RuntimeError(
+                f"build_ingest {label}@{len(machines)}: "
+                f"{len(result.failed)} failed"
+            )
+        stages = {
+            k: round(after.get(k, 0.0) - before.get(k, 0.0), 4)
+            for k in sorted(set(after) | set(before))
+        }
+        return dt, stages, result
+
+    n_machines, bucket = N_MACHINES, 64
+    machines = make_machines(n_machines, prefix=f"bench-bi{n_machines}")
+    for ing in (False, True):  # warmup: land the compiles + page cache
+        timed(machines, bucket, ing, "warmup")
+    times = {"permachine": [], "ingest": []}
+    stage_attr = {"permachine": None, "ingest": None}
+    workers = {"permachine": None, "ingest": None}
+    dedup = None
+    for rnd in range(4):
+        for label, ing in (("permachine", False), ("ingest", True)):
+            dt, stages, result = timed(machines, bucket, ing, label)
+            if not times[label] or dt < min(times[label]):
+                stage_attr[label] = stages  # attribution of the BEST round
+                workers[label] = result.loader_workers
+                if ing:
+                    dedup = dict(result.ingest or {})
+            times[label].append(dt)
+            log(f"build_ingest {label}@{n_machines} round {rnd}: "
+                f"{dt:.2f}s load={stages.get('load', 0.0):.2f}s")
+    best = {label: min(ts) for label, ts in times.items()}
+    for label in ("permachine", "ingest"):
+        out[f"build_ingest_{label}_seconds_{n_machines}"] = round(
+            best[label], 4
+        )
+        out[f"build_ingest_stage_seconds_{label}"] = stage_attr[label]
+        out[f"build_ingest_loader_workers_{label}"] = workers[label]
+    load_pm = stage_attr["permachine"].get("load", 0.0)
+    load_in = stage_attr["ingest"].get("load", 0.0)
+    ratio = (load_in / load_pm) if load_pm else None
+    out["build_ingest_load_seconds_permachine"] = load_pm
+    out["build_ingest_load_seconds_ingest"] = load_in
+    out["build_ingest_load_ratio"] = round(ratio, 4) if ratio else ratio
+    out["build_ingest_load_gate_ok"] = bool(ratio is not None
+                                            and ratio <= 0.5)
+    out["build_ingest_wall_speedup"] = round(
+        best["permachine"] / best["ingest"], 4
+    )
+    log(f"build_ingest load: per-machine {load_pm:.2f}s, "
+        f"ingest {load_in:.2f}s, ratio {ratio:.3f} (gate ≤0.5)")
+
+    # -- in-bench byte-parity attestation (ingest vs per-machine) ----------
+    # make_machines tag names don't include the prefix, so two calls with
+    # different prefixes yield dataset-fingerprint TWINS: 8 of the 32
+    # parity machines dedup against the first 8, exercising the shared
+    # fetch path in the attested build, not just vectorized assembly.
+    volatile_meta = {
+        "model_creation_date", "data_query_duration_sec",
+        "cross_validation_duration_sec", "model_builder_duration_sec",
+        "fit_samples_per_second", "fit_seconds", "fleet_seconds",
+        "bucket_size",
+    }  # mirrors tests/test_build_pipeline.py::VOLATILE_META
+
+    def strip_meta(v):
+        if isinstance(v, dict):
+            return {k: strip_meta(x) for k, x in v.items()
+                    if k not in volatile_meta}
+        if isinstance(v, list):
+            return [strip_meta(x) for x in v]
+        return v
+
+    def scrub(obj, seen=None):
+        # mirror tests/test_build_pipeline.py::_scrub_timings
+        if seen is None:
+            seen = set()
+        if id(obj) in seen:
+            return
+        seen.add(id(obj))
+        if isinstance(obj, dict):
+            for key, zero in (("fleet_seconds", 0.0), ("bucket_size", 0)):
+                if key in obj:
+                    obj[key] = zero
+            for v in obj.values():
+                scrub(v, seen)
+            return
+        if isinstance(obj, (list, tuple)):
+            for v in obj:
+                scrub(v, seen)
+            return
+        d = getattr(obj, "__dict__", None)
+        if d is None:
+            return
+        if "fit_seconds_" in d:
+            d["fit_seconds_"] = 0.0
+        for v in d.values():
+            scrub(v, seen)
+
+    parity_machines = (
+        make_machines(24, prefix="bench-bi-par")
+        + make_machines(8, prefix="bench-bi-twin")
+    )
+    dirs = {}
+    for label, ing in (("permachine", False), ("ingest", True)):
+        d = tempfile.mkdtemp(prefix=f"gordo-bench-bipar-{label}-")
+        r = tempfile.mkdtemp(prefix=f"gordo-bench-bireg-{label}-")
+        # one 32-wide chunk: fetch dedup is chunk-granular, so the twins
+        # must share a chunk with their originals to register hits
+        _, _, result = timed(
+            parity_machines, 32, ing, f"parity-{label}", out_dir=d, reg=r
+        )
+        if ing:
+            out["build_ingest_dedup"] = dict(result.ingest or {})
+        dirs[label] = (d, r)
+    try:
+        sa = artifacts_mod.open_store(dirs["permachine"][0])
+        sb = artifacts_mod.open_store(dirs["ingest"][0])
+        parity_ok = sorted(sa.names()) == sorted(sb.names())
+        for m in parity_machines:
+            ma, mb = sa.load_model(m.name), sb.load_model(m.name)
+            scrub(ma)
+            scrub(mb)
+            parity_ok = parity_ok and (
+                pickle.dumps(ma) == pickle.dumps(mb)
+            )
+            parity_ok = parity_ok and (
+                strip_meta(sa.load_metadata(m.name))
+                == strip_meta(sb.load_metadata(m.name))
+            )
+        parity_ok = parity_ok and sorted(
+            disk_registry.list_keys(dirs["permachine"][1])
+        ) == sorted(disk_registry.list_keys(dirs["ingest"][1]))
+    finally:
+        for d, r in dirs.values():
+            shutil.rmtree(d, ignore_errors=True)
+            shutil.rmtree(r, ignore_errors=True)
+    out["build_ingest_parity_ok"] = bool(parity_ok)
+    log(f"build_ingest parity (ingest vs per-machine): {parity_ok}")
+    if not parity_ok:
+        raise RuntimeError("ingest-vs-per-machine artifact parity FAILED")
+
+
 def bench_lstm_build(mesh, out: dict) -> None:
     """BASELINE config 2: lstm_hourglass on 50-tag windowed sequences —
     the scenario where scan latency and MXU under-utilization bite."""
@@ -4020,7 +4221,7 @@ def run_stage_bounded(
 
 #: stage registry order == run order == metric priority (a mid-run wedge
 #: costs the least important remaining numbers)
-STAGES = ("build", "build_pipeline", "build_throughput",
+STAGES = ("build", "build_pipeline", "build_throughput", "build_ingest",
           "artifact_io", "hot_reload",
           "serving", "serving_precision", "serving_sharded",
           "serving_wire", "serving_openloop", "telemetry_overhead",
@@ -4145,6 +4346,10 @@ def main(argv: "list[str] | None" = None) -> None:
         ),
         "build_throughput": (
             lambda: bench_build_throughput(mesh, out),
+            lambda: remaining() * 0.6,
+        ),
+        "build_ingest": (
+            lambda: bench_build_ingest(mesh, out),
             lambda: remaining() * 0.6,
         ),
         "artifact_io": (

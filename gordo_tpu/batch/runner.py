@@ -41,7 +41,6 @@ straight from the artifact plane, data from providers, scores to disk.
 from __future__ import annotations
 
 import dataclasses
-import json
 import logging
 import os
 import time
@@ -54,6 +53,7 @@ from gordo_tpu import artifacts, telemetry
 from gordo_tpu.batch.archive import ScoreArchive
 from gordo_tpu.compile import load_warmup_manifest
 from gordo_tpu.dataset import dataset_from_metadata
+from gordo_tpu.ingest.fingerprint import provider_fingerprint
 from gordo_tpu.serve import precision
 from gordo_tpu.serve.shard import shard_slices
 from gordo_tpu.serve.fleet_scorer import FleetScorer
@@ -181,20 +181,12 @@ def chunk_windows(
     return windows
 
 
-def _dataset_fingerprint(dataset_meta: Dict[str, Any]) -> str:
-    """Frames are shareable iff tags + resolution + provider match —
-    replicated fleets collapse to one provider fetch."""
-    return json.dumps(
-        {
-            "tags": [
-                t["name"] if isinstance(t, dict) else str(t)
-                for t in dataset_meta.get("tag_list", [])
-            ],
-            "resolution": dataset_meta.get("resolution", "10min"),
-            "provider": dataset_meta.get("data_provider"),
-        },
-        sort_keys=True,
-    )
+# Frames are shareable iff tags + resolution + provider match —
+# replicated fleets collapse to one provider fetch.  The fingerprint
+# definition was hoisted into the shared ingest plane (r24) so the
+# builder, refresh, and batch planes cannot drift on what "same data"
+# means.
+_dataset_fingerprint = provider_fingerprint
 
 
 def _load_fleet(

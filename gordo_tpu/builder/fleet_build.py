@@ -49,7 +49,12 @@ from gordo_tpu.builder.build_model import (
     lookup_cached_artifact,
 )
 from gordo_tpu.dataset.base import GordoBaseDataset
-from gordo_tpu.parallel.anomaly import FleetDiffBuilder, analyze_definition
+from gordo_tpu.ingest import plane as ingest_plane
+from gordo_tpu.parallel.anomaly import (
+    FleetDiffBuilder,
+    _model_axis_pad,
+    analyze_definition,
+)
 from gordo_tpu.utils import disk_registry, profiling
 from gordo_tpu.workflow.config import Machine
 
@@ -390,6 +395,13 @@ class ProjectBuildResult:
         #: the published artifact generation after this build's stamp
         #: (v2 only; None for v1 builds)
         self.generation: Optional[int] = None
+        #: resolved loader-pool thread count (adaptive when the caller
+        #: passed data_workers=None — see build_project)
+        self.loader_workers: int = 0
+        #: build-ingest plane accounting (None when GORDO_INGEST is off):
+        #: machines / fetches / dedup_hits / vectorized / fallback counts
+        #: accumulated across chunks by ingest.plane.load_chunk
+        self.ingest: Optional[Dict[str, Any]] = None
 
     def summary(self) -> Dict[str, Any]:
         out = {
@@ -404,6 +416,10 @@ class ProjectBuildResult:
             "device_idle_seconds": self.device_idle_seconds,
             "artifact_format": self.artifact_format,
         }
+        if self.loader_workers:
+            out["loader_workers"] = self.loader_workers
+        if self.ingest is not None:
+            out["ingest"] = dict(self.ingest)
         if self.warm_started or self.warm_fallbacks:
             out["warm_started"] = len(self.warm_started)
             out["warm_fallbacks"] = dict(self.warm_fallbacks)
@@ -534,7 +550,7 @@ def build_project(
     mesh: Optional[Mesh] = None,
     replace_cache: bool = False,
     max_bucket_size: Optional[int] = None,
-    data_workers: int = 8,
+    data_workers: Optional[int] = None,
     align_lengths: Optional[int] = None,
     pad_lengths: Optional[int] = None,
     auto_pad: bool = True,
@@ -543,6 +559,7 @@ def build_project(
     pipeline: Optional[bool] = None,
     artifact_format: Optional[str] = None,
     warm_start: bool = False,
+    ingest: Optional[bool] = None,
 ) -> ProjectBuildResult:
     """Build every machine; fleet-bucket the homogeneous ones.
 
@@ -624,6 +641,23 @@ def build_project(
     into cache keys exactly as an explicit ``pad_lengths`` would, so the
     decision is stable across re-runs of the same config set.
 
+    ``ingest`` (default: env-controlled via ``GORDO_INGEST``, on): load
+    each fleet chunk through the build-ingest plane
+    (:func:`gordo_tpu.ingest.plane.load_chunk`) — one fingerprint-deduped,
+    fleet-vectorized columnar assembly per chunk instead of one
+    ``dataset.get_data()`` pandas pass per machine, writing straight into
+    the stacked ``(m_pad, n, tags)`` buffer the dispatch path adopts.
+    Byte-identical artifacts either way (tests/test_ingest.py);
+    ``GORDO_INGEST=off`` or ``ingest=False`` restores the per-machine
+    loader pool.
+
+    ``data_workers`` (default None → adaptive): loader-pool threads.
+    BENCH_r23 measured the fixed 8-thread pool SLOWER than serial loading
+    on a low-core host (GIL contention on pure-pandas work), so None now
+    sizes the pool to the host — and to the ingest plane, whose unit of
+    work is a whole chunk, not a machine.  The resolved value lands in
+    ``result.loader_workers``.
+
     ``shard``: a :class:`gordo_tpu.distributed.partition.ProcessShard` —
     build only this process's slice of ``machines`` (multi-host builds;
     artifact/metadata layout is identical to the single-host path).  The
@@ -657,6 +691,16 @@ def build_project(
     result = ProjectBuildResult()
     artifact_fmt = artifacts.resolve_format(artifact_format)
     result.artifact_format = artifact_fmt
+    use_ingest = ingest_plane.resolve_enabled(ingest)
+    if data_workers is None:
+        # adaptive pool sizing (see docstring): the ingest plane loads a
+        # whole chunk per task, so prefetch depth (2: current + next) is
+        # all the parallelism the pipeline can use; the per-machine path
+        # scales with cores but never past the old fixed 8
+        ncpu = os.cpu_count() or 2
+        data_workers = 2 if use_ingest else max(2, min(8, ncpu - 1))
+    result.loader_workers = int(data_workers)
+    result.ingest = {"enabled": use_ingest} if use_ingest else None
     tracker = _LoadTracker()
     occupancy = _DeviceOccupancy()
     warm_resolved: Dict[str, Tuple[Any, Optional[float]]] = {}
@@ -842,11 +886,54 @@ def build_project(
         tracker.acquire()  # arrays are live from here until freed
         return entry
 
+    def _load_chunk_ingest(chunk: List[Machine]) -> Dict[str, Any]:
+        """One loader-pool task per CHUNK: the build-ingest plane's
+        fingerprint-deduped, fleet-vectorized assembly
+        (gordo_tpu/ingest/plane.py).  The capacity callable hands the
+        dispatch plane's model-axis padding down so the stacked buffer
+        the plane fills IS the ``(m_pad, n, tags)`` array the fleet
+        program stages — no re-stack, no pad copy."""
+        t0 = time.time()
+        entries = ingest_plane.load_chunk(
+            chunk,
+            align_lengths=align_lengths,
+            capacity=(lambda mm: _model_axis_pad(mm, mesh)),
+            stats=result.ingest,
+        )
+        _PIPE_STAGE_SECONDS.observe(time.time() - t0, "load")
+        return entries
+
     def _submit(pool, chunk: List[Machine]):
+        if use_ingest:
+            return pool.submit(_load_chunk_ingest, chunk)
         return {m.name: pool.submit(_load, m) for m in chunk}
 
     def _collect(chunk: List[Machine], futures) -> Dict[str, Tuple]:
         loaded: Dict[str, Tuple] = {}
+        if use_ingest:
+            try:
+                entries = futures.result()
+            except Exception as exc:  # plane crash: fail the whole chunk
+                logger.exception("Ingest load failed for %d machine(s)",
+                                 len(chunk))
+                for m in chunk:
+                    result.failed[m.name] = f"data: {exc}"
+                    _BUILD_MACHINES_TOTAL.inc(1.0, "failed")
+                return loaded
+            for m in chunk:
+                entry = entries.get(m.name)
+                if entry is None or isinstance(entry, Exception):
+                    exc = entry if entry is not None else RuntimeError(
+                        "ingest plane produced no entry"
+                    )
+                    logger.error("Data load failed for %s: %s", m.name, exc)
+                    result.failed[m.name] = f"data: {exc}"
+                    _BUILD_MACHINES_TOTAL.inc(1.0, "failed")
+                    continue
+                _DATA_LOAD_SECONDS.observe(entry[3])
+                tracker.acquire()  # arrays live until freed, as in _load
+                loaded[m.name] = entry
+            return loaded
         for m in chunk:
             try:
                 loaded[m.name] = futures[m.name].result()

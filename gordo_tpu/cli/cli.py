@@ -144,9 +144,18 @@ def build(output_dir, name, model_config, data_config, metadata,
                    "single-device path, an integer N takes the first N "
                    "devices. Resolved by gordo_tpu.mesh.FleetMesh; env "
                    "equivalent GORDO_MESH_DEVICES.")
-@click.option("--data-workers", default=8, show_default=True,
+@click.option("--data-workers", default=None, show_default="adaptive",
               type=click.IntRange(min=1),
-              help="Concurrent data-loader threads feeding the stream.")
+              help="Concurrent data-loader threads feeding the stream. "
+                   "Default: sized to the host and the ingest plane "
+                   "(BENCH_r23 measured a fixed 8-thread pool slower than "
+                   "serial loading on low-core hosts); the resolved count "
+                   "lands in the result summary as loader_workers.")
+@click.option("--ingest/--no-ingest", "ingest", default=None,
+              help="Fleet-vectorized chunk ingest with fingerprint-level "
+                   "fetch dedup (gordo_tpu/ingest/). Default: on, env "
+                   "GORDO_INGEST=off disables; artifacts are "
+                   "byte-identical either way.")
 @click.option("--align-lengths", default=None,
               type=click.IntRange(min=2),
               help="Truncate each machine's train rows down to a multiple "
@@ -194,16 +203,17 @@ def build(output_dir, name, model_config, data_config, metadata,
 @click.option("--replace-cache", is_flag=True)
 def build_project_cmd(machine_config, project_name, output_dir,
                       model_register_dir, max_bucket_size, data_parallel,
-                      mesh_devices, data_workers, align_lengths, pad_lengths,
-                      machines_filter, multihost, barrier_timeout, auto_pad,
-                      artifact_format, replace_cache):
+                      mesh_devices, data_workers, ingest, align_lengths,
+                      pad_lengths, machines_filter, multihost,
+                      barrier_timeout, auto_pad, artifact_format,
+                      replace_cache):
     """Build EVERY machine in the project config — homogeneous machines
     train as single mesh-sharded fleet programs (the TPU-native
     replacement for the reference's one-pod-per-machine Argo DAG)."""
     from gordo_tpu.builder.fleet_build import build_project
-    from gordo_tpu.workflow.config import NormalizedConfig, load_machine_config
+    from gordo_tpu.workflow.config import NormalizedConfig
 
-    config = NormalizedConfig(load_machine_config(machine_config), project_name)
+    config = NormalizedConfig.from_source(machine_config, project_name)
     machines = config.machines
     if machines_filter:
         wanted = {n.strip() for n in machines_filter.split(",") if n.strip()}
@@ -256,6 +266,7 @@ def build_project_cmd(machine_config, project_name, output_dir,
         pad_lengths=pad_lengths,
         auto_pad=auto_pad,
         artifact_format=artifact_format,
+        ingest=ingest,
     )
     click.echo(json.dumps(result.summary()))
     if result.failed:
@@ -470,12 +481,12 @@ def run_watchman_cmd(project, machines, machine_config, targets, host, port,
                      poll_interval, discover, kube_namespace):
     """Run the fleet-status aggregation service."""
     from gordo_tpu.watchman.server import run_watchman
-    from gordo_tpu.workflow.config import NormalizedConfig, load_machine_config
+    from gordo_tpu.workflow.config import NormalizedConfig
 
     if machines:
         machine_names = [m.strip() for m in machines.split(",") if m.strip()]
     elif machine_config:
-        config = NormalizedConfig(load_machine_config(machine_config), project)
+        config = NormalizedConfig.from_source(machine_config, project)
         machine_names = [m.name for m in config.machines]
     elif discover:
         machine_names = []  # discovered from the targets' project indexes
@@ -1229,9 +1240,9 @@ def refresh_cmd(machine_config, project_name, output_dir,
     per cycle on stdout.
     """
     from gordo_tpu.refresh import RefreshConfig, refresh_once
-    from gordo_tpu.workflow.config import NormalizedConfig, load_machine_config
+    from gordo_tpu.workflow.config import NormalizedConfig
 
-    config = NormalizedConfig(load_machine_config(machine_config), project_name)
+    config = NormalizedConfig.from_source(machine_config, project_name)
     cfg = RefreshConfig(
         machines=config.machines,
         output_dir=output_dir,
@@ -1441,11 +1452,10 @@ def workflow_generate(machine_config, project_name, image, server_replicas,
     from gordo_tpu.workflow import (
         NormalizedConfig,
         generate_workflow,
-        load_machine_config,
         workflow_to_yaml,
     )
 
-    config = NormalizedConfig(load_machine_config(machine_config), project_name)
+    config = NormalizedConfig.from_source(machine_config, project_name)
     if multihost and fmt == "argo":
         raise click.BadParameter(
             "--multihost applies to the k8s Indexed-Job builder; the argo "
@@ -1502,9 +1512,9 @@ def workflow_plan(machine_config, project_name, max_bucket_size,
     planned, prints the estimated per-distinct-length compile bill to
     stderr — the dry run is where that cost should surface, not an hour
     into the build."""
-    from gordo_tpu.workflow import NormalizedConfig, build_plan, load_machine_config
+    from gordo_tpu.workflow import NormalizedConfig, build_plan
 
-    config = NormalizedConfig(load_machine_config(machine_config), project_name)
+    config = NormalizedConfig.from_source(machine_config, project_name)
     plan = build_plan(
         config, max_bucket_size=max_bucket_size,
         align_lengths=align_lengths, pad_lengths=pad_lengths,
@@ -1530,9 +1540,9 @@ def workflow_plan(machine_config, project_name, max_bucket_size,
 @click.option("--output-file-tag-list", type=click.File("w"), default="-")
 def workflow_unique_tags(machine_config, output_file_tag_list):
     """List distinct sensor tags across the project (reference parity)."""
-    from gordo_tpu.workflow import NormalizedConfig, load_machine_config, unique_tags
+    from gordo_tpu.workflow import NormalizedConfig, unique_tags
 
-    config = NormalizedConfig(load_machine_config(machine_config))
+    config = NormalizedConfig.from_source(machine_config)
     for tag in unique_tags(config.machines):
         output_file_tag_list.write(f"{tag}\n")
 

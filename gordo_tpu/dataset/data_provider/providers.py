@@ -57,14 +57,9 @@ class RandomDataProvider(GordoBaseDataProvider):
     def can_handle_tag(self, tag) -> bool:
         return True
 
-    def load_series(
-        self,
-        from_ts: pd.Timestamp,
-        to_ts: pd.Timestamp,
-        tag_list: List,
-        dry_run: bool = False,
-    ) -> Iterable[pd.Series]:
-        tags = normalize_sensor_tags(list(tag_list))
+    def _shared_index(
+        self, from_ts: pd.Timestamp, to_ts: pd.Timestamp
+    ) -> pd.DatetimeIndex:
         step = pd.tseries.frequencies.to_offset(self.frequency).nanos
         n_grid = int((to_ts - from_ts).value // step) + 1
         n = int(np.clip(n_grid, self.min_size, self.max_size))
@@ -74,17 +69,72 @@ class RandomDataProvider(GordoBaseDataProvider):
         # ns unit up front: tz-aware periods-based date_range yields a
         # µs-resolution index, and every downstream resample would pay its
         # own as_unit("ns") conversion per tag
-        index = pd.date_range(
+        return pd.date_range(
             start=from_ts, end=to_ts, periods=n, name="time"
         ).as_unit("ns")
+
+    def _tag_values(self, tag_name: str, n: int) -> np.ndarray:
+        # Stable digest (Python's hash() is salted per process and would
+        # break cross-process reproducibility / the build cache contract).
+        rng = np.random.default_rng(
+            zlib.crc32(f"{tag_name}:{self.seed}".encode())
+        )
+        return rng.standard_normal(n).cumsum() * 0.1 + rng.uniform(-1, 1)
+
+    def load_series(
+        self,
+        from_ts: pd.Timestamp,
+        to_ts: pd.Timestamp,
+        tag_list: List,
+        dry_run: bool = False,
+    ) -> Iterable[pd.Series]:
+        tags = normalize_sensor_tags(list(tag_list))
+        index = self._shared_index(from_ts, to_ts)
         for tag in tags:
-            # Stable digest (Python's hash() is salted per process and would
-            # break cross-process reproducibility / the build cache contract).
-            rng = np.random.default_rng(
-                zlib.crc32(f"{tag.name}:{self.seed}".encode())
+            yield pd.Series(
+                self._tag_values(tag.name, len(index)),
+                index=index, name=tag.name,
             )
-            values = rng.standard_normal(n).cumsum() * 0.1 + rng.uniform(-1, 1)
-            yield pd.Series(values, index=index, name=tag.name)
+
+    # machines in a fleet share the train window, so the (identical)
+    # index grid was being rebuilt per machine by the ingest plane's
+    # array fetch; pd indexes are immutable, sharing one is safe.  The
+    # per-machine load_series path is left uncached on purpose — it is
+    # the bench baseline the ingest plane is measured against.
+    _index_cache: dict = {}
+
+    def _shared_index_cached(
+        self, from_ts: pd.Timestamp, to_ts: pd.Timestamp
+    ) -> pd.DatetimeIndex:
+        key = (
+            int(from_ts.value), int(to_ts.value), self.frequency,
+            self.min_size, self.max_size,
+        )
+        index = RandomDataProvider._index_cache.get(key)
+        if index is None:
+            index = self._shared_index(from_ts, to_ts)
+            if len(RandomDataProvider._index_cache) >= 32:
+                RandomDataProvider._index_cache.pop(
+                    next(iter(RandomDataProvider._index_cache)), None
+                )
+            RandomDataProvider._index_cache[key] = index
+        return index
+
+    def load_arrays(
+        self,
+        from_ts: pd.Timestamp,
+        to_ts: pd.Timestamp,
+        tag_list: List,
+    ):
+        """Array-grain fetch for the fleet ingest plane: the same shared
+        grid and per-tag generator as :meth:`load_series` (bit-identical
+        columns) without 1 ``pd.Series`` construction per tag."""
+        tags = normalize_sensor_tags(list(tag_list))
+        index = self._shared_index_cached(from_ts, to_ts)
+        values = np.empty((len(index), len(tags)), dtype=np.float64)
+        for j, tag in enumerate(tags):
+            values[:, j] = self._tag_values(tag.name, len(index))
+        return index, values
 
 
 class FileSystemTagProvider(GordoBaseDataProvider):

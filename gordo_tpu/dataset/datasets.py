@@ -33,10 +33,19 @@ def _summary_statistics(data: pd.DataFrame) -> Dict[str, Dict[str, float]]:
     4 stats x tags x thousands of machines made METADATA the largest
     single host cost of a warm project build (measured ~75ms/machine,
     ~80% of warm build wall time).  ddof=1 matches ``Series.std``."""
-    cols = list(data.columns)
+    return summary_statistics_arrays(
+        data.to_numpy(dtype=np.float64, copy=False), list(data.columns)
+    )
+
+
+def summary_statistics_arrays(
+    values: np.ndarray, cols: List[Any]
+) -> Dict[str, Dict[str, float]]:
+    """:func:`_summary_statistics` on a ``(rows, len(cols))`` float64
+    matrix — the shared kernel the fleet ingest plane calls column-slice
+    by column-slice without materializing per-machine DataFrames."""
     if not cols:
         return {}
-    values = data.to_numpy(dtype=np.float64, copy=False)
     if values.shape[0] == 0:
         nan = float("nan")
         return {
@@ -91,6 +100,39 @@ def _bin_label_index(
 
 _bin_label_index._cache = {}
 _bin_label_index._lock = threading.Lock()
+
+
+#: nanoseconds per day — resample origin is midnight UTC of the first sample
+_DAY_NS = 86_400_000_000_000
+
+
+def resample_prep(
+    index: pd.DatetimeIndex, nanos: int
+) -> Tuple[np.ndarray, int, np.ndarray, pd.DatetimeIndex]:
+    """Binning geometry for a mean-resample of ``index`` at a fixed
+    ``nanos``-wide resolution: ``(starts, grid_size, scatter,
+    label_index)`` — bin-boundary positions for ``np.add.reduceat``, the
+    complete output grid size, the scatter positions of the occupied
+    bins, and the (cached) label index.
+
+    The ONE definition of the resample geometry: the per-machine fast
+    path (:meth:`TimeSeriesDataset._resample_one_arrays`) and the fleet
+    ingest plane's cross-machine columnar pass
+    (``gordo_tpu/ingest/plane.py``) both call it, so they cannot drift.
+    Assumes a non-empty, monotonic, UTC index."""
+    # pandas 2.x indexes may be us/ms-resolution; do the math in ns
+    idx = index.asi8 if index.unit == "ns" else index.as_unit("ns").asi8
+    # midnight UTC of the first sample as pure integer math
+    # (Timestamp.normalize() was a measurable per-tag cost)
+    origin = (idx[0] // _DAY_NS) * _DAY_NS
+    bins = (idx - origin) // nanos
+    starts = np.concatenate([[0], np.flatnonzero(np.diff(bins)) + 1])
+    grid_size = int(bins[-1] - bins[0]) + 1
+    scatter = (bins[starts] - bins[0]).astype(np.int64)
+    label_index = _bin_label_index(
+        origin, int(bins[0]), int(bins[-1]), nanos, index.name
+    )
+    return starts, grid_size, scatter, label_index
 
 
 def _to_timestamp(value) -> pd.Timestamp:
@@ -163,7 +205,6 @@ class TimeSeriesDataset(GordoBaseDataset):
         self._metadata: Dict[str, Any] = {}
 
     # -- assembly ------------------------------------------------------------
-    _DAY_NS = 86_400_000_000_000
 
     def _resample_one_arrays(self, series: pd.Series, _memo=None):
         """Vectorized resample of one tag to ``(values, label_index)``, or
@@ -198,23 +239,8 @@ class TimeSeriesDataset(GordoBaseDataset):
         index = series.index
         prep = _memo.get(id(index)) if _memo is not None else None
         if prep is None:
-            # pandas 2.x indexes may be us/ms-resolution; do the math in ns
-            idx = (
-                index.asi8 if index.unit == "ns"
-                else index.as_unit("ns").asi8
-            )
-            # midnight UTC of the first sample as pure integer math
-            # (Timestamp.normalize() was a measurable per-tag cost)
-            origin = (idx[0] // self._DAY_NS) * self._DAY_NS
-            bins = (idx - origin) // nanos
-            starts = np.concatenate(
-                [[0], np.flatnonzero(np.diff(bins)) + 1]
-            )
-            grid_size = int(bins[-1] - bins[0]) + 1
-            scatter = (bins[starts] - bins[0]).astype(np.int64)
-            label_index = _bin_label_index(
-                origin, int(bins[0]), int(bins[-1]), nanos,
-                series.index.name,
+            starts, grid_size, scatter, label_index = resample_prep(
+                index, nanos
             )
             # the entry holds the index object itself: the memo is keyed by
             # id(), and letting the index be GC'd could recycle its id for

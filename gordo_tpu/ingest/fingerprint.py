@@ -1,0 +1,100 @@
+"""Dataset fingerprints: the shared definition of "same data".
+
+Two grains, both JSON-canonical (sorted keys, name-normalized tags) so
+equal fingerprints mean equal strings across processes and releases:
+
+- :func:`provider_fingerprint` — the FETCH grain the r18 backfill runner
+  introduced: frames are shareable iff tags + resolution + provider
+  match.  The batch plane keys its one-fetch-per-fingerprint cache on
+  this (the scoring window is fixed per backfill run, so it lives
+  outside the key).
+- :func:`dataset_fingerprint` — the full OUTPUT grain the build-ingest
+  plane dedups on: everything that shapes ``get_data()``'s result —
+  window, tags, targets, resolution, filter, aggregation, thresholds,
+  provider.  Machines with equal fingerprints get byte-identical frames,
+  so the builder fetches and assembles once and copies slots; any
+  differing field changes the JSON and misses the cache (wrong dedup
+  would train machines on the wrong data — tests/test_ingest.py pins
+  both directions).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List
+
+
+def _tag_names(tags: Any) -> List[str]:
+    """Tag names from any config/metadata spelling (str | dict | SensorTag)."""
+    out = []
+    for t in tags or []:
+        if isinstance(t, dict):
+            out.append(str(t.get("name")))
+        else:
+            out.append(str(getattr(t, "name", t)))
+    return out
+
+
+def provider_fingerprint(dataset_meta: Dict[str, Any]) -> str:
+    """Fetch-grain fingerprint over dataset METADATA or config: frames are
+    shareable iff tags + resolution + provider match — replicated fleets
+    collapse to one provider fetch (hoisted from the r18 backfill
+    runner's ``_dataset_fingerprint``; same JSON shape)."""
+    return json.dumps(
+        {
+            "tags": _tag_names(
+                dataset_meta.get("tag_list") or dataset_meta.get("tags")
+            ),
+            "resolution": dataset_meta.get("resolution", "10min"),
+            "provider": dataset_meta.get("data_provider"),
+        },
+        sort_keys=True,
+        default=str,
+    )
+
+
+def dataset_fingerprint(dataset_cfg: Dict[str, Any]) -> str:
+    """Output-grain fingerprint over a machine's dataset CONFIG: covers
+    every field that shapes ``get_data()``'s frames.  Conservative by
+    construction — unknown keys are hashed in verbatim, so a config the
+    fingerprint does not understand can only MISS the dedup cache, never
+    falsely hit it."""
+    tags = _tag_names(dataset_cfg.get("tag_list") or dataset_cfg.get("tags"))
+    targets = dataset_cfg.get("target_tag_list")
+    doc = {
+        "type": dataset_cfg.get("type"),
+        "window": [
+            str(dataset_cfg.get("train_start_date")),
+            str(dataset_cfg.get("train_end_date")),
+        ],
+        "tags": tags,
+        "targets": _tag_names(targets) if targets else tags,
+        "resolution": dataset_cfg.get("resolution", "10min"),
+        "row_filter": dataset_cfg.get("row_filter"),
+        "row_filter_buffer_size": dataset_cfg.get("row_filter_buffer_size", 0),
+        "aggregation_methods": dataset_cfg.get("aggregation_methods", "mean"),
+        "n_samples_threshold": dataset_cfg.get("n_samples_threshold", 0),
+        "asset": dataset_cfg.get("asset"),
+        "provider": dataset_cfg.get("data_provider"),
+        "extra": {
+            k: v
+            for k, v in dataset_cfg.items()
+            if k
+            not in (
+                "type",
+                "train_start_date",
+                "train_end_date",
+                "tag_list",
+                "tags",
+                "target_tag_list",
+                "resolution",
+                "row_filter",
+                "row_filter_buffer_size",
+                "aggregation_methods",
+                "n_samples_threshold",
+                "asset",
+                "data_provider",
+            )
+        },
+    }
+    return json.dumps(doc, sort_keys=True, default=str)

@@ -63,6 +63,7 @@ from gordo_tpu.mesh import (
     model_sharding,
     pad_to_multiple,
 )
+from gordo_tpu.ingest.plane import owned_stack_base, stack_live_slots
 from gordo_tpu.parallel import fleet as fleet_mod
 from gordo_tpu.pipeline import Pipeline
 from gordo_tpu.registry import lookup_factory
@@ -259,6 +260,58 @@ def _model_axis_pad(m: int, mesh) -> int:
     if mesh is not None:
         m_pad = pad_to_multiple(m_pad, mesh.shape[MODEL_AXIS])
     return m_pad
+
+
+def _stack_machine_axis(arrs: Sequence[np.ndarray]) -> np.ndarray:
+    """``np.stack`` along a new leading machine axis — except when the
+    arrays are, in order, a consecutive run of leading-axis slots of ONE
+    ingest-owned stacked buffer (``gordo_tpu/ingest/plane.py``): then the
+    buffer slice is adopted with no copy.  The ingest plane preallocates
+    that buffer at model-axis capacity precisely so this stacking copy
+    (and the padding copy in :meth:`_dispatch_group`) disappears; any
+    deviation — a fallback-loaded machine in the group, dedup slots out
+    of machine order, a foreign array — falls back to the copy."""
+    base = owned_stack_base(arrs[0])
+    if base is None or any(a.shape != base.shape[1:] for a in arrs):
+        return np.stack(arrs)
+    b0 = base.__array_interface__["data"][0]
+    stride = base.strides[0]
+    off = arrs[0].__array_interface__["data"][0] - b0
+    if stride <= 0 or off % stride:
+        return np.stack(arrs)
+    s0 = off // stride
+    if s0 + len(arrs) > base.shape[0]:
+        return np.stack(arrs)
+    for j, a in enumerate(arrs):
+        if (
+            owned_stack_base(a) is not base
+            or a.strides != base.strides[1:]
+            or a.__array_interface__["data"][0] != b0 + (s0 + j) * stride
+        ):
+            return np.stack(arrs)
+    return base[s0 : s0 + len(arrs)]
+
+
+def _pad_models_capacity(X: np.ndarray, m_pad: int) -> np.ndarray:
+    """:func:`fleet._pad_models` without the copy when ``X`` is the FULL
+    live prefix of an ingest-owned buffer with spare capacity: the dummy
+    pad lanes (repeats of the last machine; results discarded) are
+    written into the buffer's scratch rows in place.  Requiring ``X`` to
+    start at slot 0 and cover every live slot guarantees no other
+    machine's data occupies the rows being overwritten."""
+    m = X.shape[0]
+    base = owned_stack_base(X)
+    if (
+        base is not None
+        and m_pad <= base.shape[0]
+        and m == stack_live_slots(base)
+        and X.strides == base.strides
+        and X.__array_interface__["data"][0]
+        == base.__array_interface__["data"][0]
+    ):
+        base[m:m_pad] = base[m - 1]
+        return base[:m_pad]
+    return fleet_mod._pad_models(X, m_pad)
 
 
 def _stack_warm_params(params_list: Sequence[Any], m_pad: int):
@@ -550,8 +603,13 @@ class FleetDiffBuilder:
         for i in idxs:
             by_len.setdefault(int(Xs[i].shape[0]), []).append(i)
         for group in by_len.values():
-            X_g = np.stack([Xs[i] for i in group])
-            y_g = X_g if ys is None else np.stack([ys[i] for i in group])
+            X_g = _stack_machine_axis([Xs[i] for i in group])
+            if ys is None or all(ys[i] is Xs[i] for i in group):
+                # the ingest plane hands targets == inputs as the SAME
+                # array object — one stacked buffer serves both
+                y_g = X_g
+            else:
+                y_g = _stack_machine_axis([ys[i] for i in group])
             warm_g = (
                 None
                 if warm_params is None
@@ -788,8 +846,9 @@ class FleetDiffBuilder:
         # compiled program per (module, length) — see _model_axis_pad.
         m_pad = _model_axis_pad(m, self.mesh)
         if m_pad != m:
-            X = fleet_mod._pad_models(X, m_pad)
-            y = fleet_mod._pad_models(y, m_pad)
+            y_is_x = y is X
+            X = _pad_models_capacity(X, m_pad)
+            y = X if y_is_x else _pad_models_capacity(y, m_pad)
             if lens is not None:
                 # host ints → int32 view (this scope's lint gate reserves
                 # the np.asarray spelling for D2H misuse)

@@ -344,7 +344,7 @@ def refresh_once(
     selector.save(path)
     _CYCLES_TOTAL.inc(1.0, "failed" if result.failed else "rebuilt")
 
-    return {
+    summary = {
         "outcome": "failed" if result.failed else "rebuilt",
         "selected": [m.name for m in subset],
         "drifting": drifting,
@@ -356,6 +356,11 @@ def refresh_once(
         "live_confirmed": confirmed is not None,
         "seconds": latency,
     }
+    if getattr(result, "ingest", None) is not None:
+        # the refresh rides the builder's ingest plane (warm_start chunks
+        # load through it too) — surface the fetch-dedup accounting
+        summary["ingest"] = dict(result.ingest)
+    return summary
 
 
 def run_refresh(

@@ -13,12 +13,21 @@ import inspect
 from typing import Any, Dict
 
 
+@functools.lru_cache(maxsize=256)
+def _cached_signature(init):
+    # signature inspection cost ~0.2ms per construction — at fleet-ingest
+    # scale (3 constructions x thousands of machines) it was a measurable
+    # slice of the load stage; Signature objects are immutable, bind() is
+    # per-call
+    return inspect.signature(init)
+
+
 def capture_args(init):
     """Decorator for ``__init__`` storing bound arguments as ``_init_params``."""
 
     @functools.wraps(init)
     def wrapper(self, *args, **kwargs):
-        sig = inspect.signature(init)
+        sig = _cached_signature(init)
         bound = sig.bind(self, *args, **kwargs)
         bound.apply_defaults()
         params: Dict[str, Any] = {

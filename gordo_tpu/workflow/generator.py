@@ -33,6 +33,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import yaml
 
 from gordo_tpu.builder.build_model import calculate_model_key
+from gordo_tpu.ingest.fingerprint import dataset_fingerprint
 from gordo_tpu.workflow.config import Machine, NormalizedConfig
 
 API_PREFIX = "/gordo/v0"
@@ -166,6 +167,22 @@ def build_plan(
         # index) instead of one directory per machine
         "artifact_format": "v2",
         "artifact_packs_estimate": len(plan_buckets),
+    }
+    # ingest-plane projection: one provider fetch per distinct dataset
+    # fingerprint (gordo_tpu/ingest/fingerprint.py) — the plan surfaces
+    # the dedup the build will get, so a replicated fleet's operator
+    # sees the fetch bill up front in `workflow plan`
+    fingerprints = {
+        dataset_fingerprint(dict(m.dataset)) for m in config.machines
+    }
+    n_machines = len(config.machines)
+    dedup_hits = n_machines - len(fingerprints)
+    plan["ingest"] = {
+        "distinct_dataset_fingerprints": len(fingerprints),
+        "dedup_hits": dedup_hits,
+        "fetch_dedup_ratio": round(
+            dedup_hits / n_machines, 4
+        ) if n_machines else 0.0,
     }
     if align_lengths:
         plan["align_lengths"] = int(align_lengths)

@@ -284,6 +284,67 @@ def _refresh_import_findings(
     return findings
 
 
+#: the build-ingest hot path (gordo_tpu/ingest/plane.py) must stay
+#: columnar numpy: per-machine pandas assembly verbs are banned outside
+#: the ONE sanctioned escape hatch, ``_load_fallback`` (row filters,
+#: custom aggregation, subclassed datasets).  ``pd.tseries...to_offset``
+#: and type references stay legal — the ban is on per-machine FRAME
+#: construction and resampling, the r24 512-sequential-passes wall.
+INGEST_PLANE_FILE = os.path.join("gordo_tpu", "ingest", "plane.py")
+INGEST_SANCTIONED_SCOPES = {"_load_fallback"}
+INGEST_BANNED_ATTR_CALLS = {
+    "resample", "to_frame", "iterrows", "get_data",
+}
+INGEST_BANNED_PD_CALLS = {"DataFrame", "Series", "concat"}
+
+
+def _ingest_findings(
+    path: str, tree: ast.AST, noqa_lines: set
+) -> List[Finding]:
+    """Flag per-machine pandas assembly in the ingest hot path: every
+    machine routed through :func:`load_chunk`'s vectorized pass must be
+    assembled by the shared columnar kernels; a stray ``.resample()`` /
+    ``pd.DataFrame`` / ``.get_data()`` reintroduces the per-machine wall
+    the plane exists to remove.  ``_load_fallback`` is the sanctioned
+    per-machine path; ``# noqa`` opts a line out, as elsewhere."""
+    norm = os.path.normpath(path)
+    if not norm.endswith(INGEST_PLANE_FILE):
+        return []
+    sanctioned = [
+        (node.lineno, getattr(node, "end_lineno", node.lineno))
+        for node in ast.walk(tree)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and node.name in INGEST_SANCTIONED_SCOPES
+    ]
+    findings: List[Finding] = []
+    for call in ast.walk(tree):
+        if not isinstance(call, ast.Call):
+            continue
+        func = call.func
+        bad = None
+        if isinstance(func, ast.Attribute):
+            if (
+                func.attr in INGEST_BANNED_PD_CALLS
+                and isinstance(func.value, ast.Name)
+                and func.value.id in ("pd", "pandas")
+            ):
+                bad = f"{func.value.id}.{func.attr}"
+            elif func.attr in INGEST_BANNED_ATTR_CALLS:
+                bad = f".{func.attr}"
+        if not bad or call.lineno in noqa_lines:
+            continue
+        if any(a <= call.lineno <= b for a, b in sanctioned):
+            continue
+        findings.append(
+            (path, call.lineno,
+             f"per-machine pandas assembly {bad}() in the ingest hot "
+             "path — machines assemble through the columnar vectorized "
+             "pass; the only sanctioned per-machine route is "
+             "_load_fallback")
+        )
+    return findings
+
+
 def _batch_import_findings(
     path: str, tree: ast.AST, noqa_lines: set
 ) -> List[Finding]:
@@ -836,6 +897,7 @@ def lint_file(path: str) -> List[Finding]:
     findings.extend(_artifacts_pack_findings(path, tree, noqa_lines))
     findings.extend(_refresh_import_findings(path, tree, noqa_lines))
     findings.extend(_batch_import_findings(path, tree, noqa_lines))
+    findings.extend(_ingest_findings(path, tree, noqa_lines))
 
     for node in ast.walk(tree):
         if isinstance(node, ast.Call):

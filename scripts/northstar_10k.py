@@ -62,19 +62,69 @@ globals:
     )
 
 
+def measure_config(text: str):
+    """Same-session interleaved config measurement (r24 protocol).
+
+    The r23 artifact's ``config_seconds`` was measured on different
+    hardware-sharing conditions than any re-run, so the r24 fast-path
+    gate (≤ 0.5×) compares against a BASELINE RE-MEASURED IN THIS RUN:
+    the legacy path (pure-Python SafeLoader + eager normalization) and
+    the fast path (:meth:`NormalizedConfig.from_source`: C loader,
+    Counter dup-check, merge fast paths) alternate for two rounds and
+    the per-path best stands.  A third number records the content-hash
+    cache warm hit (parse + normalization both skipped).
+    """
+    import yaml
+
+    from gordo_tpu.workflow.config import NormalizedConfig
+
+    def legacy() -> float:
+        t0 = time.time()
+        cfg = yaml.load(text, Loader=yaml.SafeLoader)
+        NormalizedConfig(cfg, "northstar")
+        return time.time() - t0
+
+    best = {"legacy": None, "fast": None}
+    config = None
+    for _ in range(2):
+        dt = legacy()
+        if best["legacy"] is None or dt < best["legacy"]:
+            best["legacy"] = dt
+        t0 = time.time()
+        config = NormalizedConfig.from_source(text, "northstar")
+        dt = time.time() - t0
+        if best["fast"] is None or dt < best["fast"]:
+            best["fast"] = dt
+        print(
+            f"config round: legacy {best['legacy']:.1f}s "
+            f"fast {best['fast']:.1f}s", flush=True,
+        )
+
+    cache_dir = tempfile.mkdtemp(prefix="northstar-cfgcache-")
+    try:
+        NormalizedConfig.from_source(text, "northstar", cache_dir=cache_dir)
+        t0 = time.time()
+        config = NormalizedConfig.from_source(
+            text, "northstar", cache_dir=cache_dir
+        )
+        t_warm = time.time() - t0
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+    return config, best["fast"], best["legacy"], t_warm
+
+
 def main() -> int:
     from gordo_tpu.builder.fleet_build import build_project
-    from gordo_tpu.workflow.config import NormalizedConfig, load_machine_config
     from gordo_tpu.workflow.generator import build_plan
 
     t_all = time.time()
     print(f"generating {N_MACHINES}-machine project yaml...", flush=True)
-    t0 = time.time()
-    config = NormalizedConfig(
-        load_machine_config(project_yaml(N_MACHINES)), "northstar"
+    text = project_yaml(N_MACHINES)
+    config, t_config, t_config_base, t_config_warm = measure_config(text)
+    print(
+        f"config fast path {t_config:.1f}s vs legacy {t_config_base:.1f}s "
+        f"(cache-warm {t_config_warm:.2f}s)", flush=True,
     )
-    t_config = time.time() - t0
-    print(f"config parsed+normalized in {t_config:.1f}s", flush=True)
 
     t0 = time.time()
     plan = build_plan(config, max_bucket_size=BUCKET)
@@ -98,6 +148,9 @@ def main() -> int:
             "max_bucket_size": BUCKET,
             "plan_chunks": plan["n_buckets"],
             "config_seconds": round(t_config, 1),
+            "config_seconds_baseline": round(t_config_base, 1),
+            "config_ratio": round(t_config / t_config_base, 3),
+            "config_cache_warm_seconds": round(t_config_warm, 2),
             "plan_seconds": round(t_plan, 1),
             "build_seconds": round(t_build, 1),
             "built_ok": len(result.artifacts),
@@ -107,6 +160,8 @@ def main() -> int:
             "peak_loaded": result.peak_loaded,
             "peak_loaded_bound": 2 * BUCKET,
             "memory_bound_held": result.peak_loaded <= 2 * BUCKET,
+            "loader_workers": result.loader_workers,
+            "ingest": result.ingest,
             "platform": os.environ.get("JAX_PLATFORMS", "default"),
             "total_seconds": round(time.time() - t_all, 1),
         }
@@ -120,6 +175,7 @@ def main() -> int:
         doc["failed"] == 0
         and doc["built_ok"] == N_MACHINES
         and doc["memory_bound_held"]
+        and doc["config_ratio"] <= 0.5
     )
     print("NORTHSTAR", "OK" if ok else "FAILED", flush=True)
     return 0 if ok else 1

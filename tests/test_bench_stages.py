@@ -12,7 +12,7 @@ import bench
 
 def test_default_runs_every_stage_in_priority_order():
     assert bench.parse_stages([]) == [
-        "build", "build_pipeline", "build_throughput",
+        "build", "build_pipeline", "build_throughput", "build_ingest",
         "artifact_io", "hot_reload", "serving",
         "serving_precision", "serving_sharded", "serving_wire",
         "serving_openloop", "telemetry_overhead", "health_overhead",
@@ -28,6 +28,12 @@ def test_backfill_stage_selectable():
 def test_build_throughput_stage_selectable():
     assert bench.parse_stages(["--stage", "build_throughput"]) == [
         "build_throughput"
+    ]
+
+
+def test_build_ingest_stage_selectable():
+    assert bench.parse_stages(["--stage", "build_ingest"]) == [
+        "build_ingest"
     ]
 
 

@@ -50,6 +50,27 @@ def test_build_plan_buckets_by_signature():
     assert set(two_tag["cache_keys"]) == {"gen-a", "gen-b"}
 
 
+def test_build_plan_reports_fetch_dedup_projection():
+    """r24: `workflow plan` surfaces the ingest plane's fetch dedup —
+    the operator sees the provider-fetch bill before building."""
+    import copy
+
+    project = copy.deepcopy(PROJECT)
+    # twin of gen-a: identical dataset config, distinct name
+    project["machines"].append(
+        {"name": "gen-a-twin",
+         "dataset": dict(project["machines"][0]["dataset"])}
+    )
+    plan = build_plan(NormalizedConfig(project, "genproj"))
+    assert plan["ingest"] == {
+        "distinct_dataset_fingerprints": 3,
+        "dedup_hits": 1,
+        "fetch_dedup_ratio": 0.25,
+    }
+    # no twins → no projected dedup
+    assert build_plan(_config())["ingest"]["dedup_hits"] == 0
+
+
 def test_build_plan_respects_max_bucket_size():
     plan = build_plan(_config(), max_bucket_size=1)
     assert plan["n_buckets"] == 3
